@@ -1,0 +1,16 @@
+"""Test configuration: force a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding/collective logic is
+tested on 8 virtual CPU devices, the same way the driver's
+``dryrun_multichip`` validates the pjit path (see __graft_entry__.py).
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

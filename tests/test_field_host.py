@@ -1,0 +1,44 @@
+"""Host field-layer sanity: moduli, Montgomery constants, roots of unity."""
+
+from zkp2p_tpu.field import bn254 as f
+
+
+def test_moduli_are_prime_ish():
+    # Fermat witnesses (full primality is overkill here; these catch typos)
+    for m in (f.P, f.R):
+        assert pow(2, m - 1, m) == 1
+        assert pow(3, m - 1, m) == 1
+
+
+def test_montgomery_constants():
+    assert (f.P * pow(f.P, -1, f.MONT_R)) % f.MONT_R == 1
+    assert (f.FQ_MONT_R2 - f.MONT_R * f.MONT_R) % f.P == 0
+    # n' satisfies  n * n' == -1 mod 2^256
+    assert (f.P * f.FQ_NPRIME) % f.MONT_R == f.MONT_R - 1
+    assert (f.R * f.FR_NPRIME) % f.MONT_R == f.MONT_R - 1
+
+
+def test_mont_roundtrip():
+    x = 123456789123456789123456789
+    assert f.from_mont(f.to_mont(x)) == x
+
+
+def test_fr_two_adicity():
+    w = f.FR_ROOT_OF_UNITY
+    assert pow(w, 1 << 28, f.R) == 1
+    assert pow(w, 1 << 27, f.R) != 1
+
+
+def test_domain_roots():
+    for k in (1, 4, 10):
+        w = f.fr_domain_root(k)
+        assert pow(w, 1 << k, f.R) == 1
+        assert pow(w, 1 << (k - 1), f.R) != 1
+
+
+def test_circom_bigint_constants():
+    # wire-format parity with the reference app's limb layout
+    # (app/src/helpers/constants.ts:17-18)
+    assert f.CIRCOM_BIGINT_N == 121
+    assert f.CIRCOM_BIGINT_K == 17
+    assert f.CIRCOM_BIGINT_N * f.CIRCOM_BIGINT_K >= 2048

@@ -1,0 +1,87 @@
+"""End-to-end Groth16 on toy circuits (host oracle path).
+
+Mirrors the reference's prove->verify loop (dizkus-scripts/5_gen_proof.sh:
+prove then immediately `snarkjs groth16 verify`)."""
+
+import pytest
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.snark.fft_host import evaluate_poly, intt, ntt
+from zkp2p_tpu.snark.groth16 import prove_host, setup, verify
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+
+def build_toy():
+    """public out; private x, y:  x*y = z,  z*z = out."""
+    cs = ConstraintSystem("toy")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+    cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
+    cs.compute(z, lambda a, b: a * b % R, [x, y])
+    return cs, out, x, y
+
+
+def test_ntt_roundtrip():
+    coeffs = [(i * 7919 + 13) % R for i in range(16)]
+    assert intt(ntt(coeffs)) == coeffs
+
+
+def test_ntt_is_evaluation():
+    from zkp2p_tpu.field.bn254 import fr_domain_root
+
+    coeffs = [3, 1, 4, 1, 5, 9, 2, 6]
+    evals = ntt(coeffs)
+    w = fr_domain_root(3)
+    for j in range(8):
+        assert evals[j] == evaluate_poly(coeffs, pow(w, j, R))
+
+
+def test_groth16_end_to_end():
+    cs, out, x, y = build_toy()
+    w = cs.witness([225], {x: 3, y: 5})
+    cs.check_witness(w)
+    pk, vk = setup(cs)
+    proof = prove_host(pk, cs, w)
+    assert verify(vk, proof, [225])
+    assert not verify(vk, proof, [226])
+
+
+def test_groth16_rejects_bad_witness():
+    cs, out, x, y = build_toy()
+    w = cs.witness([225], {x: 3, y: 5})
+    w[-1] = (w[-1] + 1) % R  # corrupt z
+    with pytest.raises(AssertionError):
+        cs.check_witness(w)
+
+
+def test_proofs_are_randomized():
+    cs, out, x, y = build_toy()
+    w = cs.witness([225], {x: 3, y: 5})
+    pk, vk = setup(cs)
+    p1 = prove_host(pk, cs, w)
+    p2 = prove_host(pk, cs, w)
+    assert p1.a != p2.a  # fresh (r, s) per proof — zero-knowledge blinding
+    assert verify(vk, p1, [225]) and verify(vk, p2, [225])
+
+
+def test_verify_rejects_invalid_points():
+    from zkp2p_tpu.snark.groth16 import Proof
+
+    cs, out, x, y = build_toy()
+    w = cs.witness([225], {x: 3, y: 5})
+    pk, vk = setup(cs)
+    proof = prove_host(pk, cs, w)
+    # off-curve G1 point must be rejected before any pairing math
+    assert not verify(vk, Proof(a=(12345, 67890), b=proof.b, c=proof.c), [225])
+    assert not verify(vk, Proof(a=proof.a, b=proof.b, c=(1, 1)), [225])
+
+
+def test_witness_missing_wire_detected():
+    cs = ConstraintSystem("incomplete")
+    cs.new_public("p")
+    cs.new_wire("unset")
+    with pytest.raises(RuntimeError):
+        cs.witness([1])

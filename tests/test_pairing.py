@@ -1,0 +1,53 @@
+"""Pairing correctness: subgroup orders, bilinearity, product check."""
+
+from zkp2p_tpu.curve.host import (
+    G1_GENERATOR,
+    G2_GENERATOR,
+    g1_is_on_curve,
+    g1_mul,
+    g1_neg,
+    g2_is_on_curve,
+    g2_mul,
+)
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.pairing.pairing import pairing, pairing_product_is_one
+from zkp2p_tpu.field.tower import Fq12
+
+
+def test_generators_on_curve():
+    assert g1_is_on_curve(G1_GENERATOR)
+    assert g2_is_on_curve(G2_GENERATOR)
+
+
+def test_group_order():
+    assert g1_mul(G1_GENERATOR, R) is None
+    assert g2_mul(G2_GENERATOR, R) is None
+
+
+def test_pairing_nondegenerate():
+    e = pairing(G1_GENERATOR, G2_GENERATOR)
+    assert e != Fq12.one()
+    assert e.pow(R) == Fq12.one()
+
+
+def test_bilinearity():
+    a, b = 31337, 271828
+    e = pairing(G1_GENERATOR, G2_GENERATOR)
+    assert pairing(g1_mul(G1_GENERATOR, a), g2_mul(G2_GENERATOR, b)) == e.pow(a * b)
+    assert pairing(g1_mul(G1_GENERATOR, a * b % R), G2_GENERATOR) == e.pow(a * b)
+
+
+def test_pairing_product():
+    a, b = 99991, 10007
+    assert pairing_product_is_one(
+        [
+            (g1_neg(g1_mul(G1_GENERATOR, a * b % R)), G2_GENERATOR),
+            (g1_mul(G1_GENERATOR, a), g2_mul(G2_GENERATOR, b)),
+        ]
+    )
+    assert not pairing_product_is_one(
+        [
+            (g1_mul(G1_GENERATOR, a), G2_GENERATOR),
+            (g1_mul(G1_GENERATOR, b), G2_GENERATOR),
+        ]
+    )

@@ -1,0 +1,143 @@
+"""BN254 (alt_bn128) base/scalar field parameters and host-side arithmetic.
+
+This is the host-side (Python int) mirror of the TPU limb arithmetic in
+``zkp2p_tpu.field.jfield``.  It plays the role the reference delegates to
+rapidsnark's x86 assembly field library and to circom's ``bigint.circom``
+gadgets (reference: ``zk-email-verify-circuits/bigint.circom``,
+``zk-email-verify-circuits/fp.circom:26-85``) — here it is the oracle that
+every vectorised TPU kernel is tested against, and the engine for host-only
+steps (trusted setup, pairing-based verification, zkey parsing).
+"""
+
+from __future__ import annotations
+
+# Base field modulus (Fq) and scalar field modulus (Fr) of BN254.
+# These are the constants baked into contracts/Verifier.sol in the reference
+# (snarkjs-exported Groth16 verifier) — our proofs must live on exactly this
+# curve to stay wire-compatible.
+P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+R = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+
+# Curve: y^2 = x^3 + 3 over Fq;  G2 twist: y^2 = x^3 + 3/(u+9) over Fq2.
+CURVE_B = 3
+
+# Generators.
+G1_GEN = (1, 2)
+G2_GEN = (
+    (
+        10857046999023057135944570762232829481370756359578518086990519993285655852781,
+        11559732032986387107991004021392285783925812861821192530917403151452391805634,
+    ),
+    (
+        8495653923123431417604973247489272438418190587263600148770280649306958101930,
+        4082367875863433681332203403145435568316851327593401208105741076214120093531,
+    ),
+)
+
+# BN parameter u: p(u), r(u) are the standard BN polynomials.
+BN_U = 4965661367192848881
+ATE_LOOP_COUNT = 6 * BN_U + 2  # 29793968203157093288
+
+# Limb layout shared with the TPU side: 16 limbs x 16 bits = 256 bits.
+LIMB_BITS = 16
+NUM_LIMBS = 16
+MONT_BITS = LIMB_BITS * NUM_LIMBS  # 256
+MONT_R = 1 << MONT_BITS
+
+# snarkjs / circom "bigint" layout used at the wire level by the reference app
+# (app/src/helpers/binaryFormat.ts:70-78 packs RSA moduli as 121-bit x 17
+# limbs).  We keep those constants for input-format parity.
+CIRCOM_BIGINT_N = 121
+CIRCOM_BIGINT_K = 17
+
+
+def fq_add(a: int, b: int) -> int:
+    return (a + b) % P
+
+
+def fq_sub(a: int, b: int) -> int:
+    return (a - b) % P
+
+
+def fq_mul(a: int, b: int) -> int:
+    return (a * b) % P
+
+
+def fq_inv(a: int) -> int:
+    if a % P == 0:
+        raise ZeroDivisionError("inverse of zero in Fq")
+    return pow(a, P - 2, P)
+
+
+def fr_add(a: int, b: int) -> int:
+    return (a + b) % R
+
+
+def fr_sub(a: int, b: int) -> int:
+    return (a - b) % R
+
+
+def fr_mul(a: int, b: int) -> int:
+    return (a * b) % R
+
+
+def fr_inv(a: int) -> int:
+    if a % R == 0:
+        raise ZeroDivisionError("inverse of zero in Fr")
+    return pow(a, R - 2, R)
+
+
+def _mont_constants(modulus: int):
+    """Montgomery constants for the 16x16-bit limb layout."""
+    r_mod = MONT_R % modulus
+    r2 = (r_mod * r_mod) % modulus
+    # n' = -modulus^{-1} mod 2^256  (also per-limb: mod 2^16)
+    n_inv = pow(modulus, -1, MONT_R)
+    n_prime = (-n_inv) % MONT_R
+    return r_mod, r2, n_prime
+
+
+FQ_MONT_R, FQ_MONT_R2, FQ_NPRIME = _mont_constants(P)
+FR_MONT_R, FR_MONT_R2, FR_NPRIME = _mont_constants(R)
+
+
+def to_mont(a: int, modulus: int = P) -> int:
+    return (a * MONT_R) % modulus
+
+
+def from_mont(a: int, modulus: int = P) -> int:
+    return (a * pow(MONT_R, -1, modulus)) % modulus
+
+
+def find_fr_2adic_root() -> int:
+    """A primitive 2^28-th root of unity in Fr.
+
+    r - 1 has 2-adicity 28; this bounds our NTT domain at 2^28 points, well
+    above the 2^23 domain the 6.6M-constraint reference circuit needs
+    (reference README.md:79).  Verified at import-time by order checks rather
+    than trusting a hardcoded factorisation.
+    """
+    two_adicity = 28
+    assert (R - 1) % (1 << two_adicity) == 0
+    assert (R - 1) % (1 << (two_adicity + 1)) != 0
+    odd = (R - 1) >> two_adicity
+    for g in range(2, 100):
+        w = pow(g, odd, R)
+        # order of w divides 2^28; it is exactly 2^28 iff w^(2^27) != 1
+        if pow(w, 1 << (two_adicity - 1), R) != 1:
+            return w
+    raise RuntimeError("no 2^28 root of unity found")
+
+
+FR_TWO_ADICITY = 28
+FR_ROOT_OF_UNITY = find_fr_2adic_root()
+
+
+def fr_domain_root(log_size: int) -> int:
+    """Primitive 2^log_size-th root of unity in Fr."""
+    if log_size > FR_TWO_ADICITY:
+        raise ValueError(f"domain 2^{log_size} exceeds Fr 2-adicity {FR_TWO_ADICITY}")
+    w = FR_ROOT_OF_UNITY
+    for _ in range(FR_TWO_ADICITY - log_size):
+        w = (w * w) % R
+    return w

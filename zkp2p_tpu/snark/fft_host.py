@@ -1,0 +1,73 @@
+"""Host-side radix-2 NTT over Fr (Python ints).
+
+Oracle + setup-time twin of the TPU NTT kernel (zkp2p_tpu.ops.ntt).  In the
+reference this work hides inside snarkjs's `groth16 setup` / `groth16 prove`
+(polynomial evaluation for the QAP H polynomial).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..field.bn254 import R, fr_domain_root
+
+
+def bit_reverse_permute(a: List[int]) -> List[int]:
+    n = len(a)
+    logn = n.bit_length() - 1
+    out = list(a)
+    for i in range(n):
+        j = int(bin(i)[2:].zfill(logn)[::-1], 2)
+        if i < j:
+            out[i], out[j] = out[j], out[i]
+    return out
+
+
+def ntt(coeffs: List[int], inverse: bool = False) -> List[int]:
+    """In-order DIT NTT.  coeffs -> evaluations over the 2^k domain
+    (or back, when inverse=True)."""
+    n = len(coeffs)
+    assert n & (n - 1) == 0, "size must be a power of two"
+    logn = n.bit_length() - 1
+    w = fr_domain_root(logn)
+    if inverse:
+        w = pow(w, R - 2, R)
+    a = bit_reverse_permute(coeffs)
+    size = 2
+    while size <= n:
+        wn = pow(w, n // size, R)
+        half = size // 2
+        for start in range(0, n, size):
+            tw = 1
+            for j in range(half):
+                lo = a[start + j]
+                hi = a[start + j + half] * tw % R
+                a[start + j] = (lo + hi) % R
+                a[start + j + half] = (lo - hi) % R
+                tw = tw * wn % R
+        size *= 2
+    if inverse:
+        ninv = pow(n, R - 2, R)
+        a = [x * ninv % R for x in a]
+    return a
+
+
+def intt(evals: List[int]) -> List[int]:
+    return ntt(evals, inverse=True)
+
+
+def coset_shift(coeffs: List[int], g: int) -> List[int]:
+    """coeffs of f(X) -> coeffs of f(gX)."""
+    out = []
+    power = 1
+    for c in coeffs:
+        out.append(c * power % R)
+        power = power * g % R
+    return out
+
+
+def evaluate_poly(coeffs: List[int], x: int) -> int:
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % R
+    return acc

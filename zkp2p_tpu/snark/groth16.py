@@ -1,0 +1,291 @@
+"""Groth16 over BN254: setup, host reference prover, verifier.
+
+Pipeline-parity targets in the reference:
+  - setup   ~ `snarkjs groth16 setup` + contribute/beacon
+              (circuit/scripts/generate_keys_phase2_groth16.sh:11-28,
+               dizkus-scripts/3_gen_both_zkeys.sh) — we generate keys
+              directly from a seed (a "development ceremony"); the key
+              *material* (QAP evaluations at tau) is identical in shape.
+  - prove   ~ `snarkjs groth16 prove` / rapidsnark
+              (dizkus-scripts/5_gen_proof.sh, 6_gen_proof_rapidsnark.sh).
+              The host prover here is the slow reference oracle; the TPU
+              prover (zkp2p_tpu.prover) must emit byte-identical proofs
+              given the same (witness, r, s).
+  - verify  ~ `snarkjs groth16 verify` (5_gen_proof.sh:15-22) and
+              contracts/Verifier.sol:340-380 on-chain — same equation:
+              e(A,B) = e(alpha,beta) e(vk_x,gamma) e(C,delta).
+
+Public-input wires get dedicated binding rows in the QAP (a_row = x_i,
+b_row = 0, c_row = 0) so their A-polynomials are linearly independent —
+standard Groth16 hygiene against public-input malleability.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..curve.host import (
+    G1Point,
+    G2Point,
+    G1_GENERATOR,
+    G2_GENERATOR,
+    g1_add,
+    g1_is_on_curve,
+    g1_mul,
+    g1_msm,
+    g1_neg,
+    g2_add,
+    g2_is_on_curve,
+    g2_msm,
+    g2_mul,
+)
+from ..field.bn254 import R, fr_domain_root, fr_inv
+from ..pairing.pairing import pairing_product_is_one
+from .fft_host import coset_shift, intt, ntt
+from .r1cs import ConstraintSystem
+
+# Multiplicative coset generator for the H-polynomial evaluation domain.
+COSET_G = 5
+
+
+@dataclass
+class ProvingKey:
+    n_public: int
+    domain_size: int
+    alpha_1: G1Point
+    beta_1: G1Point
+    beta_2: G2Point
+    delta_1: G1Point
+    delta_2: G2Point
+    a_query: List[G1Point]  # [A_i(tau)]1 per wire
+    b1_query: List[G1Point]  # [B_i(tau)]1 per wire
+    b2_query: List[G2Point]  # [B_i(tau)]2 per wire
+    c_query: List[Optional[G1Point]]  # [(beta A_i + alpha B_i + C_i)/delta]1, None for public wires
+    h_query: List[G1Point]  # [tau^i Z(tau)/delta]1, i < domain_size - 1
+
+
+@dataclass
+class VerifyingKey:
+    n_public: int
+    alpha_1: G1Point
+    beta_2: G2Point
+    gamma_2: G2Point
+    delta_2: G2Point
+    ic: List[G1Point]  # [(beta A_i + alpha B_i + C_i)/gamma]1 for wires 0..n_public
+
+
+@dataclass
+class Proof:
+    a: G1Point
+    b: G2Point
+    c: G1Point
+
+
+def _seeded_scalars(seed: str, n: int) -> List[int]:
+    out = []
+    counter = 0
+    while len(out) < n:
+        h = hashlib.sha256(f"{seed}:{counter}".encode()).digest()
+        v = int.from_bytes(h + hashlib.sha256(h).digest(), "big") % R
+        counter += 1
+        if v != 0:
+            out.append(v)
+    return out
+
+
+def qap_rows(cs: ConstraintSystem) -> List[Tuple[Dict[int, int], Dict[int, int], Dict[int, int]]]:
+    """R1CS rows + public-input binding rows (wires 0..n_public)."""
+    rows = [(c.a, c.b, c.c) for c in cs.constraints]
+    for i in range(cs.num_public + 1):
+        rows.append(({i: 1}, {}, {}))
+    return rows
+
+
+def domain_size_for(cs: ConstraintSystem) -> int:
+    n = cs.num_constraints + cs.num_public + 1
+    m = 1
+    while m < n:
+        m *= 2
+    return m
+
+
+def setup(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[ProvingKey, VerifyingKey]:
+    """Deterministic development setup (tau, alpha, beta, gamma, delta from
+    seed).  For production, phase-2 ceremony import comes via
+    zkp2p_tpu.formats.zkey_file instead."""
+    tau, alpha, beta, gamma, delta = _seeded_scalars(seed, 5)
+    rows = qap_rows(cs)
+    m = domain_size_for(cs)
+    n_wires = cs.num_wires
+
+    # Lagrange basis at tau over the 2^k domain:
+    #   L_j(tau) = (tau^m - 1) * w^j / (m * (tau - w^j))
+    w = fr_domain_root(m.bit_length() - 1)
+    z_tau = (pow(tau, m, R) - 1) % R
+    minv = fr_inv(m)
+    lag = []
+    wj = 1
+    for _ in range(m):
+        lag.append(z_tau * wj % R * minv % R * fr_inv((tau - wj) % R) % R)
+        wj = wj * w % R
+
+    a_tau = [0] * n_wires
+    b_tau = [0] * n_wires
+    c_tau = [0] * n_wires
+    for j, (ra, rb, rc) in enumerate(rows):
+        lj = lag[j]
+        for wi, coeff in ra.items():
+            a_tau[wi] = (a_tau[wi] + coeff * lj) % R
+        for wi, coeff in rb.items():
+            b_tau[wi] = (b_tau[wi] + coeff * lj) % R
+        for wi, coeff in rc.items():
+            c_tau[wi] = (c_tau[wi] + coeff * lj) % R
+
+    g1, g2 = G1_GENERATOR, G2_GENERATOR
+    delta_inv = fr_inv(delta)
+    gamma_inv = fr_inv(gamma)
+
+    a_query = [g1_mul(g1, v) for v in a_tau]
+    b1_query = [g1_mul(g1, v) for v in b_tau]
+    b2_query = [g2_mul(g2, v) for v in b_tau]
+
+    c_query: List[Optional[G1Point]] = []
+    ic: List[G1Point] = []
+    for i in range(n_wires):
+        val = (beta * a_tau[i] + alpha * b_tau[i] + c_tau[i]) % R
+        if i <= cs.num_public:
+            ic.append(g1_mul(g1, val * gamma_inv % R))
+            c_query.append(None)
+        else:
+            c_query.append(g1_mul(g1, val * delta_inv % R))
+
+    h_query = []
+    z_delta = z_tau * delta_inv % R
+    tpow = 1
+    for _ in range(m - 1):
+        h_query.append(g1_mul(g1, tpow * z_delta % R))
+        tpow = tpow * tau % R
+
+    pk = ProvingKey(
+        n_public=cs.num_public,
+        domain_size=m,
+        alpha_1=g1_mul(g1, alpha),
+        beta_1=g1_mul(g1, beta),
+        beta_2=g2_mul(g2, beta),
+        delta_1=g1_mul(g1, delta),
+        delta_2=g2_mul(g2, delta),
+        a_query=a_query,
+        b1_query=b1_query,
+        b2_query=b2_query,
+        c_query=c_query,
+        h_query=h_query,
+    )
+    vk = VerifyingKey(
+        n_public=cs.num_public,
+        alpha_1=pk.alpha_1,
+        beta_2=pk.beta_2,
+        gamma_2=g2_mul(g2, gamma),
+        delta_2=pk.delta_2,
+        ic=ic,
+    )
+    return pk, vk
+
+
+def compute_h_coeffs(cs: ConstraintSystem, witness: Sequence[int]) -> List[int]:
+    """Coefficients of h(X) = (A(X)B(X) - C(X)) / Z(X), degree <= m-2.
+
+    Lagrange-basis row dot-products -> iNTT -> coset NTT -> pointwise
+    (a*b - c) * Z^{-1} -> coset iNTT.  On the coset g*H, Z(g w^j) = g^m - 1
+    is a constant, so the division is a single scalar multiply.
+    This exact dataflow is what zkp2p_tpu.prover runs as batched TPU NTTs.
+    """
+    rows = qap_rows(cs)
+    m = domain_size_for(cs)
+    a_ev = [0] * m
+    b_ev = [0] * m
+    c_ev = [0] * m
+    for j, (ra, rb, rc) in enumerate(rows):
+        a_ev[j] = sum(coeff * witness[wi] for wi, coeff in ra.items()) % R
+        b_ev[j] = sum(coeff * witness[wi] for wi, coeff in rb.items()) % R
+        c_ev[j] = sum(coeff * witness[wi] for wi, coeff in rc.items()) % R
+    a_c = intt(a_ev)
+    b_c = intt(b_ev)
+    c_c = intt(c_ev)
+    g = COSET_G
+    a_cos = ntt(coset_shift(a_c, g))
+    b_cos = ntt(coset_shift(b_c, g))
+    c_cos = ntt(coset_shift(c_c, g))
+    z_on_coset = (pow(g, m, R) - 1) % R
+    z_inv = fr_inv(z_on_coset)
+    h_cos = [(a * b - c) * z_inv % R for a, b, c in zip(a_cos, b_cos, c_cos)]
+    h_shifted = intt(h_cos)
+    h = coset_shift(h_shifted, fr_inv(g))
+    assert h[m - 1] == 0, "h degree too high (witness unsatisfied?)"
+    return h[: m - 1]
+
+
+def prove_host(
+    pk: ProvingKey,
+    cs: ConstraintSystem,
+    witness: Sequence[int],
+    r: Optional[int] = None,
+    s: Optional[int] = None,
+) -> Proof:
+    """Reference prover (host ints).  Deliberately structured exactly like
+    the TPU prover so the two can be diffed step by step."""
+    if r is None:
+        r = 1 + secrets.randbelow(R - 1)
+    if s is None:
+        s = 1 + secrets.randbelow(R - 1)
+    h = compute_h_coeffs(cs, witness)
+
+    a_acc = g1_msm(pk.a_query, witness)
+    pi_a = g1_add(g1_add(pk.alpha_1, a_acc), g1_mul(pk.delta_1, r))
+
+    b2_acc = g2_msm(pk.b2_query, witness)
+    pi_b = g2_add(g2_add(pk.beta_2, b2_acc), g2_mul(pk.delta_2, s))
+
+    b1_acc = g1_msm(pk.b1_query, witness)
+    pi_b1 = g1_add(g1_add(pk.beta_1, b1_acc), g1_mul(pk.delta_1, s))
+
+    priv = [(pt, wv) for pt, wv in zip(pk.c_query, witness) if pt is not None]
+    c_acc = g1_msm([p for p, _ in priv], [v for _, v in priv])
+    h_acc = g1_msm(pk.h_query, h)
+    pi_c = g1_add(c_acc, h_acc)
+    pi_c = g1_add(pi_c, g1_mul(pi_a, s))
+    pi_c = g1_add(pi_c, g1_mul(pi_b1, r))
+    pi_c = g1_add(pi_c, g1_neg(g1_mul(pk.delta_1, r * s % R)))
+
+    return Proof(a=pi_a, b=pi_b, c=pi_c)
+
+
+def verify(vk: VerifyingKey, proof: Proof, public_inputs: Sequence[int]) -> bool:
+    """e(A,B) == e(alpha,beta) * e(vk_x,gamma) * e(C,delta) — the equation
+    contracts/Verifier.sol:340-359 checks via pairingProd4."""
+    if len(public_inputs) != vk.n_public:
+        return False
+    # Point validation before any pairing work — mirrors what the EVM
+    # ecPairing precompile enforces (off-curve or non-subgroup points make
+    # the whole call revert).  G1 has cofactor 1, so on-curve == in-subgroup;
+    # G2's twist has a large cofactor, so proof.b also needs an order check
+    # (the small-subgroup forgery gap).
+    if not (g1_is_on_curve(proof.a) and g1_is_on_curve(proof.c)):
+        return False
+    if not g2_is_on_curve(proof.b):
+        return False
+    if proof.b is not None and g2_mul(proof.b, R) is not None:
+        return False
+    vk_x = vk.ic[0]
+    for i, x in enumerate(public_inputs):
+        vk_x = g1_add(vk_x, g1_mul(vk.ic[i + 1], x % R))
+    return pairing_product_is_one(
+        [
+            (g1_neg(proof.a), proof.b),
+            (vk.alpha_1, vk.beta_2),
+            (vk_x, vk.gamma_2),
+            (proof.c, vk.delta_2),
+        ]
+    )

@@ -1,0 +1,245 @@
+"""R1CS constraint-system builder — the framework's circuit frontend.
+
+This replaces the circom language layer of the reference (circuit/*.circom,
+zk-email-verify-circuits/*.circom).  Where the reference writes
+
+    template P2POnrampVerify(...) { signal input ...; component ... }
+
+our circuits are built programmatically: gadgets (zkp2p_tpu.gadgets) allocate
+wires, emit rank-1 constraints  <A,w> * <B,w> = <C,w>, and register witness
+computation hooks.  Witness generation therefore lives *with* the circuit
+definition (as circom's generated WASM/C++ witness calculators do for the
+reference, dizkus-scripts/2_gen_wtns.sh), but the hot per-byte blocks also
+get vectorised JAX witness programs (zkp2p_tpu.gadgets.*.jax_witness).
+
+Wire layout follows the Groth16/snarkjs convention: wire 0 is the constant
+``1``, wires 1..n_pub are public, the rest private.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from ..field.bn254 import R
+
+
+Coeffs = Dict[int, int]  # wire index -> Fr coefficient
+
+
+class LC:
+    """Linear combination of wires over Fr."""
+
+    __slots__ = ("terms",)
+
+    def __init__(self, terms: Optional[Coeffs] = None):
+        self.terms: Coeffs = dict(terms) if terms else {}
+
+    @classmethod
+    def const(cls, c: int) -> "LC":
+        c %= R
+        return cls({0: c} if c else {})
+
+    @classmethod
+    def of(cls, wire: int, coeff: int = 1) -> "LC":
+        coeff %= R
+        return cls({wire: coeff} if coeff else {})
+
+    def __add__(self, other: "LCLike") -> "LC":
+        other = as_lc(other)
+        out = dict(self.terms)
+        for w, c in other.terms.items():
+            nc = (out.get(w, 0) + c) % R
+            if nc:
+                out[w] = nc
+            else:
+                out.pop(w, None)
+        return LC(out)
+
+    def __sub__(self, other: "LCLike") -> "LC":
+        return self + (as_lc(other) * (R - 1))
+
+    def __mul__(self, scalar: int) -> "LC":
+        scalar %= R
+        if scalar == 0:
+            return LC()
+        return LC({w: (c * scalar) % R for w, c in self.terms.items()})
+
+    __rmul__ = __mul__
+
+    def __neg__(self) -> "LC":
+        return self * (R - 1)
+
+    def eval(self, assignment: Sequence[int]) -> int:
+        return sum(c * assignment[w] for w, c in self.terms.items()) % R
+
+    def is_const(self) -> bool:
+        return all(w == 0 for w in self.terms)
+
+    def __repr__(self):
+        return f"LC({self.terms})"
+
+
+LCLike = Union["LC", int]
+
+
+def as_lc(x: LCLike) -> LC:
+    if isinstance(x, LC):
+        return x
+    return LC.const(x)
+
+
+@dataclass
+class Constraint:
+    a: Coeffs
+    b: Coeffs
+    c: Coeffs
+    tag: str = ""
+
+
+@dataclass
+class ComputeHook:
+    """Witness computation step: outs <- fn(*wire values of ins)."""
+
+    outs: List[int]
+    fn: Callable[..., Union[int, Sequence[int]]]
+    ins: List[int]
+
+
+class ConstraintSystem:
+    """Mutable R1CS under construction + witness program."""
+
+    def __init__(self, name: str = "circuit"):
+        self.name = name
+        self.num_wires = 1  # wire 0 == 1
+        self.num_public = 0  # not counting wire 0
+        self.constraints: List[Constraint] = []
+        self.hooks: List[ComputeHook] = []
+        self._public_frozen = False
+        self.labels: Dict[int, str] = {0: "one"}
+
+    # ---------------------------------------------------------- allocation
+
+    def new_public(self, label: str = "") -> int:
+        if self._public_frozen:
+            raise RuntimeError("public inputs must be allocated before private wires")
+        idx = self.num_wires
+        self.num_wires += 1
+        self.num_public += 1
+        if label:
+            self.labels[idx] = label
+        return idx
+
+    def new_wire(self, label: str = "") -> int:
+        self._public_frozen = True
+        idx = self.num_wires
+        self.num_wires += 1
+        if label:
+            self.labels[idx] = label
+        return idx
+
+    def new_wires(self, n: int, label: str = "") -> List[int]:
+        return [self.new_wire(f"{label}[{i}]" if label else "") for i in range(n)]
+
+    # ---------------------------------------------------------- constraints
+
+    def enforce(self, a: LCLike, b: LCLike, c: LCLike, tag: str = "") -> None:
+        """<a,w> * <b,w> = <c,w>."""
+        self.constraints.append(
+            Constraint(as_lc(a).terms, as_lc(b).terms, as_lc(c).terms, tag)
+        )
+
+    def enforce_eq(self, a: LCLike, b: LCLike, tag: str = "") -> None:
+        """<a,w> = <b,w>  encoded as  (a-b) * 1 = 0."""
+        self.enforce(as_lc(a) - as_lc(b), LC.const(1), LC(), tag)
+
+    def enforce_zero(self, a: LCLike, tag: str = "") -> None:
+        self.enforce(as_lc(a), LC.const(1), LC(), tag)
+
+    def enforce_bool(self, w: int, tag: str = "") -> None:
+        """w * (w - 1) = 0."""
+        self.enforce(LC.of(w), LC.of(w) - 1, LC(), tag or "bool")
+
+    # ---------------------------------------------------------- witness gen
+
+    def compute(self, outs, fn, ins) -> None:
+        """Register a witness hook.  fn receives int values of `ins` and
+        returns the value(s) for `outs` (single int or sequence)."""
+        outs = [outs] if isinstance(outs, int) else list(outs)
+        ins = [ins] if isinstance(ins, int) else list(ins)
+        self.hooks.append(ComputeHook(outs, fn, ins))
+
+    def witness(self, public_inputs: Sequence[int], private_inputs: Dict[int, int] | None = None) -> List[int]:
+        """Run the witness program.  `public_inputs` fills wires 1..n_pub;
+        `private_inputs` optionally pre-seeds private wires (for inputs that
+        are not computed from anything, e.g. the email bytes)."""
+        if len(public_inputs) != self.num_public:
+            raise ValueError(
+                f"expected {self.num_public} public inputs, got {len(public_inputs)}"
+            )
+        w: List[Optional[int]] = [None] * self.num_wires
+        w[0] = 1
+        for i, v in enumerate(public_inputs):
+            w[1 + i] = v % R
+        if private_inputs:
+            for idx, v in private_inputs.items():
+                w[idx] = v % R
+        for hook in self.hooks:
+            args = []
+            for i in hook.ins:
+                if w[i] is None:
+                    raise RuntimeError(
+                        f"witness hook reads unassigned wire {i} ({self.labels.get(i)})"
+                    )
+                args.append(w[i])
+            vals = hook.fn(*args)
+            if isinstance(vals, int):
+                vals = [vals]
+            if len(vals) != len(hook.outs):
+                raise RuntimeError(
+                    f"hook produced {len(vals)} values for {len(hook.outs)} outs"
+                )
+            for o, v in zip(hook.outs, vals):
+                w[o] = v % R
+        missing = [i for i, v in enumerate(w) if v is None]
+        if missing:
+            raise RuntimeError(
+                f"{len(missing)} unassigned wires, first: "
+                f"{[(i, self.labels.get(i)) for i in missing[:5]]}"
+            )
+        return w  # type: ignore[return-value]
+
+    # ---------------------------------------------------------- checking
+
+    def check_witness(self, w: Sequence[int]) -> None:
+        """Assert every constraint is satisfied (the Az*Bz=Cz self-check —
+        the ZK analog of the reference's `circom --inspect` lint, see
+        SURVEY.md §5 race-detection)."""
+        for idx, con in enumerate(self.constraints):
+            a = sum(c * w[i] for i, c in con.a.items()) % R
+            b = sum(c * w[i] for i, c in con.b.items()) % R
+            c_ = sum(c * w[i] for i, c in con.c.items()) % R
+            if a * b % R != c_:
+                raise AssertionError(
+                    f"constraint {idx} ({con.tag}) unsatisfied: {a}*{b} != {c_}"
+                )
+
+    # ---------------------------------------------------------- stats
+
+    @property
+    def num_constraints(self) -> int:
+        return len(self.constraints)
+
+    def stats(self) -> Dict[str, int]:
+        """Constraint-count profile — mirror of `snarkjs r1cs info`
+        (circuit/scripts/circuit_stats.sh:2)."""
+        by_tag: Dict[str, int] = {}
+        for c in self.constraints:
+            key = c.tag.split("/")[0] if c.tag else "untagged"
+            by_tag[key] = by_tag.get(key, 0) + 1
+        return {
+            "wires": self.num_wires,
+            "public": self.num_public,
+            "constraints": self.num_constraints,
+            **{f"tag:{k}": v for k, v in sorted(by_tag.items())},
+        }
