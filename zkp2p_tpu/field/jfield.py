@@ -1,0 +1,300 @@
+"""Vectorised BN254 field arithmetic on TPU lanes (JAX).
+
+This module is the TPU mirror of rapidsnark's x86-assembly field library and
+of the circom bigint gadgets the reference leans on
+(``zk-email-verify-circuits/bigint.circom``, ``fp.circom:26-85``).  TPUs have
+no native 64x64 multiply, so field elements are **16 limbs x 16 bits in
+uint32 lanes**: a 16x16-bit product fits a uint32 exactly, and its lo/hi
+16-bit halves are accumulated in separate uint32 planes (each partial < 2^16,
+so thousands can be summed before carry propagation).  All ops are shape-
+polymorphic over leading batch dims and therefore `vmap`/`shard_map`-friendly;
+multiplication is Montgomery (SOS: full schoolbook product, then one
+Montgomery reduction), so a field mul is three 16-limb convolutions — pure
+elementwise uint32 mul/add/shift that XLA vectorises onto the VPU.
+
+Layout contract (shared with the host oracle ``zkp2p_tpu.field.bn254``):
+  value = sum(limb[i] << (16*i)),  limb[i] < 2^16,  canonical (< modulus).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bn254 import MONT_R, P, R
+
+LIMB_BITS = 16
+NUM_LIMBS = 16
+MASK = (1 << LIMB_BITS) - 1
+
+
+def int_to_limbs(x: int, n: int = NUM_LIMBS) -> np.ndarray:
+    """Host int -> uint32 limb vector (little-endian 16-bit limbs)."""
+    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(n)], dtype=np.uint32)
+
+
+def limbs_to_int(a) -> int:
+    a = np.asarray(a, dtype=np.uint64)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(a))
+
+
+def _carry_canon(x: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Propagate carries: arbitrary uint32 limbs -> canonical 16-bit limbs.
+
+    Static unrolled ripple (out_limbs steps); each step is elementwise over
+    the batch dims, so the whole chain stays on the VPU.
+    """
+    in_limbs = x.shape[-1]
+    carry = jnp.zeros_like(x[..., 0])
+    out = []
+    for i in range(out_limbs):
+        t = carry if i >= in_limbs else x[..., i] + carry
+        out.append(t & MASK)
+        carry = t >> LIMB_BITS
+    return jnp.stack(out, axis=-1)
+
+
+def _mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full product of two 16-limb values -> 32 canonical limbs.
+
+    Schoolbook convolution with lo/hi-plane accumulation: every partial
+    product a_i*b_j < 2^32 is split into two 16-bit halves which are
+    scatter-added (static offsets) into a 33-limb uint32 accumulator; the
+    accumulator maxes out near 32*2^16 < 2^22, far below uint32 overflow.
+    """
+    prods = a[..., :, None] * b[..., None, :]  # (..., 16, 16) uint32
+    lo = prods & MASK
+    hi = prods >> LIMB_BITS
+    n = a.shape[-1]
+    m = b.shape[-1]
+    acc = jnp.zeros(a.shape[:-1] + (n + m + 1,), dtype=jnp.uint32)
+    for i in range(n):
+        acc = acc.at[..., i : i + m].add(lo[..., i, :])
+        acc = acc.at[..., i + 1 : i + m + 1].add(hi[..., i, :])
+    return _carry_canon(acc, n + m)
+
+
+class JPrimeField:
+    """A prime field instance with device-resident Montgomery constants.
+
+    Two global instances exist: ``FQ`` (base field, curve coordinates) and
+    ``FR`` (scalar field, witnesses / NTT).  Elements are uint32 arrays of
+    shape (..., 16) in Montgomery form unless a function says otherwise.
+    """
+
+    def __init__(self, modulus: int, name: str):
+        from .bn254 import _mont_constants
+
+        self.modulus = modulus
+        self.name = name
+        self.mont_r, self.mont_r2, self.nprime_int = _mont_constants(modulus)
+        self.n_limbs = jnp.asarray(int_to_limbs(modulus))
+        self.nprime_limbs = jnp.asarray(int_to_limbs(self.nprime_int))
+        self.r2_limbs = jnp.asarray(int_to_limbs(self.mont_r2))
+        self.one_mont = jnp.asarray(int_to_limbs(self.mont_r))
+        self.zero_limbs = jnp.zeros(NUM_LIMBS, dtype=jnp.uint32)
+
+    # ------------------------------------------------------------ host I/O
+
+    def to_mont_host(self, x: int) -> np.ndarray:
+        return int_to_limbs((x * MONT_R) % self.modulus)
+
+    def from_mont_host(self, limbs) -> int:
+        return (limbs_to_int(limbs) * pow(MONT_R, -1, self.modulus)) % self.modulus
+
+    def to_std_host(self, x: int) -> np.ndarray:
+        return int_to_limbs(x % self.modulus)
+
+    def array_to_mont_host(self, xs) -> np.ndarray:
+        return np.stack([self.to_mont_host(int(x)) for x in xs])
+
+    # --------------------------------------------------------- basic arith
+
+    def _cond_sub_n(self, a: jnp.ndarray) -> jnp.ndarray:
+        """a (< 2*modulus, canonical limbs) -> a mod modulus."""
+        d, borrow = self._sub_raw(a, self.n_limbs)
+        return jnp.where(borrow[..., None] != 0, a, d)
+
+    @staticmethod
+    def _sub_raw(a: jnp.ndarray, b: jnp.ndarray):
+        """(a - b) mod 2^256 with final borrow flag (1 if a < b)."""
+        ai = a.astype(jnp.int32)
+        bi = jnp.broadcast_to(b, a.shape).astype(jnp.int32)
+        borrow = jnp.zeros_like(ai[..., 0])
+        out = []
+        for i in range(a.shape[-1]):
+            t = ai[..., i] - bi[..., i] - borrow
+            out.append((t & MASK).astype(jnp.uint32))
+            borrow = (t < 0).astype(jnp.int32)
+        return jnp.stack(out, axis=-1), borrow
+
+    def add(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return self._cond_sub_n(_carry_canon(a + b, NUM_LIMBS))
+
+    def sub(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        d, borrow = self._sub_raw(a, b)
+        dn = _carry_canon(d + self.n_limbs, NUM_LIMBS)
+        return jnp.where(borrow[..., None] != 0, dn, d)
+
+    def neg(self, a: jnp.ndarray) -> jnp.ndarray:
+        d, _ = self._sub_raw(jnp.broadcast_to(self.n_limbs, a.shape), a)
+        # -0 must stay 0, not N
+        is_zero = self.is_zero(a)
+        return jnp.where(is_zero[..., None], a, self._cond_sub_n(d))
+
+    def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        """Montgomery product: (a*b*R^-1) mod N, R = 2^256 (SOS method)."""
+        t = _mul_wide(a, b)  # (..., 32)
+        m = _mul_wide(t[..., :NUM_LIMBS], self.nprime_limbs)[..., :NUM_LIMBS]
+        u = _mul_wide(m, self.n_limbs)  # (..., 32)
+        # t + u is divisible by 2^256; sum then shift right 16 limbs.
+        s = _carry_canon(t.astype(jnp.uint32) + u, 2 * NUM_LIMBS + 1)
+        return self._cond_sub_n(s[..., NUM_LIMBS : 2 * NUM_LIMBS + 1][..., :NUM_LIMBS])
+
+    def square(self, a: jnp.ndarray) -> jnp.ndarray:
+        return self.mul(a, a)
+
+    def to_mont(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Standard-form limbs -> Montgomery form (on device)."""
+        return self.mul(a, self.r2_limbs)
+
+    def from_mont(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Montgomery form -> standard-form limbs (mont-mul by 1)."""
+        one = jnp.zeros_like(a).at[..., 0].set(1)
+        return self.mul(a, one)
+
+    # ----------------------------------------------------------- predicates
+
+    @staticmethod
+    def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return jnp.all(a == b, axis=-1)
+
+    @staticmethod
+    def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+        return jnp.all(a == 0, axis=-1)
+
+    @staticmethod
+    def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        """cond ? a : b, with cond shaped (...,) against (..., 16) operands."""
+        return jnp.where(cond[..., None], a, b)
+
+    # ------------------------------------------------------------ inversion
+
+    def pow_const(self, a: jnp.ndarray, e: int) -> jnp.ndarray:
+        """a^e for a compile-time exponent.
+
+        lax.scan over the exponent's bits (LSB first) keeps the traced graph
+        at one square+select per step regardless of exponent size — the
+        unrolled ladder was a 60k-op HLO graph for a 254-bit exponent.
+        """
+        if e == 0:
+            return jnp.broadcast_to(self.one_mont, a.shape)
+        bits = jnp.asarray([(e >> i) & 1 for i in range(e.bit_length())], dtype=jnp.uint32)
+
+        def step(carry, bit):
+            acc, base = carry
+            acc = self.select(bit != 0, self.mul(acc, base), acc)
+            base = self.square(base)
+            return (acc, base), None
+
+        acc0 = jnp.broadcast_to(self.one_mont, a.shape)
+        (acc, _), _ = jax.lax.scan(step, (acc0, a), bits)
+        return acc
+
+    def inv(self, a: jnp.ndarray) -> jnp.ndarray:
+        """Fermat inverse a^(N-2); 0 maps to 0 (callers select around it)."""
+        return self.pow_const(a, self.modulus - 2)
+
+
+FQ = JPrimeField(P, "fq")
+FR = JPrimeField(R, "fr")
+
+
+# --------------------------------------------------------------------- Fq2
+#
+# Fq2 = Fq[u]/(u^2 + 1): elements are pairs of Fq limb arrays, stacked on a
+# new axis -2: shape (..., 2, 16).  Mirrors zkp2p_tpu.field.tower.Fq2 (host).
+
+
+class JFq2Ops:
+    """Fq2 arithmetic over stacked limb pairs (..., 2, 16)."""
+
+    def __init__(self, fq: JPrimeField = FQ):
+        self.fq = fq
+        self.one_mont = jnp.stack([fq.one_mont, fq.zero_limbs])
+        self.zero_limbs = jnp.zeros((2, NUM_LIMBS), dtype=jnp.uint32)
+
+    def add(self, a, b):
+        return self.fq.add(a, b)
+
+    def sub(self, a, b):
+        return self.fq.sub(a, b)
+
+    def neg(self, a):
+        return self.fq.neg(a)
+
+    def mul(self, a, b):
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        b0, b1 = b[..., 0, :], b[..., 1, :]
+        v0 = self.fq.mul(a0, b0)
+        v1 = self.fq.mul(a1, b1)
+        c0 = self.fq.sub(v0, v1)  # u^2 = -1
+        c1 = self.fq.sub(
+            self.fq.mul(self.fq.add(a0, a1), self.fq.add(b0, b1)),
+            self.fq.add(v0, v1),
+        )
+        return jnp.stack([c0, c1], axis=-2)
+
+    def square(self, a):
+        return self.mul(a, a)
+
+    def eq(self, a, b):
+        return jnp.all(a == b, axis=(-1, -2))
+
+    def is_zero(self, a):
+        return jnp.all(a == 0, axis=(-1, -2))
+
+    @staticmethod
+    def select(cond, a, b):
+        return jnp.where(cond[..., None, None], a, b)
+
+
+FQ2 = JFq2Ops(FQ)
+
+
+# ------------------------------------------------------- batched reductions
+
+
+def reduce_wide(field: JPrimeField, wide: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a canonical-limb value of up to 31 limbs to x mod N.
+
+    Montgomery round-trip: one Montgomery reduction computes x*2^-256 mod N
+    (exact because x < 2^496 << 2^256 * N), then a mont-mul by the
+    precomputed 2^512 mod N restores the 2^256 factor.  Three convolutions,
+    no data-dependent control flow.
+    """
+    L = wide.shape[-1]
+    assert L <= 31, "reduce_wide supports < 2^496 inputs"
+    x = jnp.zeros(wide.shape[:-1] + (2 * NUM_LIMBS,), dtype=jnp.uint32)
+    x = x.at[..., :L].set(wide)
+    m = _mul_wide(x[..., :NUM_LIMBS], field.nprime_limbs)[..., :NUM_LIMBS]
+    u = _mul_wide(m, field.n_limbs)  # 32 limbs
+    s = _carry_canon(x + u, 2 * NUM_LIMBS + 1)
+    t = field._cond_sub_n(s[..., NUM_LIMBS : 2 * NUM_LIMBS])
+    # r2_limbs == 2^512 mod N, exactly the factor that undoes the 2^-256.
+    return field.mul(t, field.r2_limbs)
+
+
+def lazy_segment_sum_mod(
+    field: JPrimeField, values: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int
+) -> jnp.ndarray:
+    """Modular segment-sum: sum canonical limb values per segment, then reduce.
+
+    Limbs are < 2^16, so uint32 per-limb accumulation is exact for up to ~2^16
+    terms per segment — far above the row fan-in of any of our constraint
+    systems.  This is the sparse-matvec primitive behind Az/Bz/Cz.
+    """
+    acc = jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
+    wide = _carry_canon(acc, NUM_LIMBS + 2)
+    return reduce_wide(field, wide)

@@ -112,12 +112,12 @@ XI = Fq2(9, 1)
 #   FROB_C2[i] = xi^((2 p^i - 2) / 3)
 #   FROB_W[i]  = xi^((p^i - 1) / 6)   acting on Fq12 w-coefficient
 def _frob_coeffs():
+    # Only the p^1 coefficients are needed: frobenius(power) iterates the
+    # p^1 map, so higher-power tables would be dead weight at import time.
     c1, c2, cw = [Fq2.one()], [Fq2.one()], [Fq2.one()]
-    for i in range(1, 4):
-        pi = P**i
-        c1.append(XI.pow((pi - 1) // 3))
-        c2.append(XI.pow((2 * pi - 2) // 3))
-        cw.append(XI.pow((pi - 1) // 6))
+    c1.append(XI.pow((P - 1) // 3))
+    c2.append(XI.pow((2 * P - 2) // 3))
+    cw.append(XI.pow((P - 1) // 6))
     return c1, c2, cw
 
 
