@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Benchmark: batched Groth16 proving throughput on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): rapidsnark proves the 6,618,823-constraint Venmo
+circuit in 9.2 s on a 48-core z1d.12xlarge -> 0.1087 proofs/s.  This bench
+proves a SHA-256 circuit slice on one TPU chip with the vmapped prover and
+normalises throughput by constraint count (MSM/NTT work scales ~linearly
+in wires), so vs_baseline = (our proofs/s * our_constraints / 6,618,823)
+/ 0.1087.  Artifacts (circuit + keys) are cached under .bench_cache/ so
+re-runs skip host setup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sys
+import time
+
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+BASELINE_CONSTRAINTS = 6_618_823
+BASELINE_PROOFS_PER_SEC = 1.0 / 9.2
+BATCH = int(os.environ.get("BENCH_BATCH", "4"))
+MSG_BLOCKS = int(os.environ.get("BENCH_SHA_BLOCKS", "1"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _build_circuit():
+    from zkp2p_tpu.gadgets import core, sha256
+    from zkp2p_tpu.snark.r1cs import ConstraintSystem
+
+    cs = ConstraintSystem("bench_sha")
+    max_len = 64 * MSG_BLOCKS
+    msg = cs.new_wires(max_len, "msg")
+    bits = core.assert_bytes(cs, msg)
+    sha256.sha256_blocks(cs, bits, None)
+    return cs, msg
+
+
+def build_or_load():
+    """Circuit is rebuilt each run (deterministic, seconds); only the keys
+    are cached — witness hooks hold lambdas and do not pickle."""
+    os.makedirs(CACHE, exist_ok=True)
+    path = os.path.join(CACHE, f"sha{MSG_BLOCKS}.keys.pkl")
+    log(f"building SHA-256 bench circuit ({MSG_BLOCKS} block[s]) ...")
+    cs, msg = _build_circuit()
+    log(f"constraints={cs.num_constraints} wires={cs.num_wires}")
+    if os.path.exists(path):
+        log("loading cached keys")
+        with open(path, "rb") as f:
+            pk, vk = pickle.load(f)
+    else:
+        from zkp2p_tpu.snark.groth16 import setup
+
+        log("running setup (host; cached for future runs) ...")
+        t0 = time.time()
+        pk, vk = setup(cs, seed="bench")
+        log(f"setup took {time.time() - t0:.0f}s")
+        with open(path, "wb") as f:
+            pickle.dump((pk, vk), f)
+    return cs, pk, vk, msg
+
+
+def main():
+    import jax
+
+    from zkp2p_tpu.utils.jaxcfg import enable_cache
+
+    enable_cache()
+    devs = jax.devices()
+    log("devices:", devs)
+
+    from zkp2p_tpu.inputs.sha_host import sha256_pad
+    from zkp2p_tpu.prover.groth16_tpu import device_pk, prove_tpu_batch
+    from zkp2p_tpu.snark.groth16 import verify
+
+    cs, pk, vk, msg_wires = build_or_load()
+    dpk = device_pk(pk, cs)
+
+    if os.environ.get("BENCH_DRY"):
+        log("BENCH_DRY set: artifacts built, skipping device proving")
+        print(json.dumps({"metric": "bench_dry", "value": cs.num_constraints, "unit": "constraints", "vs_baseline": 0}))
+        return
+
+    witnesses = []
+    pubs = []
+    for i in range(BATCH):
+        data = bytes([i + 1] * 30)
+        padded, _ = sha256_pad(data, 64 * MSG_BLOCKS)
+        w = cs.witness([], {wi: b for wi, b in zip(msg_wires, padded)})
+        witnesses.append(w)
+
+    log("warmup (compile) ...")
+    t0 = time.time()
+    proofs = prove_tpu_batch(dpk, witnesses)
+    log(f"first batch (incl compile): {time.time() - t0:.1f}s")
+
+    assert verify(vk, proofs[0], []), "proof failed verification"
+
+    log("timed runs ...")
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        prove_tpu_batch(dpk, witnesses)
+        times.append(time.time() - t0)
+    best = min(times)
+    proofs_per_sec = BATCH / best
+    vs = (proofs_per_sec * cs.num_constraints / BASELINE_CONSTRAINTS) / BASELINE_PROOFS_PER_SEC
+    log(f"batch={BATCH} best={best:.2f}s -> {proofs_per_sec:.3f} proofs/s on {cs.num_constraints} constraints")
+    print(
+        json.dumps(
+            {
+                "metric": "groth16_proofs_per_sec_constraint_normalized",
+                "value": round(proofs_per_sec, 4),
+                "unit": f"proofs/s @ {cs.num_constraints} constraints (batch={BATCH}, 1 chip)",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

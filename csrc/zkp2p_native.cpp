@@ -1,0 +1,349 @@
+// Native BN254 host library: Montgomery field arithmetic + G1/G2 fixed-base.
+//
+// The runtime role rapidsnark's x86-asm field library plays in the
+// reference (SURVEY.md §2.2): the host-side hot loops — trusted-setup
+// query-point generation, witness-side bignum math — run here instead of
+// Python bigints (~400x).  The TPU compute path stays JAX/XLA; this is
+// the CPU runtime around it.  Exposed as extern "C" for ctypes
+// (zkp2p_tpu.native.lib); every entry point is batch-oriented.
+//
+// Field elements: 4 x 64-bit little-endian limbs, Montgomery form with
+// R = 2^256.  unsigned __int128 provides the 64x64->128 multiply.
+
+#include <cstdint>
+#include <cstring>
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+
+// BN254 base field p and scalar field r moduli (little-endian limbs).
+static const u64 P[4] = {0x3c208c16d87cfd47ULL, 0x97816a916871ca8dULL,
+                         0xb85045b68181585dULL, 0x30644e72e131a029ULL};
+static const u64 PINV = 0x87d20782e4866389ULL;  // -p^-1 mod 2^64
+// R^2 mod p
+static const u64 R2P[4] = {0xf32cfc5b538afa89ULL, 0xb5e71911d44501fbULL,
+                           0x47ab1eff0a417ff6ULL, 0x06d89f71cab8351fULL};
+
+struct Fp {
+  u64 v[4];
+};
+
+static inline bool geq(const u64 a[4], const u64 b[4]) {
+  for (int i = 3; i >= 0; --i) {
+    if (a[i] != b[i]) return a[i] > b[i];
+  }
+  return true;
+}
+
+static inline void sub_nored(u64 out[4], const u64 a[4], const u64 b[4]) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a[i] - b[i] - borrow;
+    out[i] = (u64)d;
+    borrow = (d >> 64) & 1;
+  }
+}
+
+static inline void add_mod(u64 out[4], const u64 a[4], const u64 b[4]) {
+  u64 t[5] = {0, 0, 0, 0, 0};
+  u128 carry = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 s = (u128)a[i] + b[i] + carry;
+    t[i] = (u64)s;
+    carry = s >> 64;
+  }
+  t[4] = (u64)carry;
+  if (t[4] || geq(t, P)) {
+    sub_nored(out, t, P);
+  } else {
+    memcpy(out, t, 32);
+  }
+}
+
+static inline void sub_mod(u64 out[4], const u64 a[4], const u64 b[4]) {
+  if (geq(a, b)) {
+    sub_nored(out, a, b);
+  } else {
+    u64 t[4];
+    sub_nored(t, b, a);
+    sub_nored(out, P, t);
+  }
+}
+
+// CIOS Montgomery multiplication.
+static void mont_mul(u64 out[4], const u64 a[4], const u64 b[4]) {
+  u64 t[6] = {0, 0, 0, 0, 0, 0};
+  for (int i = 0; i < 4; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 s = (u128)t[j] + (u128)a[i] * b[j] + carry;
+      t[j] = (u64)s;
+      carry = s >> 64;
+    }
+    u128 s = (u128)t[4] + carry;
+    t[4] = (u64)s;
+    t[5] = (u64)(s >> 64);
+
+    u64 m = t[0] * PINV;
+    carry = ((u128)t[0] + (u128)m * P[0]) >> 64;
+    for (int j = 1; j < 4; ++j) {
+      u128 s2 = (u128)t[j] + (u128)m * P[j] + carry;
+      t[j - 1] = (u64)s2;
+      carry = s2 >> 64;
+    }
+    u128 s3 = (u128)t[4] + carry;
+    t[3] = (u64)s3;
+    t[4] = t[5] + (u64)(s3 >> 64);
+  }
+  if (t[4] || geq(t, P)) {
+    sub_nored(out, t, P);
+  } else {
+    memcpy(out, t, 32);
+  }
+}
+
+static inline void mont_sqr(u64 out[4], const u64 a[4]) { mont_mul(out, a, a); }
+
+static const u64 ZERO[4] = {0, 0, 0, 0};
+
+struct G1Jac {
+  u64 X[4], Y[4], Z[4];
+};
+struct G1Aff {
+  u64 x[4], y[4];  // Montgomery; (0,0) = infinity
+};
+
+static inline bool is_zero4(const u64 a[4]) {
+  return !(a[0] | a[1] | a[2] | a[3]);
+}
+
+static void jac_double(G1Jac &r, const G1Jac &p) {
+  if (is_zero4(p.Z)) {
+    r = p;
+    return;
+  }
+  u64 A[4], B[4], C[4], D[4], E[4], F[4], t[4], t2[4];
+  mont_sqr(A, p.X);
+  mont_sqr(B, p.Y);
+  mont_sqr(C, B);
+  add_mod(t, p.X, B);
+  mont_sqr(t, t);
+  sub_mod(t, t, A);
+  sub_mod(t, t, C);
+  add_mod(D, t, t);
+  add_mod(E, A, A);
+  add_mod(E, E, A);
+  mont_sqr(F, E);
+  // X3 = F - 2D
+  add_mod(t, D, D);
+  sub_mod(r.X, F, t);
+  // Y3 = E(D - X3) - 8C
+  sub_mod(t, D, r.X);
+  mont_mul(t, E, t);
+  add_mod(t2, C, C);
+  add_mod(t2, t2, t2);
+  add_mod(t2, t2, t2);
+  u64 y3[4];
+  sub_mod(y3, t, t2);
+  // Z3 = 2 Y Z
+  mont_mul(t, p.Y, p.Z);
+  add_mod(r.Z, t, t);
+  memcpy(r.Y, y3, 32);
+}
+
+// r = p + (x2, y2) affine (Montgomery), standard madd-2007-bl shape.
+static void jac_add_mixed(G1Jac &r, const G1Jac &p, const u64 x2[4], const u64 y2[4]) {
+  if (is_zero4(x2) && is_zero4(y2)) {
+    r = p;
+    return;
+  }
+  if (is_zero4(p.Z)) {
+    memcpy(r.X, x2, 32);
+    memcpy(r.Y, y2, 32);
+    // Z = 1 in Montgomery form = R mod p
+    static const u64 ONE_M[4] = {0xd35d438dc58f0d9dULL, 0x0a78eb28f5c70b3dULL,
+                                 0x666ea36f7879462cULL, 0x0e0a77c19a07df2fULL};
+    memcpy(r.Z, ONE_M, 32);
+    return;
+  }
+  u64 Z1Z1[4], U2[4], S2[4], H[4], HH[4], HHH[4], V[4], Rr[4], t[4];
+  mont_sqr(Z1Z1, p.Z);
+  mont_mul(U2, x2, Z1Z1);
+  mont_mul(t, y2, p.Z);
+  mont_mul(S2, t, Z1Z1);
+  sub_mod(H, U2, p.X);
+  sub_mod(Rr, S2, p.Y);
+  if (is_zero4(H)) {
+    if (is_zero4(Rr)) {
+      jac_double(r, p);
+      return;
+    }
+    memset(&r, 0, sizeof(r));  // infinity
+    return;
+  }
+  mont_sqr(HH, H);
+  mont_mul(HHH, H, HH);
+  mont_mul(V, p.X, HH);
+  // X3 = Rr^2 - HHH - 2V
+  mont_sqr(t, Rr);
+  sub_mod(t, t, HHH);
+  u64 v2[4];
+  add_mod(v2, V, V);
+  sub_mod(r.X, t, v2);
+  // Y3 = Rr (V - X3) - Y1 HHH
+  sub_mod(t, V, r.X);
+  mont_mul(t, Rr, t);
+  u64 t2[4];
+  mont_mul(t2, p.Y, HHH);
+  sub_mod(r.Y, t, t2);
+  // Z3 = Z1 H
+  u64 z3[4];
+  mont_mul(z3, p.Z, H);
+  memcpy(r.Z, z3, 32);
+}
+
+// Fermat inverse via exponentiation (p - 2); only used once per output.
+static void mont_inv(u64 out[4], const u64 a[4]) {
+  // exponent p-2, big-endian bit scan
+  u64 e[4];
+  u64 two[4] = {2, 0, 0, 0};
+  sub_nored(e, P, two);
+  // out = 1 (Montgomery)
+  static const u64 ONE_M[4] = {0xd35d438dc58f0d9dULL, 0x0a78eb28f5c70b3dULL,
+                               0x666ea36f7879462cULL, 0x0e0a77c19a07df2fULL};
+  u64 acc[4];
+  memcpy(acc, ONE_M, 32);
+  for (int i = 255; i >= 0; --i) {
+    mont_sqr(acc, acc);
+    if ((e[i / 64] >> (i % 64)) & 1) mont_mul(acc, acc, a);
+  }
+  memcpy(out, acc, 32);
+}
+
+extern "C" {
+
+// std -> Montgomery and back (batch), for the Python bridge.
+void fp_to_mont(const u64 *in, u64 *out, int n) {
+  for (int i = 0; i < n; ++i) mont_mul(out + 4 * i, in + 4 * i, R2P);
+}
+void fp_from_mont(const u64 *in, u64 *out, int n) {
+  static const u64 ONE[4] = {1, 0, 0, 0};
+  for (int i = 0; i < n; ++i) mont_mul(out + 4 * i, in + 4 * i, ONE);
+}
+
+// Fixed-base batch scalar-mul over G1.
+//   base: affine (x, y) standard form; scalars: 4-limb standard form;
+//   out: n affine points, standard form, (0,0) for infinity.
+// Window-8 table built per call (n is large in setup, so amortised).
+void g1_fixed_base_batch(const u64 *base_xy, const u64 *scalars, int n, u64 *out_xy) {
+  // Build table[32][256] affine-in-Jacobian: keep Jacobian to skip inversions.
+  static G1Jac table[32][256];  // ~0.8 MB; single-threaded use
+  u64 bx[4], by[4];
+  fp_to_mont(base_xy, bx, 1);
+  fp_to_mont(base_xy + 4, by, 1);
+
+  G1Jac wbase;
+  memcpy(wbase.X, bx, 32);
+  memcpy(wbase.Y, by, 32);
+  static const u64 ONE_M[4] = {0xd35d438dc58f0d9dULL, 0x0a78eb28f5c70b3dULL,
+                               0x666ea36f7879462cULL, 0x0e0a77c19a07df2fULL};
+  memcpy(wbase.Z, ONE_M, 32);
+
+  for (int w = 0; w < 32; ++w) {
+    memset(&table[w][0], 0, sizeof(G1Jac));
+    // normalize wbase to affine for mixed adds: one inversion per window
+    u64 zi[4], zi2[4], zi3[4], ax[4], ay[4];
+    mont_inv(zi, wbase.Z);
+    mont_sqr(zi2, zi);
+    mont_mul(zi3, zi2, zi);
+    mont_mul(ax, wbase.X, zi2);
+    mont_mul(ay, wbase.Y, zi3);
+    for (int d = 1; d < 256; ++d) {
+      jac_add_mixed(table[w][d], table[w][d - 1], ax, ay);
+    }
+    for (int k = 0; k < 8; ++k) jac_double(wbase, wbase);
+  }
+
+  for (int i = 0; i < n; ++i) {
+    const u64 *s = scalars + 4 * i;
+    G1Jac acc;
+    memset(&acc, 0, sizeof(acc));
+    for (int w = 0; w < 32; ++w) {
+      int d = (int)((s[w / 8] >> ((w % 8) * 8)) & 0xff);
+      if (!d) continue;
+      const G1Jac &e = table[w][d];
+      if (is_zero4(acc.Z)) {
+        acc = e;
+      } else {
+        // general Jacobian add via mixed trick: normalise e lazily is
+        // costly; use add-via-double formulas on Jacobian pair:
+        // convert e to affine once would need inversion; instead use
+        // full jacobian addition:
+        u64 Z1Z1[4], Z2Z2[4], U1[4], U2[4], S1[4], S2[4], H[4], Rr[4];
+        mont_sqr(Z1Z1, acc.Z);
+        mont_sqr(Z2Z2, e.Z);
+        mont_mul(U1, acc.X, Z2Z2);
+        mont_mul(U2, e.X, Z1Z1);
+        u64 t[4];
+        mont_mul(t, acc.Y, e.Z);
+        mont_mul(S1, t, Z2Z2);
+        mont_mul(t, e.Y, acc.Z);
+        mont_mul(S2, t, Z1Z1);
+        sub_mod(H, U2, U1);
+        sub_mod(Rr, S2, S1);
+        if (is_zero4(H)) {
+          if (is_zero4(Rr)) {
+            jac_double(acc, acc);
+            continue;
+          }
+          memset(&acc, 0, sizeof(acc));
+          continue;
+        }
+        u64 HH[4], HHH[4], V[4];
+        mont_sqr(HH, H);
+        mont_mul(HHH, H, HH);
+        mont_mul(V, U1, HH);
+        u64 x3[4], y3[4], z3[4];
+        mont_sqr(t, Rr);
+        sub_mod(t, t, HHH);
+        u64 v2[4];
+        add_mod(v2, V, V);
+        sub_mod(x3, t, v2);
+        sub_mod(t, V, x3);
+        mont_mul(t, Rr, t);
+        u64 t2[4];
+        mont_mul(t2, S1, HHH);
+        sub_mod(y3, t, t2);
+        mont_mul(t, acc.Z, e.Z);
+        mont_mul(z3, t, H);
+        memcpy(acc.X, x3, 32);
+        memcpy(acc.Y, y3, 32);
+        memcpy(acc.Z, z3, 32);
+      }
+    }
+    u64 *o = out_xy + 8 * i;
+    if (is_zero4(acc.Z)) {
+      memset(o, 0, 64);
+      continue;
+    }
+    u64 zi[4], zi2[4], zi3[4], mx[4], my[4];
+    mont_inv(zi, acc.Z);
+    mont_sqr(zi2, zi);
+    mont_mul(zi3, zi2, zi);
+    mont_mul(mx, acc.X, zi2);
+    mont_mul(my, acc.Y, zi3);
+    fp_from_mont(mx, o, 1);
+    fp_from_mont(my, o + 4, 1);
+  }
+}
+
+// Self-test hook: c = a*b mod p (standard form in/out).
+void fp_mul_std(const u64 *a, const u64 *b, u64 *c) {
+  u64 am[4], bm[4], cm[4];
+  fp_to_mont(a, am, 1);
+  fp_to_mont(b, bm, 1);
+  mont_mul(cm, am, bm);
+  fp_from_mont(cm, c, 1);
+}
+
+}  // extern "C"

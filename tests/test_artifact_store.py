@@ -1,0 +1,47 @@
+"""Chunked artifact store: the zkey-chunk download path with a mocked
+backend + cache — mirror of the reference's msw-mocked zkp.test.ts
+(SURVEY.md §4 app unit tests)."""
+
+import os
+
+import pytest
+
+from zkp2p_tpu.formats.artifact_store import DirBackend, download_chunked, upload_chunked
+
+
+def test_roundtrip_and_progress(tmp_path):
+    backend = DirBackend(str(tmp_path / "bucket"))
+    blob = bytes(range(256)) * 409 + b"tail"  # deliberately not chunk-aligned
+    manifest = upload_chunked(backend, "circuit.zkey", blob)
+    assert len(manifest.chunks) == 10
+    assert manifest.chunks[0] == "circuit.zkeyb.gz"  # the b..k suffix scheme
+
+    calls = []
+    out = download_chunked(backend, "circuit.zkey", progress=lambda i, n: calls.append((i, n)))
+    assert out == blob
+    assert calls == [(i, 10) for i in range(1, 11)]  # zkp.test.ts progress count
+
+
+def test_cache_skips_backend(tmp_path):
+    backend = DirBackend(str(tmp_path / "bucket"))
+    cache = str(tmp_path / "cache")
+    blob = os.urandom(10_000)
+    upload_chunked(backend, "k", blob)
+    assert download_chunked(backend, "k", cache_dir=cache) == blob
+
+    # poison the backend chunks; cached copies must still serve
+    for f in os.listdir(tmp_path / "bucket"):
+        if f.endswith(".gz"):
+            os.remove(tmp_path / "bucket" / f)
+    assert download_chunked(backend, "k", cache_dir=cache) == blob
+
+
+def test_integrity_failure(tmp_path):
+    backend = DirBackend(str(tmp_path / "bucket"))
+    upload_chunked(backend, "k", b"hello world" * 100)
+    # corrupt one chunk
+    import gzip
+
+    backend.put("kb.gz", gzip.compress(b"evil"))
+    with pytest.raises(IOError):
+        download_chunked(backend, "k")

@@ -1,0 +1,58 @@
+"""Marketplace flow: on-ramper <-> off-ramper through crypto + escrow.
+
+The SURVEY.md §3.3 lifecycle without the proof leg (that's covered by
+test_contracts/test_venmo_model): post -> encrypted claim -> decrypt +
+hash-verify ("Matches") -> clawback paths."""
+
+import pytest
+
+from zkp2p_tpu.client import crypto
+from zkp2p_tpu.client.flow import OffRamper, OnRamper
+from zkp2p_tpu.contracts.ramp import FakeUSDC, Ramp
+from zkp2p_tpu.gadgets.bigint import int_to_limbs_host
+from zkp2p_tpu.inputs.email import venmo_id_hash
+from zkp2p_tpu.snark.groth16 import setup
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+
+def _dummy_vk():
+    cs = ConstraintSystem("d")
+    a = cs.new_public("a")
+    w = cs.new_wire("w")
+    cs.enforce(LC.of(a), LC.of(a), LC.of(w), "sq")
+    cs.compute(w, lambda v: v * v, [a])
+    _, vk = setup(cs, seed="flow")
+    return vk
+
+
+def test_claim_encrypt_decrypt_flow():
+    usdc = FakeUSDC()
+    ramp = Ramp(int_to_limbs_host(0xC0FFEE, 121, 17), usdc, 10_000_000, _dummy_vk())
+
+    onr = OnRamper("onramper", ramp, wallet_signature=b"login sig 0xabc")
+    offr = OffRamper("offramper", ramp, venmo_id="1234567891234567891")
+    usdc.mint("offramper", 20_000_000)
+    usdc.approve("offramper", ramp.address, 20_000_000)
+
+    order_id = onr.post_order(9_000_000, 10_000_000)
+    claim_id = offr.claim_order(order_id, onr.account.public_key_bytes, 10_000_000)
+
+    views = onr.decrypt_claims(order_id)
+    assert len(views) == 1
+    assert views[0].venmo_id == "1234567891234567891"
+    assert views[0].hash_matches  # the "Matches" column
+
+    # wrong recipient can't decrypt
+    eve = OnRamper("eve", ramp, wallet_signature=b"other sig")
+    eve_views = eve.decrypt_claims(order_id)
+    assert not eve_views[0].hash_matches
+
+    # a lying off-ramper (hash of a different id) is flagged
+    offr2 = OffRamper("liar", ramp, venmo_id="9999999999999999999")
+    usdc.mint("liar", 20_000_000)
+    usdc.approve("liar", ramp.address, 20_000_000)
+    order2 = onr.post_order(9_000_000, 10_000_000)
+    blob = crypto.encrypt_message(b"1111111111111111111", onr.account.public_key_bytes)
+    ramp.claim_order("liar", venmo_id_hash("9999999999999999999"), order2, blob, 10_000_000)
+    v2 = onr.decrypt_claims(order2)
+    assert not v2[0].hash_matches  # decrypted id does not hash to the claim

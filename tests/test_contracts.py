@@ -1,0 +1,163 @@
+"""Ramp escrow integration tests (the test/ramp.test.js rebuild).
+
+A 26-signal toy circuit stands in for the Venmo circuit (the hardhat suite
+does the same thing: it pins one known-good proof instead of proving in
+CI, test/ramp.test.js:193-196); here we go one better and actually prove
+with the host Groth16 prover, then run the full order lifecycle."""
+
+import pytest
+
+from zkp2p_tpu.contracts.ramp import (
+    ClaimStatus,
+    FakeUSDC,
+    OrderStatus,
+    Ramp,
+    convert_packed_bytes_to_string,
+    string_to_uint,
+)
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.gadgets.bigint import int_to_limbs_host
+from zkp2p_tpu.inputs.email import pack_bytes_le, venmo_id_hash
+from zkp2p_tpu.snark.groth16 import prove_host, setup
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+VENMO_ID = "1234567891234567891"
+MODULUS = 0xC0FFEE  # toy: any 17-limb value matches as long as contract stores it
+
+
+def build_signal_circuit():
+    """26 public signals in the Ramp layout + one real constraint."""
+    cs = ConstraintSystem("ramp_sig")
+    pubs = [cs.new_public(f"s{i}") for i in range(26)]
+    prod = cs.new_wire("prod")
+    cs.enforce(LC.of(pubs[24]), LC.of(pubs[25]) + 1, LC.of(prod), "bind")
+    cs.compute(prod, lambda a, b: a * (b + 1) % R, [pubs[24], pubs[25]])
+    return cs, pubs
+
+
+def make_signals(order_id, claim_id, amount_str="9.", nullifier=(111, 222, 333)):
+    amt = amount_str.encode() + b"\x00" * (21 - len(amount_str))
+    return (
+        [venmo_id_hash(VENMO_ID)]
+        + pack_bytes_le(amt, 7)
+        + list(nullifier)
+        + int_to_limbs_host(MODULUS, 121, 17)
+        + [order_id, claim_id]
+    )
+
+
+@pytest.fixture(scope="module")
+def world():
+    cs, _ = build_signal_circuit()
+    pk, vk = setup(cs, seed="ramp-test")
+    usdc = FakeUSDC()
+    ramp = Ramp(int_to_limbs_host(MODULUS, 121, 17), usdc, max_amount=10_000_000, vk=vk)
+    return cs, pk, vk, usdc, ramp
+
+
+def prove_signals(cs, pk, signals):
+    w = cs.witness(signals)
+    cs.check_witness(w)
+    return prove_host(pk, cs, w)
+
+
+def test_full_onramp_lifecycle(world):
+    cs, pk, vk, usdc, ramp = world
+    usdc.mint("offramper", 50_000_000)
+    usdc.approve("offramper", ramp.address, 50_000_000)
+
+    order_id = ramp.post_order("onramper", amount=9_000_000, max_amount_to_pay=10_000_000)
+    claim_id = ramp.claim_order("offramper", venmo_id_hash(VENMO_ID), order_id, b"\x69", 10_000_000)
+    assert usdc.balances["offramper"] == 41_000_000  # escrowed
+
+    signals = make_signals(order_id, claim_id)
+    proof = prove_signals(cs, pk, signals)
+    ramp.on_ramp("onramper", proof, signals)
+
+    assert ramp.orders[order_id].status == OrderStatus.Filled
+    assert ramp.order_claims[order_id][claim_id].status == ClaimStatus.Used
+    assert usdc.balances["onramper"] == 9_000_000
+
+    # replay: same nullifier must be rejected (Ramp.sol:281)
+    order2 = ramp.post_order("onramper", 9_000_000, 10_000_000)
+    usdc.approve("offramper", ramp.address, 50_000_000)
+    claim2 = ramp.claim_order("offramper", venmo_id_hash(VENMO_ID), order2, b"\x69", 10_000_000)
+    signals2 = make_signals(order2, claim2, nullifier=(444, 555, 666))
+    proof2 = prove_signals(cs, pk, signals2)
+    signals2_replay = list(signals2)
+    signals2_replay[4:7] = signals[4:7]  # reuse old nullifier
+    with pytest.raises(AssertionError, match="already been used|Invalid Proof"):
+        ramp.on_ramp("onramper", prove_signals(cs, pk, signals2_replay), signals2_replay)
+    # fresh nullifier goes through
+    ramp.on_ramp("onramper", proof2, signals2)
+
+
+def test_rejects_bad_proof_and_wrong_modulus(world):
+    cs, pk, vk, usdc, ramp = world
+    usdc.mint("off2", 20_000_000)
+    usdc.approve("off2", ramp.address, 20_000_000)
+    order_id = ramp.post_order("onr2", 9_000_000, 10_000_000)
+    claim_id = ramp.claim_order("off2", venmo_id_hash(VENMO_ID), order_id, b"", 10_000_000)
+
+    signals = make_signals(order_id, claim_id)
+    signals[4] = 999  # new nullifier
+    proof = prove_signals(cs, pk, signals)
+
+    # tampered signal -> pairing check fails
+    bad = list(signals)
+    bad[0] = (bad[0] + 1) % R
+    with pytest.raises(AssertionError, match="Invalid Proof"):
+        ramp.on_ramp("onr2", proof, bad)
+
+    # wrong modulus limb -> key check fails
+    bad2 = list(signals)
+    bad2[7] = (bad2[7] + 1) % R
+    bad2[4] = 998  # fresh nullifier so the key check is what fires
+    with pytest.raises(AssertionError, match="RSA modulus not matched"):
+        ramp.on_ramp("onr2", prove_signals(cs, pk, bad2), bad2)
+
+
+def test_amount_below_order_rejected(world):
+    cs, pk, vk, usdc, ramp = world
+    usdc.mint("off3", 20_000_000)
+    usdc.approve("off3", ramp.address, 20_000_000)
+    order_id = ramp.post_order("onr3", 9_000_000, 10_000_000)
+    claim_id = ramp.claim_order("off3", venmo_id_hash(VENMO_ID), order_id, b"", 10_000_000)
+    signals = make_signals(order_id, claim_id, amount_str="8.")
+    signals[4] = 777
+    with pytest.raises(AssertionError, match="below order amount"):
+        ramp.on_ramp("onr3", prove_signals(cs, pk, signals), signals)
+
+
+def test_clawback_after_expiry(world):
+    cs, pk, vk, usdc, ramp = world
+    usdc.mint("off4", 20_000_000)
+    usdc.approve("off4", ramp.address, 20_000_000)
+    order_id = ramp.post_order("onr4", 9_000_000, 10_000_000)
+    claim_id = ramp.claim_order("off4", venmo_id_hash(VENMO_ID), order_id, b"", 10_000_000)
+    before = usdc.balances["off4"]
+    with pytest.raises(AssertionError, match="not expired"):
+        ramp.clawback("off4", order_id, claim_id)
+    ramp.increase_time(86401)
+    ramp.clawback("off4", order_id, claim_id)
+    assert usdc.balances["off4"] == before + 9_000_000
+    assert ramp.order_claims[order_id][claim_id].status == ClaimStatus.Clawback
+
+
+def test_cancel_order(world):
+    cs, pk, vk, usdc, ramp = world
+    oid = ramp.post_order("onr5", 5_000_000, 6_000_000)
+    with pytest.raises(AssertionError):
+        ramp.cancel_order("not-owner", oid)
+    ramp.cancel_order("onr5", oid)
+    assert ramp.orders[oid].status == OrderStatus.Canceled
+
+
+def test_packed_bytes_helpers():
+    packed = pack_bytes_le(b"30.\x00\x00\x00\x00" + b"\x00" * 14, 7)
+    assert convert_packed_bytes_to_string(packed, 21) == "30."
+    assert string_to_uint("30.") == 30
+    assert string_to_uint("1234567891234567891") == 1234567891234567891
+    # two nonzero runs must be rejected
+    with pytest.raises(AssertionError, match="Invalid final state"):
+        convert_packed_bytes_to_string(pack_bytes_le(b"ab\x00cd" + b"\x00" * 17, 7), 21)

@@ -1,0 +1,139 @@
+"""Gadget-library unit tests (host witness oracle).
+
+Mirrors the reference's circuit-check strategy (SURVEY.md §4: in-circuit
+log + `--inspect`; here: build -> witness -> check_witness -> compare to a
+trusted host implementation)."""
+
+import hashlib
+import random
+
+import pytest
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.gadgets import core, sha256
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+rng = random.Random(5)
+
+
+def seed_bytes(cs, data, max_len):
+    """Allocate byte wires + decomposition; returns (wires, bit wires, seed map)."""
+    wires = cs.new_wires(max_len, "msg")
+    bits = core.assert_bytes(cs, wires)
+    seed = {w: (data[i] if i < len(data) else 0) for i, w in enumerate(wires)}
+    return wires, bits, seed
+
+
+def sha_pad(msg: bytes, max_len: int):
+    """MD padding to max_len bytes (shaHash.ts sha256Pad semantics)."""
+    length = len(msg) * 8
+    padded = bytearray(msg) + b"\x80"
+    while (len(padded) + 8) % 64:
+        padded.append(0)
+    padded += length.to_bytes(8, "big")
+    used = len(padded)
+    assert used <= max_len and max_len % 64 == 0
+    padded += b"\x00" * (max_len - used)
+    return bytes(padded), used
+
+
+def digest_to_bits(digest: bytes):
+    out = []
+    for wi in range(8):
+        word = int.from_bytes(digest[4 * wi : 4 * wi + 4], "big")
+        out.extend((word >> i) & 1 for i in range(32))
+    return out
+
+
+def test_core_comparators():
+    cs = ConstraintSystem("core")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    ez = core.is_zero(cs, x)
+    eq = core.is_equal(cs, x, y)
+    eqc = core.is_equal_const(cs, x, 7)
+    lt = core.less_than(cs, 8, x, y)
+    for xv, yv in [(0, 0), (7, 7), (3, 9), (9, 3), (255, 0)]:
+        w = cs.witness([], {x: xv, y: yv})
+        cs.check_witness(w)
+        assert w[ez] == (1 if xv == 0 else 0)
+        assert w[eq] == (1 if xv == yv else 0)
+        assert w[eqc] == (1 if xv == 7 else 0)
+        assert w[lt] == (1 if xv < yv else 0)
+
+
+def test_quin_selector_and_packing():
+    cs = ConstraintSystem("sel")
+    idx = cs.new_wire("idx")
+    opts = cs.new_wires(5, "opt")
+    out = core.quin_selector(cs, idx, opts)
+    packed = core.pack_bytes(cs, opts, n_per=3)
+    vals = [10, 20, 30, 40, 50]
+    w = cs.witness([], {idx: 3, **dict(zip(opts, vals))})
+    cs.check_witness(w)
+    assert w[out] == 40
+    assert w[packed[0]] == 10 + (20 << 8) + (30 << 16)
+    assert w[packed[1]] == 40 + (50 << 8)
+    w_bad = cs.witness([], {idx: 9, **dict(zip(opts, vals))})  # out-of-range idx
+    with pytest.raises(AssertionError):
+        cs.check_witness(w_bad)
+
+
+@pytest.mark.parametrize("msg", [b"abc", b""])
+def test_sha256_one_block_fixed(msg):
+    max_len = 64
+    padded, _ = sha_pad(msg, max_len)
+    cs = ConstraintSystem("sha1b")
+    wires, bits, seed = seed_bytes(cs, padded, max_len)
+    out = sha256.sha256_blocks(cs, bits, None)
+    w = cs.witness([], seed)
+    cs.check_witness(w)
+    assert [w[b] for b in out] == digest_to_bits(hashlib.sha256(msg).digest())
+
+
+def test_sha256_variable_length():
+    """2-block circuit, 1-block message: output selected at n_blocks=1."""
+    max_len = 128
+    msg = b"hello zkp2p"
+    padded, used = sha_pad(msg, max_len)
+    n_blocks = used // 64
+    cs = ConstraintSystem("shavar")
+    nb = cs.new_wire("n_blocks")
+    wires, bits, seed = seed_bytes(cs, padded, max_len)
+    out = sha256.sha256_blocks(cs, bits, nb)
+    seed[nb] = n_blocks
+    w = cs.witness([], seed)
+    cs.check_witness(w)
+    assert [w[b] for b in out] == digest_to_bits(hashlib.sha256(msg).digest())
+
+
+def test_sha256_midstate_resume():
+    """Partial SHA: hash prefix outside, resume from midstate wires —
+    the Sha256Partial trick (sha256partial.circom:9, generate_input.ts:110)."""
+    prefix = bytes(rng.randrange(256) for _ in range(64))
+    suffix_msg = b"tail data"
+    full = prefix + suffix_msg
+
+    # Host midstate after the prefix block = compression of prefix.
+    import zkp2p_tpu.inputs.sha_host as sh
+
+    mid = sh.midstate(prefix)
+
+    max_len = 64
+    padded_all, used = sha_pad(full, 128)
+    suffix = padded_all[64:]
+
+    cs = ConstraintSystem("shapart")
+    state_wires = cs.new_wires(256, "mid")
+    # group into 8 words of 32 little-endian bits
+    init_state = [state_wires[32 * i : 32 * i + 32] for i in range(8)]
+    for sw in state_wires:
+        cs.enforce_bool(sw)
+    wires, bits, seed = seed_bytes(cs, suffix, max_len)
+    out = sha256.sha256_blocks(cs, bits, None, init_state=init_state)
+    for i, word in enumerate(mid):
+        for b in range(32):
+            seed[state_wires[32 * i + b]] = (word >> b) & 1
+    w = cs.witness([], seed)
+    cs.check_witness(w)
+    assert [w[b] for b in out] == digest_to_bits(hashlib.sha256(full).digest())

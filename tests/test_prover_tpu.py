@@ -1,0 +1,82 @@
+"""TPU Groth16 prover vs host oracle + pairing verifier.
+
+The determinism contract: same (witness, r, s) -> byte-identical proof from
+`prove_tpu` and `prove_host` (the build's analog of the reference pinning a
+known-good proof vector in test/ramp.test.js:193-196)."""
+
+import random
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.prover import device_pk, prove_tpu, prove_tpu_batch
+from zkp2p_tpu.snark.groth16 import prove_host, setup, verify
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+rng = random.Random(42)
+
+
+def build_toy():
+    """public out; private x, y:  x*y = z,  z*z = out (test_groth16_host twin)."""
+    cs = ConstraintSystem("toy")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+    cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
+    cs.compute(z, lambda a, b: a * b % R, [x, y])
+    return cs, out, x, y
+
+
+def build_wide():
+    """A fatter circuit: chain of muls + linear combos, 2 public inputs."""
+    cs = ConstraintSystem("wide")
+    pub_a = cs.new_public("a")
+    pub_b = cs.new_public("b")
+    wires = [pub_a, pub_b]
+    for i in range(12):
+        u, v = wires[-2], wires[-1]
+        w = cs.new_wire(f"w{i}")
+        cs.enforce(LC.of(u) + LC.of(v) * 3 + LC.const(i + 1), LC.of(v) + LC.const(2), LC.of(w))
+        cs.compute(w, lambda x, y, k=i: (x + 3 * y + k + 1) * (y + 2) % R, [u, v])
+        wires.append(w)
+    return cs
+
+
+def test_tpu_matches_host_prover():
+    cs, out, x, y = build_toy()
+    w = cs.witness([225], {x: 3, y: 5})
+    pk, vk = setup(cs)
+    dpk = device_pk(pk, cs)
+    r, s = rng.randrange(1, R), rng.randrange(1, R)
+    got = prove_tpu(dpk, w, r=r, s=s)
+    want = prove_host(pk, cs, w, r=r, s=s)
+    assert got == want
+    assert verify(vk, got, [225])
+
+
+def test_tpu_prover_wide_circuit():
+    cs = build_wide()
+    pub = [7, 11]
+    w = cs.witness(pub)
+    cs.check_witness(w)
+    pk, vk = setup(cs, seed="wide")
+    dpk = device_pk(pk, cs)
+    proof = prove_tpu(dpk, w)
+    assert verify(vk, proof, pub)
+    assert not verify(vk, proof, [8, 11])
+
+
+def test_tpu_batch_prove():
+    cs, out, x, y = build_toy()
+    pk, vk = setup(cs)
+    dpk = device_pk(pk, cs)
+    cases = [(3, 5), (2, 7), (10, 11), (1, 1)]
+    wits, pubs = [], []
+    for a, b in cases:
+        z = a * b % R
+        o = z * z % R
+        wits.append(cs.witness([o], {x: a, y: b}))
+        pubs.append([o])
+    proofs = prove_tpu_batch(dpk, wits)
+    for proof, pub in zip(proofs, pubs):
+        assert verify(vk, proof, pub)

@@ -1,0 +1,26 @@
+"""Tracing + deploy-config units."""
+
+from zkp2p_tpu.contracts.deploy import VENMO_RSA_KEY_LIMBS, venmo_modulus_int
+from zkp2p_tpu.gadgets.bigint import int_to_limbs_host
+from zkp2p_tpu.utils import trace as tr
+
+
+def test_trace_nesting_and_records():
+    tr.reset()
+    with tr.trace("prove", batch=4):
+        with tr.trace("h_poly"):
+            pass
+        with tr.trace("msm"):
+            pass
+    recs = tr.records()
+    assert [r["stage"] for r in recs] == ["prove/h_poly", "prove/msm", "prove"]
+    assert recs[-1]["batch"] == 4
+    assert all(r["ms"] >= 0 for r in recs)
+    tr.reset()
+    assert tr.records() == []
+
+
+def test_venmo_modulus_limb_roundtrip():
+    n = venmo_modulus_int()
+    assert n.bit_length() == 1024  # the production key is RSA-1024
+    assert int_to_limbs_host(n, 121, 17) == VENMO_RSA_KEY_LIMBS

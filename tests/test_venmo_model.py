@@ -1,0 +1,64 @@
+"""End-to-end semantic test of the flagship Venmo circuit (mini params).
+
+Synthetic DKIM-signed email -> generate_inputs -> witness -> check_witness,
+with the public signals in the Ramp.sol uint[26] layout.  This is the
+build's analog of the reference proving `circuit/input.json` and checking
+against the pinned proof vector (test/ramp.test.js:193-239) — proving the
+mini model itself happens on TPU in bench, not in CI."""
+
+import pytest
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.inputs.email import (
+    generate_inputs,
+    make_test_key,
+    make_venmo_email,
+    venmo_id_hash,
+)
+from zkp2p_tpu.models.venmo import VenmoParams, build_venmo_circuit
+
+PARAMS = VenmoParams(max_header_bytes=256, max_body_bytes=192)
+
+
+@pytest.fixture(scope="module")
+def circuit():
+    return build_venmo_circuit(PARAMS)
+
+
+@pytest.fixture(scope="module")
+def key():
+    return make_test_key(1)
+
+
+@pytest.mark.slow
+def test_venmo_witness_end_to_end(circuit, key):
+    cs, lay = circuit
+    email = make_venmo_email(key, raw_id="1234567891234567891", amount="30", body_filler=40)
+    inputs = generate_inputs(email, key.n, order_id=1, claim_id=0, params=PARAMS, layout=lay)
+    assert len(inputs.public_signals) == 26
+
+    w = cs.witness(inputs.public_signals, inputs.seed)
+    cs.check_witness(w)
+
+    # signal layout (Ramp.sol:253-293)
+    assert inputs.public_signals[0] == venmo_id_hash(email.raw_id)
+    # "30." packed little-endian: '3'=0x33, '0'=0x30, '.'=0x2e
+    assert inputs.public_signals[1] == 0x33 + (0x30 << 8) + (0x2E << 16)
+
+    # tampered public amount must fail
+    bad = list(inputs.public_signals)
+    bad[1] = (bad[1] + 1) % R
+    w_bad = cs.witness(bad, inputs.seed)
+    with pytest.raises(AssertionError):
+        cs.check_witness(w_bad)
+
+
+@pytest.mark.slow
+def test_venmo_witness_different_email(circuit, key):
+    cs, lay = circuit
+    email = make_venmo_email(key, raw_id="9876543210987654321", amount="125", body_filler=10)
+    inputs = generate_inputs(email, key.n, order_id=7, claim_id=3, params=PARAMS, layout=lay)
+    w = cs.witness(inputs.public_signals, inputs.seed)
+    cs.check_witness(w)
+    assert inputs.public_signals[0] == venmo_id_hash("9876543210987654321")
+    assert inputs.public_signals[24] == 7 and inputs.public_signals[25] == 3

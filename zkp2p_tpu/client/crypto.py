@@ -1,0 +1,210 @@
+"""Client-side claim cryptography: deterministic identity + ECIES.
+
+Rebuild of `app/src/helpers/messagEncryption.ts:5-46` (eth-crypto ECIES):
+  - generate_account_from_signature: wallet signature -> sha512 -> secp256k1
+    keypair (the deterministic "encryption identity" the on-ramper derives
+    by signing a login message, NewOrderForm.tsx:35-64)
+  - encrypt_message / decrypt_message: ECIES over secp256k1 — ephemeral
+    ECDH, SHA-512 KDF, AES-256-CTR + HMAC-SHA256 (encrypt-then-MAC).
+    (The reference's eth-crypto uses AES-CBC; CTR needs no inverse cipher
+    and is equivalent here — both sides of this flow are in-framework.)
+
+All primitives are pure Python/stdlib: zero-egress environments have no
+pip, and none of this is on the proving hot path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+# ---------------------------------------------------------- secp256k1
+
+_P = 2**256 - 2**32 - 977
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_G = (
+    0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798,
+    0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8,
+)
+
+Point = Optional[Tuple[int, int]]
+
+
+def _inv(a: int, m: int = _P) -> int:
+    return pow(a, m - 2, m)
+
+
+def _add(p: Point, q: Point) -> Point:
+    if p is None:
+        return q
+    if q is None:
+        return p
+    if p[0] == q[0]:
+        if (p[1] + q[1]) % _P == 0:
+            return None
+        lam = (3 * p[0] * p[0]) * _inv(2 * p[1]) % _P
+    else:
+        lam = (q[1] - p[1]) * _inv(q[0] - p[0]) % _P
+    x = (lam * lam - p[0] - q[0]) % _P
+    return (x, (lam * (p[0] - x) - p[1]) % _P)
+
+
+def _mul(p: Point, k: int) -> Point:
+    acc: Point = None
+    while k:
+        if k & 1:
+            acc = _add(acc, p)
+        p = _add(p, p)
+        k >>= 1
+    return acc
+
+
+def _ser_pub(pt: Point) -> bytes:
+    assert pt is not None
+    return b"\x04" + pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+def _parse_pub(data: bytes) -> Point:
+    assert data[0] == 4 and len(data) == 65
+    return (int.from_bytes(data[1:33], "big"), int.from_bytes(data[33:], "big"))
+
+
+# ---------------------------------------------------------------- AES
+
+_SBOX = None
+
+
+def _aes_sbox():
+    global _SBOX
+    if _SBOX is not None:
+        return _SBOX
+    sbox = [0] * 256
+    p = q = 1
+    sbox[0] = 0x63
+    while True:
+        # multiply p by 3 in GF(2^8)
+        p = p ^ ((p << 1) & 0xFF) ^ (0x1B if p & 0x80 else 0)
+        # divide q by 3
+        q ^= (q << 1) & 0xFF
+        q ^= (q << 2) & 0xFF
+        q ^= (q << 4) & 0xFF
+        q ^= 0x09 if q & 0x80 else 0
+        x = q ^ ((q << 1) | (q >> 7)) & 0xFF ^ ((q << 2) | (q >> 6)) & 0xFF ^ ((q << 3) | (q >> 5)) & 0xFF ^ ((q << 4) | (q >> 4)) & 0xFF
+        sbox[p] = (x ^ 0x63) & 0xFF
+        if p == 1:
+            break
+    _SBOX = sbox
+    return sbox
+
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    return (a ^ 0x1B) & 0xFF if a & 0x100 else a
+
+
+def _aes_expand_key(key: bytes):
+    sbox = _aes_sbox()
+    nk = len(key) // 4
+    nr = nk + 6
+    w = [list(key[4 * i : 4 * i + 4]) for i in range(nk)]
+    rcon = 1
+    for i in range(nk, 4 * (nr + 1)):
+        t = list(w[i - 1])
+        if i % nk == 0:
+            t = t[1:] + t[:1]
+            t = [sbox[b] for b in t]
+            t[0] ^= rcon
+            rcon = _xtime(rcon)
+        elif nk > 6 and i % nk == 4:
+            t = [sbox[b] for b in t]
+        w.append([a ^ b for a, b in zip(w[i - nk], t)])
+    return w, nr
+
+
+def _aes_encrypt_block(block: bytes, w, nr) -> bytes:
+    sbox = _aes_sbox()
+    s = [list(block[i::4]) for i in range(4)]  # state[r][c] = block[r + 4c]
+
+    def add_round_key(rnd):
+        for c in range(4):
+            for r in range(4):
+                s[r][c] ^= w[4 * rnd + c][r]
+
+    add_round_key(0)
+    for rnd in range(1, nr + 1):
+        for r in range(4):
+            for c in range(4):
+                s[r][c] = sbox[s[r][c]]
+        for r in range(1, 4):
+            s[r] = s[r][r:] + s[r][:r]
+        if rnd != nr:
+            for c in range(4):
+                a = [s[r][c] for r in range(4)]
+                s[0][c] = _xtime(a[0]) ^ (_xtime(a[1]) ^ a[1]) ^ a[2] ^ a[3]
+                s[1][c] = a[0] ^ _xtime(a[1]) ^ (_xtime(a[2]) ^ a[2]) ^ a[3]
+                s[2][c] = a[0] ^ a[1] ^ _xtime(a[2]) ^ (_xtime(a[3]) ^ a[3])
+                s[3][c] = (_xtime(a[0]) ^ a[0]) ^ a[1] ^ a[2] ^ _xtime(a[3])
+        add_round_key(rnd)
+    return bytes(s[r][c] for c in range(4) for r in range(4))
+
+
+def _aes_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    w, nr = _aes_expand_key(key)
+    out = bytearray()
+    counter = int.from_bytes(iv, "big")
+    for off in range(0, len(data), 16):
+        ks = _aes_encrypt_block(counter.to_bytes(16, "big"), w, nr)
+        chunk = data[off : off + 16]
+        out.extend(b ^ k for b, k in zip(chunk, ks))
+        counter = (counter + 1) % (1 << 128)
+    return bytes(out)
+
+
+# --------------------------------------------------------------- ECIES
+
+
+@dataclass
+class Account:
+    private_key: int
+    public_key: Point
+
+    @property
+    def public_key_bytes(self) -> bytes:
+        return _ser_pub(self.public_key)
+
+
+def generate_account_from_signature(signature: bytes) -> Account:
+    """signature -> sha512 -> private key (messagEncryption.ts:5-23)."""
+    seed = hashlib.sha512(signature).digest()
+    priv = int.from_bytes(seed[:32], "big") % _N or 1
+    return Account(private_key=priv, public_key=_mul(_G, priv))
+
+
+def _kdf(shared_x: int) -> Tuple[bytes, bytes]:
+    h = hashlib.sha512(shared_x.to_bytes(32, "big")).digest()
+    return h[:32], h[32:]
+
+
+def encrypt_message(message: bytes, recipient_pub: bytes, rng: Optional[bytes] = None) -> bytes:
+    """ECIES: ephemeral_pub(65) || iv(16) || mac(32) || ciphertext."""
+    eph_priv = int.from_bytes(rng or os.urandom(32), "big") % _N or 1
+    eph_pub = _mul(_G, eph_priv)
+    shared = _mul(_parse_pub(recipient_pub), eph_priv)
+    enc_key, mac_key = _kdf(shared[0])
+    iv = (rng and hashlib.sha256(rng).digest()[:16]) or os.urandom(16)
+    ct = _aes_ctr(enc_key, iv, message)
+    mac = hmac.new(mac_key, iv + ct, hashlib.sha256).digest()
+    return _ser_pub(eph_pub) + iv + mac + ct
+
+
+def decrypt_message(blob: bytes, account: Account) -> bytes:
+    eph_pub = _parse_pub(blob[:65])
+    iv, mac, ct = blob[65:81], blob[81:113], blob[113:]
+    shared = _mul(eph_pub, account.private_key)
+    enc_key, mac_key = _kdf(shared[0])
+    if not hmac.compare_digest(mac, hmac.new(mac_key, iv + ct, hashlib.sha256).digest()):
+        raise ValueError("ECIES MAC mismatch")
+    return _aes_ctr(enc_key, iv, ct)

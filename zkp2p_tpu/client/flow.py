@@ -1,0 +1,85 @@
+"""The client order flow — the functional core of the reference SPA (L4).
+
+What the React components do, minus the DOM (SURVEY.md §3.3):
+  OnRamper.post_order      ~ NewOrderForm.tsx:35-105 (derive ECIES identity
+                             from a wallet signature, post with pubkey*)
+  OffRamper.claim_order    ~ ClaimOrderForm.tsx:56-104 (encrypt the Venmo
+                             id to the on-ramper, Poseidon-hash it)
+  OnRamper.decrypt_claims  ~ SubmitOrderClaimsForm.tsx:110-207 (decrypt,
+                             re-hash, report Matches / Does Not Match)
+  OnRamper.prove_and_onramp ~ SubmitOrderGenerateProofForm.tsx:150-229 +
+                             SubmitOrderOnRampForm.tsx:36-59 (email ->
+                             inputs -> TPU prove -> submit)
+
+*The reference stores the encrypt pubkey alongside the order; our Ramp
+model keeps the order book minimal, so the pubkey travels with the
+OnRamper object — same trust shape, the chain never checks it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..contracts.ramp import Ramp
+from ..inputs.email import SyntheticEmail, VenmoInputs, generate_inputs, venmo_id_hash
+from . import crypto
+
+
+@dataclass
+class ClaimView:
+    claim_id: int
+    venmo_id: str
+    hash_matches: bool
+    min_amount_to_pay: int
+
+
+class OnRamper:
+    def __init__(self, address: str, ramp: Ramp, wallet_signature: bytes):
+        self.address = address
+        self.ramp = ramp
+        self.account = crypto.generate_account_from_signature(wallet_signature)
+
+    def post_order(self, amount: int, max_amount_to_pay: int) -> int:
+        return self.ramp.post_order(self.address, amount, max_amount_to_pay)
+
+    def decrypt_claims(self, order_id: int) -> List[ClaimView]:
+        """Decrypt claimed Venmo ids and re-hash to verify
+        (SubmitOrderClaimsForm's Matches / Does Not Match column)."""
+        out = []
+        for cid, claim in self.ramp.order_claims.get(order_id, {}).items():
+            try:
+                venmo_id = crypto.decrypt_message(claim.encrypted_off_ramper_venmo_id, self.account).decode()
+                ok = venmo_id_hash(venmo_id) == claim.venmo_id_hash
+            except Exception:
+                venmo_id, ok = "", False
+            out.append(ClaimView(cid, venmo_id, ok, claim.min_amount_to_pay))
+        return out
+
+    def prove_and_onramp(self, cs, dpk, email: SyntheticEmail, modulus: int, order_id: int, claim_id: int, params, layout) -> VenmoInputs:
+        """Generate inputs, prove on TPU, submit to the escrow — the whole
+        SubmitOrderGenerateProofForm -> SubmitOrderOnRampForm arc."""
+        from ..prover.groth16_tpu import prove_tpu
+
+        inputs = generate_inputs(email, modulus, order_id, claim_id, params, layout)
+        w = cs.witness(inputs.public_signals, inputs.seed)
+        proof = prove_tpu(dpk, w)
+        self.ramp.on_ramp(self.address, proof, inputs.public_signals)
+        return inputs
+
+
+class OffRamper:
+    def __init__(self, address: str, ramp: Ramp, venmo_id: str):
+        self.address = address
+        self.ramp = ramp
+        self.venmo_id = venmo_id
+
+    def claim_order(self, order_id: int, on_ramper_pubkey: bytes, min_amount_to_pay: int) -> int:
+        encrypted = crypto.encrypt_message(self.venmo_id.encode(), on_ramper_pubkey)
+        return self.ramp.claim_order(
+            self.address,
+            venmo_id_hash(self.venmo_id),
+            order_id,
+            encrypted,
+            min_amount_to_pay,
+        )

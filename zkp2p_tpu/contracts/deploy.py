@@ -1,0 +1,42 @@
+"""Deployment config + bootstrap (scripts/deploy.js rebuild).
+
+Carries the production constants the reference bakes into its deploy
+script (`scripts/deploy.js:23-47`): the Venmo mailserver RSA modulus as
+17 x 121-bit limbs (9 nonzero — a 1024-bit key) and the $10 launch cap,
+plus a factory that stands up the executable contract model with them.
+On-chain deployment itself stays hardhat territory; `formats.solidity`
+exports the Verifier these constants pair with.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..snark.groth16 import VerifyingKey
+from .ramp import FakeUSDC, Ramp
+
+# The Venmo mailserver RSA modulus limbs (deploy.js:24-42), 121-bit x 17.
+VENMO_RSA_KEY_LIMBS: List[int] = [
+    683441457792668103047675496834917209,
+    1011953822609495209329257792734700899,
+    1263501452160533074361275552572837806,
+    2083482795601873989011209904125056704,
+    642486996853901942772546774764252018,
+    1463330014555221455251438998802111943,
+    2411895850618892594706497264082911185,
+    520305634984671803945830034917965905,
+    47421696716332554,
+    0, 0, 0, 0, 0, 0, 0, 0,
+]
+
+MAX_AMOUNT_USDC = 10_000_000  # $10, 6 decimals (deploy.js:23)
+
+
+def venmo_modulus_int() -> int:
+    """The limbs reassembled to the 1024-bit modulus."""
+    return sum(v << (121 * i) for i, v in enumerate(VENMO_RSA_KEY_LIMBS))
+
+
+def deploy(vk: VerifyingKey, usdc: Optional[FakeUSDC] = None, max_amount: int = MAX_AMOUNT_USDC) -> Ramp:
+    """Stand up the escrow with production constants (model form)."""
+    return Ramp(VENMO_RSA_KEY_LIMBS, usdc or FakeUSDC(), max_amount, vk)

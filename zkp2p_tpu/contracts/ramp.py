@@ -1,0 +1,222 @@
+"""On-chain settlement semantics: the Ramp escrow + Groth16 verifier (L3).
+
+Executable Python model of `contracts/Ramp.sol` (order book state machine,
+claim escrow/expiry, proof-gated release, nullifier replay protection) and
+`contracts/FakeUSDC.sol`, verified against our pairing-based
+`snark.groth16.verify` — the same equation `Verifier.sol:340-380` checks
+via the EVM pairing precompile.  The Solidity sources themselves are a
+compatibility TARGET (SURVEY.md §7 step 9): proofs emitted by the TPU
+prover must satisfy this logic bit for bit, so the model doubles as the
+integration-test harness the reference runs under hardhat
+(`test/ramp.test.js`).
+
+Semantics mirrored with file:line cites inline.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Dict, List, Optional, Tuple
+
+from ..snark.groth16 import Proof, VerifyingKey, verify
+
+MSG_LEN = 26  # uint[26] signals (Verifier.sol:360)
+BYTES_IN_PACKED = 7  # Ramp.sol:57
+CLAIM_TTL = 86400  # 1 days (Ramp.sol:144)
+
+
+class OrderStatus(IntEnum):  # Ramp.sol:14-19
+    Unopened = 0
+    Open = 1
+    Filled = 2
+    Canceled = 3
+
+
+class ClaimStatus(IntEnum):  # Ramp.sol:21-27
+    Unsubmitted = 0
+    Submitted = 1
+    Used = 2
+    Clawback = 3
+
+
+@dataclass
+class Order:  # Ramp.sol:29-36
+    on_ramper: str
+    amount: int
+    max_amount_to_pay: int
+    status: OrderStatus
+
+
+@dataclass
+class OrderClaim:  # Ramp.sol:38-45
+    off_ramper: str
+    venmo_id_hash: int
+    status: ClaimStatus
+    encrypted_off_ramper_venmo_id: bytes
+    claim_expiration_time: int
+    min_amount_to_pay: int
+
+
+class FakeUSDC:
+    """6-decimals ERC20 with open mint (contracts/FakeUSDC.sol:6-18)."""
+
+    def __init__(self):
+        self.balances: Dict[str, int] = {}
+        self.allowances: Dict[Tuple[str, str], int] = {}
+
+    def mint(self, to: str, amount: int) -> None:
+        self.balances[to] = self.balances.get(to, 0) + amount
+
+    def approve(self, owner: str, spender: str, amount: int) -> None:
+        self.allowances[(owner, spender)] = amount
+
+    def transfer(self, sender: str, to: str, amount: int) -> None:
+        if self.balances.get(sender, 0) < amount:
+            raise AssertionError("ERC20: insufficient balance")
+        self.balances[sender] -= amount
+        self.balances[to] = self.balances.get(to, 0) + amount
+
+    def transfer_from(self, spender: str, owner: str, to: str, amount: int) -> None:
+        if self.allowances.get((owner, spender), 0) < amount:
+            raise AssertionError("ERC20: insufficient allowance")
+        self.allowances[(owner, spender)] -= amount
+        self.transfer(owner, to, amount)
+
+
+def convert_packed_bytes_to_string(packed: List[int], max_bytes: int) -> str:
+    """_convertPackedBytesToBytes (Ramp.sol:299-335): unpack 7-byte LE words,
+    keep the single contiguous nonzero run."""
+    state = 0
+    out = bytearray()
+    for word in packed:
+        for j in range(BYTES_IN_PACKED):
+            b = (word >> (8 * j)) & 0xFF
+            if b != 0:
+                out.append(b)
+                if state % 2 == 0:
+                    state += 1
+            else:
+                if state % 2 == 1:
+                    state += 1
+    if state != 2:
+        raise AssertionError("Invalid final state of packed bytes in email")
+    if len(out) > max_bytes:
+        raise AssertionError("Venmo id too long")
+    return out.decode("latin1")
+
+
+def string_to_uint(s: str) -> int:
+    """_stringToUint256 (Ramp.sol:338-354): digits only, others skipped."""
+    result = 0
+    for ch in s:
+        if "0" <= ch <= "9":
+            result = result * 10 + (ord(ch) - 48)
+    return result
+
+
+class Ramp:
+    """The escrow order book (`contracts/Ramp.sol:10-354`)."""
+
+    def __init__(self, venmo_keys: List[int], usdc: FakeUSDC, max_amount: int, vk: VerifyingKey, address: str = "ramp"):
+        assert len(venmo_keys) == 17
+        self.venmo_mailserver_keys = list(venmo_keys)  # Ramp.sol:63
+        self.usdc = usdc
+        self.max_amount = max_amount
+        self.vk = vk
+        self.address = address
+        self.order_nonce = 1  # Ramp.sol:94 (starts at 1)
+        self.orders: Dict[int, Order] = {}
+        self.order_claims: Dict[int, Dict[int, OrderClaim]] = {}
+        self.order_claim_nonce: Dict[int, int] = {}
+        self.claimed_venmo_ids: Dict[int, set] = {}
+        self.nullified: set = set()  # Ramp.sol:75
+        self._now = int(_time.time())
+
+    # -- test helper (hardhat time.increase analog, test/ramp.test.js:260)
+    def increase_time(self, secs: int) -> None:
+        self._now += secs
+
+    # ---------------------------------------------------------- Ramp.sol:100
+    def post_order(self, sender: str, amount: int, max_amount_to_pay: int) -> int:
+        assert 0 < amount <= self.max_amount, "amount over max"
+        order_id = self.order_nonce
+        self.orders[order_id] = Order(sender, amount, max_amount_to_pay, OrderStatus.Open)
+        self.order_claims[order_id] = {}
+        self.order_claim_nonce[order_id] = 0
+        self.claimed_venmo_ids[order_id] = set()
+        self.order_nonce += 1
+        return order_id
+
+    # ---------------------------------------------------------- Ramp.sol:122
+    def claim_order(self, sender: str, venmo_id_hash: int, order_id: int, encrypted_venmo_id: bytes, min_amount_to_pay: int) -> int:
+        order = self.orders.get(order_id)
+        assert order and order.status == OrderStatus.Open, "order not open"
+        assert venmo_id_hash not in self.claimed_venmo_ids[order_id], "venmo id already claimed"
+        claim_id = self.order_claim_nonce[order_id]
+        self.order_claims[order_id][claim_id] = OrderClaim(
+            off_ramper=sender,
+            venmo_id_hash=venmo_id_hash,
+            status=ClaimStatus.Submitted,
+            encrypted_off_ramper_venmo_id=encrypted_venmo_id,
+            claim_expiration_time=self._now + CLAIM_TTL,
+            min_amount_to_pay=min_amount_to_pay,
+        )
+        self.claimed_venmo_ids[order_id].add(venmo_id_hash)
+        self.order_claim_nonce[order_id] = claim_id + 1
+        # escrow USDC (Ramp.sol:153)
+        self.usdc.transfer_from(self.address, sender, self.address, self.orders[order_id].amount)
+        return claim_id
+
+    # ---------------------------------------------------------- Ramp.sol:156
+    def on_ramp(self, sender: str, proof: Proof, signals: List[int]) -> None:
+        venmo_id, usd_amount, order_id, claim_id, nullifier = self._verify_and_parse(proof, signals)
+        order = self.orders.get(order_id)
+        claim = self.order_claims.get(order_id, {}).get(claim_id)
+        assert order and order.status == OrderStatus.Open, "order not open"
+        assert claim and claim.status == ClaimStatus.Submitted, "claim not submitted"
+        assert claim.venmo_id_hash == venmo_id, "wrong venmo id"
+        assert usd_amount >= order.amount, "payment below order amount"  # Ramp.sol:176
+        self.nullified.add(nullifier)
+        order.status = OrderStatus.Filled
+        claim.status = ClaimStatus.Used
+        self.usdc.transfer(self.address, order.on_ramper, order.amount)  # Ramp.sol:186-192
+
+    # ---------------------------------------------------------- Ramp.sol:195
+    def cancel_order(self, sender: str, order_id: int) -> None:
+        order = self.orders.get(order_id)
+        assert order and order.status == OrderStatus.Open and order.on_ramper == sender
+        order.status = OrderStatus.Canceled
+
+    # ---------------------------------------------------------- Ramp.sol:202
+    def clawback(self, sender: str, order_id: int, claim_id: int) -> None:
+        claim = self.order_claims.get(order_id, {}).get(claim_id)
+        order = self.orders[order_id]
+        assert claim and claim.off_ramper == sender
+        assert claim.status == ClaimStatus.Submitted
+        order_done = order.status in (OrderStatus.Filled, OrderStatus.Canceled)
+        if not order_done:
+            assert self._now > claim.claim_expiration_time, "claim not expired"
+        claim.status = ClaimStatus.Clawback
+        self.usdc.transfer(self.address, sender, order.amount)
+
+    # ------------------------------------------------------------- views
+    def get_claims_for_order(self, order_id: int) -> List[OrderClaim]:  # Ramp.sol:228
+        return list(self.order_claims.get(order_id, {}).values())
+
+    def get_all_orders(self) -> List[Tuple[int, Order]]:  # Ramp.sol:239
+        return sorted(self.orders.items())
+
+    # ---------------------------------------------------------- Ramp.sol:253
+    def _verify_and_parse(self, proof: Proof, signals: List[int]):
+        assert len(signals) == MSG_LEN
+        assert verify(self.vk, proof, signals), "Invalid Proof"
+        venmo_id = signals[0]
+        amount_str = convert_packed_bytes_to_string(signals[1:4], BYTES_IN_PACKED * 3)
+        usd_amount = string_to_uint(amount_str) * 10**6
+        nullifier = tuple(signals[4:7])  # keccak of the 3 words on-chain
+        assert nullifier not in self.nullified, "Email has already been used"
+        for i in range(7, MSG_LEN - 2):
+            assert signals[i] == self.venmo_mailserver_keys[i - 7], "Invalid: RSA modulus not matched"
+        return venmo_id, usd_amount, signals[MSG_LEN - 2], signals[MSG_LEN - 1], nullifier

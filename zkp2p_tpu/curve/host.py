@@ -192,3 +192,162 @@ def g2_msm(points, scalars) -> G2Point:
     for pt, s in zip(points, scalars, strict=True):
         acc = g2_add(acc, g2_mul(pt, s))
     return acc
+
+
+# ------------------------------------------------- fixed-base scalar mul
+
+from ..field.bn254 import R as _R_SCALAR  # noqa: E402
+
+
+def _g2_jac_add(X1, Y1, Z1, X2, Y2, Z2):
+    """Jacobian add over Fq2 (mirrors _jac_add; Fq2 operators auto-reduce)."""
+    Z1Z1 = Z1 * Z1
+    Z2Z2 = Z2 * Z2
+    U1 = X1 * Z2Z2
+    U2 = X2 * Z1Z1
+    S1 = Y1 * Z2 * Z2Z2
+    S2 = Y2 * Z1 * Z1Z1
+    if U1 == U2:
+        if S1 != S2:
+            return Fq2.zero(), Fq2.one(), Fq2.zero()
+        return _g2_jac_double(X1, Y1, Z1)
+    H = U2 - U1
+    I = (H + H) * (H + H)
+    J = H * I
+    rr = (S2 - S1) + (S2 - S1)
+    V = U1 * I
+    X3 = rr * rr - J - V - V
+    Y3 = rr * (V - X3) - (S1 * J + S1 * J)
+    Z3 = ((Z1 + Z2) * (Z1 + Z2) - Z1Z1 - Z2Z2) * H
+    return X3, Y3, Z3
+
+
+def _g2_jac_double(X1, Y1, Z1):
+    A = X1 * X1
+    B = Y1 * Y1
+    C = B * B
+    t = (X1 + B) * (X1 + B) - A - C
+    D = t + t
+    E = A + A + A
+    F = E * E
+    X3 = F - D - D
+    C8 = C + C
+    C8 = C8 + C8
+    C8 = C8 + C8
+    Y3 = E * (D - X3) - C8
+    YZ = Y1 * Z1
+    Z3 = YZ + YZ
+    return X3, Y3, Z3
+
+
+class FixedBaseMul:
+    """Windowed fixed-base scalar multiplication (host).
+
+    Setup evaluates hundreds of thousands of scalar muls of the SAME base
+    (the generators) — `[A_i(tau)]1` etc. for every wire.  A one-time
+    8-bit-window affine table (32 windows x 255 entries) turns each mul
+    into <= 31 Jacobian mixed additions with a single final inversion:
+    ~15x over per-mul double-and-add."""
+
+    WINDOW = 8
+
+    def __init__(self, base, add, jac_add, to_affine):
+        self._jac_add = jac_add
+        self._to_affine = to_affine
+        self.tables = []
+        w_base = base
+        for _ in range(256 // self.WINDOW):
+            row = [None]
+            cur = None
+            for _d in range(1, 1 << self.WINDOW):
+                cur = add(cur, w_base)
+                row.append(cur)
+            self.tables.append(row)
+            for _ in range(self.WINDOW):
+                w_base = add(w_base, w_base)
+
+    def mul(self, k: int):
+        k %= _R_SCALAR
+        acc = None  # (X, Y, Z) jacobian
+        w = 0
+        while k:
+            d = k & ((1 << self.WINDOW) - 1)
+            k >>= self.WINDOW
+            if d:
+                x, y = self.tables[w][d]
+                if acc is None:
+                    acc = (x, y, self._one())
+                else:
+                    acc = self._jac_add(*acc, x, y, self._one())
+            w += 1
+        return None if acc is None else self._to_affine(acc)
+
+    def _one(self):
+        raise NotImplementedError
+
+
+class _G1Fixed(FixedBaseMul):
+    def __init__(self):
+        super().__init__(G1_GENERATOR, g1_add, _jac_add, self._affine)
+
+    def _one(self):
+        return 1
+
+    @staticmethod
+    def _affine(acc):
+        X, Y, Z = acc
+        if Z == 0:
+            return None
+        zi = pow(Z, P - 2, P)
+        z2 = zi * zi % P
+        return (X * z2 % P, Y * z2 % P * zi % P)
+
+
+class _G2Fixed(FixedBaseMul):
+    def __init__(self):
+        super().__init__(G2_GENERATOR, g2_add, _g2_jac_add, self._affine)
+
+    def _one(self):
+        return Fq2.one()
+
+    @staticmethod
+    def _affine(acc):
+        X, Y, Z = acc
+        if Z.is_zero():
+            return None
+        zi = Z.inv()
+        z2 = zi * zi
+        return (X * z2, Y * z2 * zi)
+
+
+_g1_fixed: Optional[_G1Fixed] = None
+_g2_fixed: Optional[_G2Fixed] = None
+
+
+def g1_gen_mul(k: int) -> G1Point:
+    """k*G1 via the shared fixed-base table (setup's hot path)."""
+    global _g1_fixed
+    if _g1_fixed is None:
+        _g1_fixed = _G1Fixed()
+    return _g1_fixed.mul(k)
+
+
+def g2_gen_mul(k: int) -> G2Point:
+    global _g2_fixed
+    if _g2_fixed is None:
+        _g2_fixed = _G2Fixed()
+    return _g2_fixed.mul(k)
+
+
+def g1_gen_mul_batch(scalars) -> "list[G1Point]":
+    """Batch k*G1: native C++ fixed-base when available (~135us/mul),
+    Python windowed tables otherwise."""
+    try:
+        from ..native.lib import g1_fixed_base_batch
+
+        res = g1_fixed_base_batch(G1_GENERATOR, list(scalars))
+        if res is not None:
+            return res
+    except Exception:
+        pass
+    return [g1_gen_mul(k) for k in scalars]

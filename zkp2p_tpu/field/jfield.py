@@ -39,20 +39,40 @@ def limbs_to_int(a) -> int:
     return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(a))
 
 
+def _shift_up(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """x[i] -> x[i-k] along the limb (last) axis, zero-filled below."""
+    pad = [(0, 0)] * (x.ndim - 1) + [(k, 0)]
+    return jnp.pad(x, pad)[..., : x.shape[-1]]
+
+
 def _carry_canon(x: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
     """Propagate carries: arbitrary uint32 limbs -> canonical 16-bit limbs.
 
-    Static unrolled ripple (out_limbs steps); each step is elementwise over
-    the batch dims, so the whole chain stays on the VPU.
+    Log-depth instead of a limb-count ripple: two local folds bring every
+    limb to <= 2^16, then a Kogge-Stone generate/propagate ladder resolves
+    the remaining 0/1 carries in ceil(log2(out_limbs)) vector steps.  Keeps
+    both the traced graph and the runtime dependency chain at O(log limbs).
+
+    Callers guarantee limbs beyond `out_limbs` are zero (no value is
+    silently truncated).
     """
-    in_limbs = x.shape[-1]
-    carry = jnp.zeros_like(x[..., 0])
-    out = []
-    for i in range(out_limbs):
-        t = carry if i >= in_limbs else x[..., i] + carry
-        out.append(t & MASK)
-        carry = t >> LIMB_BITS
-    return jnp.stack(out, axis=-1)
+    L = x.shape[-1]
+    if L < out_limbs:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, out_limbs - L)])
+    else:
+        x = x[..., :out_limbs]
+    # Fold 1: limbs < 2^16 + 2^16 = 2^17.  Fold 2: limbs <= 2^16.
+    for _ in range(2):
+        x = (x & MASK) + _shift_up(x >> LIMB_BITS, 1)
+    g = x >> LIMB_BITS  # 0/1 generate
+    r = x & MASK
+    p = (r == MASK).astype(jnp.uint32)  # propagate
+    k = 1
+    while k < out_limbs:
+        g = g | (p & _shift_up(g, k))
+        p = p & _shift_up(p, k)
+        k *= 2
+    return (r + _shift_up(g, 1)) & MASK
 
 
 def _mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -68,10 +88,17 @@ def _mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     hi = prods >> LIMB_BITS
     n = a.shape[-1]
     m = b.shape[-1]
-    acc = jnp.zeros(a.shape[:-1] + (n + m + 1,), dtype=jnp.uint32)
-    for i in range(n):
-        acc = acc.at[..., i : i + m].add(lo[..., i, :])
-        acc = acc.at[..., i + 1 : i + m + 1].add(hi[..., i, :])
+    L = n + m + 1
+    # Shear rows to their limb offset with static pads, then one tree-sum —
+    # no dynamic-update-slice chain (an n-step serial graph XLA compiles and
+    # executes far slower than pad+reduce).
+    lead = [(0, 0)] * (lo.ndim - 2)
+    rows = [
+        jnp.pad(lo[..., i, :], lead + [(i, L - m - i)])
+        + jnp.pad(hi[..., i, :], lead + [(i + 1, L - m - i - 1)])
+        for i in range(n)
+    ]
+    acc = jnp.sum(jnp.stack(rows, axis=-2), axis=-2)  # max ~2n*2^16 << 2^32
     return _carry_canon(acc, n + m)
 
 
@@ -118,16 +145,16 @@ class JPrimeField:
 
     @staticmethod
     def _sub_raw(a: jnp.ndarray, b: jnp.ndarray):
-        """(a - b) mod 2^256 with final borrow flag (1 if a < b)."""
-        ai = a.astype(jnp.int32)
-        bi = jnp.broadcast_to(b, a.shape).astype(jnp.int32)
-        borrow = jnp.zeros_like(ai[..., 0])
-        out = []
-        for i in range(a.shape[-1]):
-            t = ai[..., i] - bi[..., i] - borrow
-            out.append((t & MASK).astype(jnp.uint32))
-            borrow = (t < 0).astype(jnp.int32)
-        return jnp.stack(out, axis=-1), borrow
+        """(a - b) mod 2^256 with final borrow flag (1 if a < b).
+
+        Two's-complement addition a + ~b + 1 through the log-depth carry
+        ladder; the carry out of the top limb is the no-borrow flag."""
+        n = a.shape[-1]
+        x = a + (MASK - jnp.broadcast_to(b, a.shape))
+        one = jnp.zeros(n, dtype=jnp.uint32).at[0].set(1)
+        y = _carry_canon(x + one, n + 1)
+        borrow = (1 - y[..., n]).astype(jnp.int32)
+        return y[..., :n], borrow
 
     def add(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         return self._cond_sub_n(_carry_canon(a + b, NUM_LIMBS))

@@ -1,0 +1,159 @@
+"""circom binary formats: .r1cs and .wtns, read AND write.
+
+Interop with the reference's toolchain (SURVEY.md §2.2): `circom --r1cs`
+emits .r1cs consumed by snarkjs setup; witness generators emit .wtns
+consumed by `snarkjs groth16 prove` / rapidsnark
+(`dizkus-scripts/2_gen_wtns.sh`, `6_gen_proof_rapidsnark.sh:24-31`).
+Supporting both directions means:
+  - our ConstraintSystem can be exported for snarkjs to set up / prove
+    (differential verification of circuits), and
+  - real circom artifacts can be imported and proven by the TPU prover
+    (drop-in `prover=tpu`).
+
+Format (iden3 binfile): magic(4) version(u32) n_sections(u32) then
+sections of [type u32][size u64][payload].  Field elements are 32-byte
+little-endian.  r1cs header section: fieldSize u32, prime, nWires,
+nPubOut, nPubIn, nPrvIn, nLabels u64, nConstraints.  Wire order:
+[1, pubOuts, pubIns, prvIns] — our publics map to pubOuts.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+from ..field.bn254 import R
+from ..snark.r1cs import ConstraintSystem
+
+R1CS_MAGIC = b"r1cs"
+WTNS_MAGIC = b"wtns"
+
+
+def _fe_bytes(x: int) -> bytes:
+    return (x % R).to_bytes(32, "little")
+
+
+def _write_binfile(path: str, magic: bytes, version: int, sections: List[Tuple[int, bytes]]) -> None:
+    with open(path, "wb") as f:
+        f.write(magic)
+        f.write(struct.pack("<II", version, len(sections)))
+        for stype, payload in sections:
+            f.write(struct.pack("<IQ", stype, len(payload)))
+            f.write(payload)
+
+
+def _read_binfile(path: str, magic: bytes) -> Dict[int, bytes]:
+    with open(path, "rb") as f:
+        data = f.read()
+    assert data[:4] == magic, f"bad magic {data[:4]!r}"
+    _version, n_sections = struct.unpack_from("<II", data, 4)
+    off = 12
+    sections: Dict[int, bytes] = {}
+    for _ in range(n_sections):
+        stype, size = struct.unpack_from("<IQ", data, off)
+        off += 12
+        sections[stype] = data[off : off + size]
+        off += size
+    return sections
+
+
+# -------------------------------------------------------------- r1cs
+
+
+@dataclass
+class R1csFile:
+    n_wires: int
+    n_pub_out: int
+    n_pub_in: int
+    n_prv_in: int
+    constraints: List[Tuple[Dict[int, int], Dict[int, int], Dict[int, int]]]
+
+    @property
+    def n_public(self) -> int:
+        return self.n_pub_out + self.n_pub_in
+
+
+def write_r1cs(cs: ConstraintSystem, path: str) -> None:
+    header = struct.pack("<I", 32) + R.to_bytes(32, "little")
+    n_prv = cs.num_wires - 1 - cs.num_public
+    header += struct.pack("<IIIIQI", cs.num_wires, cs.num_public, 0, n_prv, cs.num_wires, cs.num_constraints)
+
+    body = bytearray()
+    for con in cs.constraints:
+        for terms in (con.a, con.b, con.c):
+            body += struct.pack("<I", len(terms))
+            for wire, coeff in sorted(terms.items()):
+                body += struct.pack("<I", wire) + _fe_bytes(coeff)
+
+    labels = b"".join(struct.pack("<Q", i) for i in range(cs.num_wires))
+    _write_binfile(path, R1CS_MAGIC, 1, [(1, header), (2, bytes(body)), (3, labels)])
+
+
+def read_r1cs(path: str) -> R1csFile:
+    sections = _read_binfile(path, R1CS_MAGIC)
+    hdr = sections[1]
+    fs = struct.unpack_from("<I", hdr, 0)[0]
+    prime = int.from_bytes(hdr[4 : 4 + fs], "little")
+    assert prime == R, "not a BN254-scalar r1cs"
+    n_wires, n_pub_out, n_pub_in, n_prv, _n_labels, n_constraints = struct.unpack_from(
+        "<IIIIQI", hdr, 4 + fs
+    )
+    body = sections[2]
+    off = 0
+    constraints = []
+    for _ in range(n_constraints):
+        lcs = []
+        for _k in range(3):
+            (n_terms,) = struct.unpack_from("<I", body, off)
+            off += 4
+            terms: Dict[int, int] = {}
+            for _t in range(n_terms):
+                (wire,) = struct.unpack_from("<I", body, off)
+                off += 4
+                terms[wire] = int.from_bytes(body[off : off + fs], "little")
+                off += fs
+            lcs.append(terms)
+        constraints.append((lcs[0], lcs[1], lcs[2]))
+    return R1csFile(
+        n_wires=n_wires,
+        n_pub_out=n_pub_out,
+        n_pub_in=n_pub_in,
+        n_prv_in=n_prv,
+        constraints=constraints,
+    )
+
+
+def r1cs_to_constraint_system(r: R1csFile, name: str = "imported") -> ConstraintSystem:
+    """Imported circuits carry no witness program — witnesses arrive via
+    .wtns (the circom witness generator's job)."""
+    cs = ConstraintSystem(name)
+    for i in range(r.n_public):
+        cs.new_public(f"pub{i}")
+    for i in range(r.n_wires - 1 - r.n_public):
+        cs.new_wire(f"w{i}")
+    for a, b, c in r.constraints:
+        from ..snark.r1cs import LC
+
+        cs.enforce(LC(a), LC(b), LC(c), "imported")
+    return cs
+
+
+# -------------------------------------------------------------- wtns
+
+
+def write_wtns(witness: List[int], path: str) -> None:
+    header = struct.pack("<I", 32) + R.to_bytes(32, "little") + struct.pack("<I", len(witness))
+    body = b"".join(_fe_bytes(w) for w in witness)
+    _write_binfile(path, WTNS_MAGIC, 2, [(1, header), (2, body)])
+
+
+def read_wtns(path: str) -> List[int]:
+    sections = _read_binfile(path, WTNS_MAGIC)
+    hdr = sections[1]
+    fs = struct.unpack_from("<I", hdr, 0)[0]
+    prime = int.from_bytes(hdr[4 : 4 + fs], "little")
+    assert prime == R
+    (n,) = struct.unpack_from("<I", hdr, 4 + fs)
+    body = sections[2]
+    return [int.from_bytes(body[i * fs : (i + 1) * fs], "little") for i in range(n)]

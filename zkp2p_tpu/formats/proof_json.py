@@ -1,0 +1,109 @@
+"""snarkjs-compatible proof / public-signal / vkey JSON (wire formats).
+
+The pipeline contract (SURVEY.md §3.2): same `proof.json` / `public.json`
+shapes snarkjs and rapidsnark emit (`dizkus-scripts/5_gen_proof.sh`,
+`6_gen_proof_rapidsnark.sh`), so our `prover=tpu` output drops into
+`snarkjs groth16 verify` and the existing upload/chain tooling.
+
+G2 coordinate order: snarkjs JSON stores [[x.c0,x.c1],[y.c0,y.c1]]; the
+EVM precompile wants c1 before c0, so the app flips pi_b before calling
+`Ramp.onRamp` (`SubmitOrderOnRampForm.tsx:36-46`).  `proof_to_calldata`
+reproduces that flip — byte-for-byte the uint layout `Verifier.sol:360`
+expects.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from ..curve.host import G1Point, G2Point
+from ..field.tower import Fq2
+from ..snark.groth16 import Proof, VerifyingKey
+
+
+def _g1(pt: G1Point) -> List[str]:
+    assert pt is not None
+    return [str(pt[0]), str(pt[1]), "1"]
+
+
+def _g2(pt: G2Point) -> List[List[str]]:
+    assert pt is not None
+    x, y = pt
+    return [[str(x.c0), str(x.c1)], [str(y.c0), str(y.c1)], ["1", "0"]]
+
+
+def _parse_g1(v: Sequence) -> G1Point:
+    x, y = int(v[0]), int(v[1])
+    if x == 0 and y == 0:
+        return None
+    return (x, y)
+
+
+def _parse_g2(v: Sequence) -> G2Point:
+    return (Fq2(int(v[0][0]), int(v[0][1])), Fq2(int(v[1][0]), int(v[1][1])))
+
+
+def proof_to_json(proof: Proof) -> Dict:
+    return {
+        "pi_a": _g1(proof.a),
+        "pi_b": _g2(proof.b),
+        "pi_c": _g1(proof.c),
+        "protocol": "groth16",
+        "curve": "bn128",
+    }
+
+
+def proof_from_json(d: Dict) -> Proof:
+    return Proof(a=_parse_g1(d["pi_a"]), b=_parse_g2(d["pi_b"]), c=_parse_g1(d["pi_c"]))
+
+
+def public_to_json(signals: Sequence[int]) -> List[str]:
+    return [str(s) for s in signals]
+
+
+def proof_to_calldata(proof: Proof, signals: Sequence[int]) -> Tuple:
+    """(a, b, c, signals) uint tuples with the pi_b c1/c0 flip — the
+    reformatProofForChain transform (SubmitOrderOnRampForm.tsx:36-46)."""
+    a = (proof.a[0], proof.a[1])
+    bx, by = proof.b
+    b = ((bx.c1, bx.c0), (by.c1, by.c0))
+    c = (proof.c[0], proof.c[1])
+    return a, b, c, tuple(int(s) for s in signals)
+
+
+def vkey_to_json(vk: VerifyingKey) -> Dict:
+    """snarkjs verification_key.json (the embedded `app/src/helpers/vkey.ts`
+    shape; `vk_alphabeta_12` is omitted — snarkjs recomputes pairings from
+    the points during verify)."""
+    return {
+        "protocol": "groth16",
+        "curve": "bn128",
+        "nPublic": vk.n_public,
+        "vk_alpha_1": _g1(vk.alpha_1),
+        "vk_beta_2": _g2(vk.beta_2),
+        "vk_gamma_2": _g2(vk.gamma_2),
+        "vk_delta_2": _g2(vk.delta_2),
+        "IC": [_g1(pt) for pt in vk.ic],
+    }
+
+
+def vkey_from_json(d: Dict) -> VerifyingKey:
+    return VerifyingKey(
+        n_public=int(d["nPublic"]),
+        alpha_1=_parse_g1(d["vk_alpha_1"]),
+        beta_2=_parse_g2(d["vk_beta_2"]),
+        gamma_2=_parse_g2(d["vk_gamma_2"]),
+        delta_2=_parse_g2(d["vk_delta_2"]),
+        ic=[_parse_g1(p) for p in d["IC"]],
+    )
+
+
+def dump(obj, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=1)
+
+
+def load(path: str):
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,184 @@
+"""Core R1CS gadgets: bits, comparators, selectors, packing.
+
+Our equivalents of the circom stdlib the reference circuits lean on —
+circomlib `bitify`/`comparators`/`gates`, `zk-email-verify-circuits/
+utils.circom` (`QuinSelector:20-47`, `CalculateTotal:49`, `Bytes2Packed:
+120-172`) and `regex_helpers.circom` (`MultiOR:34-47`).  Each gadget
+emits constraints AND registers witness hooks, so `cs.witness` stays a
+complete host oracle for the vectorised JAX witness tracers.
+
+Convention: functions take the ConstraintSystem first, wires as ints /
+lists of ints, and return output wire(s).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..field.bn254 import R
+from ..snark.r1cs import LC, ConstraintSystem
+
+
+def const_mul(wire: int, k: int) -> LC:
+    return LC.of(wire, k % R)
+
+
+def lc_sum(wires: Sequence[int], coeffs: Sequence[int] | None = None) -> LC:
+    acc = LC()
+    for i, w in enumerate(wires):
+        acc = acc + LC.of(w, 1 if coeffs is None else coeffs[i] % R)
+    return acc
+
+
+# ------------------------------------------------------------------- bits
+
+
+def num2bits(cs: ConstraintSystem, x: int, n: int, tag: str = "num2bits") -> List[int]:
+    """x -> n little-endian bit wires; enforces booleanity + recomposition.
+    (circomlib Num2Bits; the decomposition must be unique, so n must be
+    small enough that 2^n - 1 < R.)"""
+    assert n < 254, "ambiguous decomposition"
+    bits = cs.new_wires(n, f"{tag}.b")
+    for b in bits:
+        cs.enforce_bool(b, f"{tag}/bool")
+    cs.enforce_eq(lc_sum(bits, [1 << i for i in range(n)]), LC.of(x), f"{tag}/recompose")
+    cs.compute(bits, lambda v: [(v >> i) & 1 for i in range(n)], [x])
+    return bits
+
+
+def bits2num(cs: ConstraintSystem, bits: Sequence[int], tag: str = "bits2num") -> int:
+    """Little-endian bit wires -> one wire (no booleanity re-check)."""
+    out = cs.new_wire(f"{tag}.out")
+    cs.enforce_eq(lc_sum(bits, [1 << i for i in range(len(bits))]), LC.of(out), tag)
+    cs.compute(out, lambda *bs: sum(b << i for i, b in enumerate(bs)) % R, list(bits))
+    return out
+
+
+def range_check(cs: ConstraintSystem, x: int, n: int, tag: str = "range") -> None:
+    """x < 2^n via throwaway bit decomposition."""
+    num2bits(cs, x, n, tag)
+
+
+# ------------------------------------------------------------- comparators
+
+
+def is_zero(cs: ConstraintSystem, x: int, tag: str = "iszero") -> int:
+    """out = 1 iff x == 0 (circomlib IsZero: out = -x*inv + 1, x*out = 0)."""
+    inv = cs.new_wire(f"{tag}.inv")
+    out = cs.new_wire(f"{tag}.out")
+    cs.enforce(LC.of(x), LC.of(inv), LC.const(1) - LC.of(out), f"{tag}/inv")
+    cs.enforce(LC.of(x), LC.of(out), LC(), f"{tag}/zero")
+    cs.compute(inv, lambda v: pow(v, R - 2, R) if v else 0, [x])
+    cs.compute(out, lambda v: 0 if v else 1, [x])
+    return out
+
+
+def is_equal(cs: ConstraintSystem, x: int, y: int, tag: str = "iseq") -> int:
+    diff = cs.new_wire(f"{tag}.diff")
+    cs.enforce_eq(LC.of(x) - LC.of(y), LC.of(diff), f"{tag}/diff")
+    cs.compute(diff, lambda a, b: (a - b) % R, [x, y])
+    return is_zero(cs, diff, tag)
+
+
+def is_equal_const(cs: ConstraintSystem, x: int, k: int, tag: str = "iseqc") -> int:
+    """x == constant k, without a diff wire."""
+    inv = cs.new_wire(f"{tag}.inv")
+    out = cs.new_wire(f"{tag}.out")
+    cs.enforce(LC.of(x) - k, LC.of(inv), LC.const(1) - LC.of(out), f"{tag}/inv")
+    cs.enforce(LC.of(x) - k, LC.of(out), LC(), f"{tag}/zero")
+    cs.compute(inv, lambda v: pow((v - k) % R, R - 2, R) if (v - k) % R else 0, [x])
+    cs.compute(out, lambda v: 1 if v == k % R else 0, [x])
+    return out
+
+
+def less_than(cs: ConstraintSystem, n: int, a: int, b: int, tag: str = "lt") -> int:
+    """a < b for a, b < 2^n (circomlib LessThan: top bit of a - b + 2^n)."""
+    assert n < 252
+    shifted = cs.new_wire(f"{tag}.shift")
+    cs.enforce_eq(LC.of(a) - LC.of(b) + (1 << n), LC.of(shifted), f"{tag}/shift")
+    cs.compute(shifted, lambda x, y: (x - y + (1 << n)) % R, [a, b])
+    bits = num2bits(cs, shifted, n + 1, f"{tag}.bits")
+    out = cs.new_wire(f"{tag}.out")
+    cs.enforce_eq(LC.const(1) - LC.of(bits[n]), LC.of(out), f"{tag}/out")
+    cs.compute(out, lambda top: 1 - top, [bits[n]])
+    return out
+
+
+# ---------------------------------------------------------------- boolean
+
+
+def and_gate(cs: ConstraintSystem, a: int, b: int, tag: str = "and") -> int:
+    out = cs.new_wire(f"{tag}.out")
+    cs.enforce(LC.of(a), LC.of(b), LC.of(out), tag)
+    cs.compute(out, lambda x, y: x * y % R, [a, b])
+    return out
+
+
+def multi_or(cs: ConstraintSystem, bits: Sequence[int], tag: str = "or") -> int:
+    """OR of boolean wires as NOT(sum == 0) (regex_helpers MultiOR:34-47)."""
+    total = cs.new_wire(f"{tag}.sum")
+    cs.enforce_eq(lc_sum(bits), LC.of(total), f"{tag}/sum")
+    cs.compute(total, lambda *bs: sum(bs) % R, list(bits))
+    z = is_zero(cs, total, f"{tag}.z")
+    out = cs.new_wire(f"{tag}.out")
+    cs.enforce_eq(LC.const(1) - LC.of(z), LC.of(out), f"{tag}/not")
+    cs.compute(out, lambda v: 1 - v, [z])
+    return out
+
+
+def mux2(cs: ConstraintSystem, sel: int, a: int, b: int, tag: str = "mux") -> int:
+    """sel ? b : a  (sel boolean)."""
+    out = cs.new_wire(f"{tag}.out")
+    cs.enforce(LC.of(sel), LC.of(b) - LC.of(a), LC.of(out) - LC.of(a), tag)
+    cs.compute(out, lambda s, x, y: y if s else x, [sel, a, b])
+    return out
+
+
+# ---------------------------------------------------------------- selectors
+
+
+def one_hot(cs: ConstraintSystem, idx: int, n: int, tag: str = "onehot") -> List[int]:
+    """Indicator wires ind[i] = (idx == i) with Σ ind = 1 and Σ i·ind = idx.
+
+    The two closing sums make the decomposition sound without per-lane
+    IsEqual inverses being trusted blindly."""
+    inds = [is_equal_const(cs, idx, i, f"{tag}.{i}") for i in range(n)]
+    cs.enforce_eq(lc_sum(inds), LC.const(1), f"{tag}/onehot")
+    cs.enforce_eq(lc_sum(inds, list(range(n))), LC.of(idx), f"{tag}/index")
+    return inds
+
+
+def quin_selector(cs: ConstraintSystem, idx: int, options: Sequence[int], tag: str = "quin") -> int:
+    """out = options[idx] (utils.circom QuinSelector:20-47): one-hot dot."""
+    inds = one_hot(cs, idx, len(options), tag)
+    out = cs.new_wire(f"{tag}.out")
+    terms = LC()
+    prods = []
+    for i, (ind, opt) in enumerate(zip(inds, options)):
+        p = and_gate(cs, ind, opt, f"{tag}.p{i}")
+        prods.append(p)
+    cs.enforce_eq(lc_sum(prods), LC.of(out), f"{tag}/sum")
+    cs.compute(out, lambda *ps: sum(ps) % R, prods)
+    return out
+
+
+# ----------------------------------------------------------------- packing
+
+
+def pack_bytes(cs: ConstraintSystem, byte_wires: Sequence[int], n_per: int = 7, tag: str = "pack") -> List[int]:
+    """Pack byte wires into little-endian n_per-byte field words
+    (utils.circom Bytes2Packed:120-172; 7 bytes/signal keeps values < 2^56).
+    Bytes must already be range-checked to 8 bits by the producer."""
+    out = []
+    for chunk_i in range(0, len(byte_wires), n_per):
+        chunk = byte_wires[chunk_i : chunk_i + n_per]
+        w = cs.new_wire(f"{tag}.word{chunk_i // n_per}")
+        cs.enforce_eq(lc_sum(chunk, [1 << (8 * j) for j in range(len(chunk))]), LC.of(w), f"{tag}/word")
+        cs.compute(w, lambda *bs: sum(b << (8 * j) for j, b in enumerate(bs)) % R, list(chunk))
+        out.append(w)
+    return out
+
+
+def assert_bytes(cs: ConstraintSystem, wires: Sequence[int], tag: str = "byte") -> List[List[int]]:
+    """Range-check wires to 8 bits; returns the bit decompositions."""
+    return [num2bits(cs, w, 8, f"{tag}.{i}") for i, w in enumerate(wires)]

@@ -1,0 +1,221 @@
+"""DFA regex matching as R1CS: one-hot state recurrence over bytes.
+
+Our rebuild of the generated regex circuits (`venmo_offramper_id_regex
+.circom:29-217`, `dkim_header_regex.circom`, `body_hash_regex.circom`,
+`gen.py:64-163` codegen): instead of emitting circom source per regex, ONE
+generic gadget consumes the compiled DFA table (regexc.compiler.DFA).
+
+Per byte t:   s_{t+1}[d] = Σ_{(s,d,cls)} s_t[s] · ind_cls(byte_t)
+where ind_cls is a char-class membership indicator built from range /
+equality tests against constants (the lt/eq component pattern of
+`gen.py:64-163`), memoised per (byte, class) so overlapping regexes and
+shared classes pay once.
+
+Outputs mirror the reference templates: per-step one-hot state wires, a
+match count (`out === 2` style checks, `circuit.circom:106,119`), and
+reveal masks `reveal[i] = in[i] * states[i+1][j]` (`gen.py:214-217`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Sequence, Tuple
+
+from ..field.bn254 import R
+from ..regexc.compiler import DEAD, DFA
+from ..snark.r1cs import LC, ConstraintSystem
+from .core import and_gate, lc_sum, num2bits
+
+
+def _ranges(chars: FrozenSet[int]) -> List[Tuple[int, int]]:
+    xs = sorted(chars)
+    out = []
+    lo = prev = xs[0]
+    for c in xs[1:]:
+        if c == prev + 1:
+            prev = c
+            continue
+        out.append((lo, prev))
+        lo = prev = c
+    out.append((lo, prev))
+    return out
+
+
+class CharClassCache:
+    """Shared per-byte machinery for char-class membership tests.
+
+    Each byte gets lazily-built NIBBLE one-hots (24 constraints per nibble,
+    48 per byte, shared by every class of every regex on that byte).  A
+    class indicator then groups its chars by high nibble: the low-nibble
+    part is a free LC over the low one-hot, so the indicator costs ONE
+    multiplication per populated high nibble (<= 4 for the email classes)
+    plus one closing sum.  This is what keeps multi-regex scans at
+    reference-level constraint counts (the naive lt/eq-per-range form was
+    ~25 constraints per class per byte — 60% of the whole circuit)."""
+
+    def __init__(self, cs: ConstraintSystem):
+        self.cs = cs
+        self._bits: Dict[int, List[int]] = {}
+        self._nib: Dict[int, Tuple[List[int], List[int]]] = {}  # byte -> (lo16, hi16)
+        self._cls: Dict[Tuple[int, FrozenSet[int]], int] = {}
+
+    def register_bits(self, byte: int, bits: List[int]) -> None:
+        """Reuse an existing 8-bit decomposition (e.g. from assert_bytes)."""
+        self._bits.setdefault(byte, bits)
+
+    def _nibble_onehot(self, bits4: List[int], tag: str) -> List[int]:
+        cs = self.cs
+        pair0: List[int] = []  # one-hot of bits4[0:2]
+        for v in range(4):
+            w = cs.new_wire(f"{tag}.p{v}")
+            a = LC.of(bits4[0]) if v & 1 else LC.const(1) - LC.of(bits4[0])
+            b = LC.of(bits4[1]) if v & 2 else LC.const(1) - LC.of(bits4[1])
+            cs.enforce(a, b, LC.of(w), f"{tag}/p")
+            cs.compute(
+                w,
+                lambda b0, b1, vv=v: int(b0 == (vv & 1) and b1 == ((vv >> 1) & 1)),
+                [bits4[0], bits4[1]],
+            )
+            pair0.append(w)
+        pair1: List[int] = []  # one-hot of bits4[2:4]
+        for v in range(4):
+            w = cs.new_wire(f"{tag}.q{v}")
+            a = LC.of(bits4[2]) if v & 1 else LC.const(1) - LC.of(bits4[2])
+            b = LC.of(bits4[3]) if v & 2 else LC.const(1) - LC.of(bits4[3])
+            cs.enforce(a, b, LC.of(w), f"{tag}/q")
+            cs.compute(
+                w,
+                lambda b2, b3, vv=v: int(b2 == (vv & 1) and b3 == ((vv >> 1) & 1)),
+                [bits4[2], bits4[3]],
+            )
+            pair1.append(w)
+        out: List[int] = []
+        for v in range(16):
+            w = cs.new_wire(f"{tag}.n{v}")
+            cs.enforce(LC.of(pair0[v & 3]), LC.of(pair1[v >> 2]), LC.of(w), f"{tag}/n")
+            cs.compute(w, lambda x, y: x * y, [pair0[v & 3], pair1[v >> 2]])
+            out.append(w)
+        return out
+
+    def _nibbles(self, byte: int) -> Tuple[List[int], List[int]]:
+        if byte not in self._nib:
+            bits = self._bits.get(byte)
+            if bits is None:
+                bits = num2bits(self.cs, byte, 8, "re.bits")
+                self._bits[byte] = bits
+            lo = self._nibble_onehot(bits[0:4], "re.lo")
+            hi = self._nibble_onehot(bits[4:8], "re.hi")
+            self._nib[byte] = (lo, hi)
+        return self._nib[byte]
+
+    def eq_const(self, byte: int, c: int) -> int:
+        return self.indicator(byte, frozenset([c]))
+
+    def in_range(self, byte: int, lo: int, hi: int) -> int:
+        return self.indicator(byte, frozenset(range(lo, hi + 1)))
+
+    def indicator(self, byte: int, chars: FrozenSet[int]) -> int:
+        key = (byte, chars)
+        if key in self._cls:
+            return self._cls[key]
+        cs = self.cs
+        lo16, hi16 = self._nibbles(byte)
+        by_hi: Dict[int, List[int]] = {}
+        for c in chars:
+            by_hi.setdefault(c >> 4, []).append(c & 0xF)
+        parts: List[int] = []
+        full_his: List[int] = []
+        for h, los in sorted(by_hi.items()):
+            if len(los) == 16:
+                full_his.append(hi16[h])  # whole row: no product needed
+                continue
+            p = cs.new_wire("re.cls.p")
+            mask = lc_sum([lo16[l] for l in los])
+            cs.enforce(LC.of(hi16[h]), mask, LC.of(p), "re.cls/p")
+            cs.compute(
+                p,
+                lambda hv, *lvs: hv * (sum(lvs) % R),
+                [hi16[h]] + [lo16[l] for l in los],
+            )
+            parts.append(p)
+        if not parts and len(full_his) == 1:
+            out = full_his[0]
+        elif len(parts) == 1 and not full_his:
+            out = parts[0]
+        else:
+            out = cs.new_wire("re.cls")
+            cs.enforce_eq(lc_sum(parts + full_his), LC.of(out), "re.cls/sum")
+            cs.compute(out, lambda *ps: sum(ps), parts + full_his)
+        self._cls[key] = out
+        return out
+
+
+def dfa_scan(
+    cs: ConstraintSystem,
+    byte_wires: Sequence[int],
+    dfa: DFA,
+    cache: CharClassCache | None = None,
+    tag: str = "re",
+) -> List[List[int]]:
+    """Run the DFA over byte wires; returns states[t][s] one-hot wires for
+    t in 0..T (states[0] pinned to start).  Dead state is implicit: when no
+    transition fires, all lanes go 0 (Σ state can drop to 0 and stays 0)."""
+    cache = cache or CharClassCache(cs)
+    S = dfa.n_states
+    trans = dfa.transitions()
+
+    s0 = []
+    for j in range(S):
+        w = cs.new_wire(f"{tag}.s0.{j}")
+        cs.enforce_eq(LC.of(w), LC.const(1 if j == 0 else 0), f"{tag}/init")
+        cs.compute(w, lambda v=1 if j == 0 else 0: v, [])
+        s0.append(w)
+    states = [s0]
+
+    for t, byte in enumerate(byte_wires):
+        prev = states[-1]
+        terms_by_dst: Dict[int, List[int]] = {}
+        for src, dst, chars in trans:
+            ind = cache.indicator(byte, chars)
+            p = and_gate(cs, prev[src], ind, f"{tag}.t{t}.{src}.{dst}")
+            terms_by_dst.setdefault(dst, []).append(p)
+        nxt = []
+        for j in range(S):
+            w = cs.new_wire(f"{tag}.s{t + 1}.{j}")
+            ts = terms_by_dst.get(j, [])
+            cs.enforce_eq(lc_sum(ts), LC.of(w), f"{tag}/step")
+            cs.compute(w, lambda *ps: sum(ps), ts)
+            nxt.append(w)
+        states.append(nxt)
+    return states
+
+
+def match_count(cs: ConstraintSystem, states: List[List[int]], accept: FrozenSet[int], tag: str = "re.cnt") -> int:
+    """Number of steps landing in an accept state (the template's `out`
+    signal; main circuit asserts exact counts, `circuit.circom:106,119`)."""
+    out = cs.new_wire(tag)
+    acc_wires = [states[t][a] for t in range(1, len(states)) for a in accept]
+    cs.enforce_eq(lc_sum(acc_wires), LC.of(out), tag)
+    cs.compute(out, lambda *vs: sum(vs), acc_wires)
+    return out
+
+
+def reveal_bytes(
+    cs: ConstraintSystem,
+    byte_wires: Sequence[int],
+    states: List[List[int]],
+    reveal_states: Sequence[int],
+    tag: str = "re.rev",
+) -> List[int]:
+    """reveal[i] = byte[i] * (state_{i+1} in reveal_states)
+    (`gen.py:214-217`: the extraction mask for payee ID / amount)."""
+    out = []
+    for i, byte in enumerate(byte_wires):
+        mask_wires = [states[i + 1][s] for s in reveal_states]
+        if len(mask_wires) == 1:
+            mask = mask_wires[0]
+        else:
+            mask = cs.new_wire(f"{tag}.m{i}")
+            cs.enforce_eq(lc_sum(mask_wires), LC.of(mask), f"{tag}/mask")
+            cs.compute(mask, lambda *vs: sum(vs), mask_wires)
+        out.append(and_gate(cs, byte, mask, f"{tag}.{i}"))
+    return out

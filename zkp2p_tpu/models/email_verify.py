@@ -1,0 +1,128 @@
+"""EmailVerify — the generic DKIM email circuit family.
+
+Rebuild of `zk-email-verify-circuits/email.circom:15-222`
+(`EmailVerify(max_header_bytes, max_body_bytes, n, k)`) — the
+architectural ancestor of the Venmo circuit: header SHA-256 + RSA-2048 +
+DKIM to/from regex + bh= extraction + partial body SHA + base64 check,
+WITHOUT the Venmo-specific extraction; plus an optional body regex with
+packed reveal output (instantiated here with `TwitterResetRegex`
+semantics, `twitter_reset_regex.circom:5`, to complete the family).
+
+Public signal layout: [modulus (k) | reveal words (n_reveal_words)] —
+matching EmailVerify's `public [modulus]` + packed reveal outputs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..field.bn254 import R
+from ..gadgets import base64 as b64
+from ..gadgets import core, rsa, sha256
+from ..gadgets.regex import CharClassCache, dfa_scan, match_count, reveal_bytes
+from ..regexc import compiler as regexc
+from ..snark.r1cs import LC, ConstraintSystem
+
+
+@dataclass
+class EmailVerifyParams:
+    max_header_bytes: int = 1024
+    max_body_bytes: int = 1536
+    n: int = 121
+    k: int = 17
+    bh_b64_len: int = 44
+    # optional body extraction (None = header/bh/body-hash checks only)
+    body_regex: Optional[str] = regexc.TWITTER_RESET
+    reveal_len: int = 21  # bytes -> 3 packed words
+    dkim_match_count: int = 2
+
+
+@dataclass
+class EmailVerifyLayout:
+    modulus: List[int] = field(default_factory=list)
+    reveal_words: List[int] = field(default_factory=list)
+    header: List[int] = field(default_factory=list)
+    header_blocks: int = 0
+    signature: List[int] = field(default_factory=list)
+    body: List[int] = field(default_factory=list)
+    body_blocks: int = 0
+    midstate_bits: List[int] = field(default_factory=list)
+    body_hash_idx: int = 0
+    reveal_idx: int = 0
+
+
+def build_email_verify(p: EmailVerifyParams):
+    assert p.max_header_bytes % 64 == 0 and p.max_body_bytes % 64 == 0
+    cs = ConstraintSystem("email_verify")
+    lay = EmailVerifyLayout()
+
+    lay.modulus = [cs.new_public(f"modulus[{i}]") for i in range(p.k)]
+    n_words = (p.reveal_len + 6) // 7 if p.body_regex else 0
+    lay.reveal_words = [cs.new_public(f"reveal[{i}]") for i in range(n_words)]
+
+    lay.header = cs.new_wires(p.max_header_bytes, "in_padded")
+    lay.header_blocks = cs.new_wire("in_len_blocks")
+    lay.signature = cs.new_wires(p.k, "signature")
+    lay.body = cs.new_wires(p.max_body_bytes, "in_body_padded")
+    lay.body_blocks = cs.new_wire("in_body_len_blocks")
+    lay.midstate_bits = cs.new_wires(256, "precomputed_sha")
+    lay.body_hash_idx = cs.new_wire("body_hash_idx")
+    if p.body_regex:
+        lay.reveal_idx = cs.new_wire("reveal_idx")
+
+    header_bits = core.assert_bytes(cs, lay.header, "hdr")
+    body_bits = core.assert_bytes(cs, lay.body, "body")
+    for w in lay.midstate_bits:
+        cs.enforce_bool(w, "midstate")
+
+    digest_bits = sha256.sha256_blocks(cs, header_bits, lay.header_blocks, tag="sha_hdr")
+    rsa.rsa_verify_65537(cs, lay.signature, lay.modulus, digest_bits, p.n, p.k, "rsa")
+
+    cache = CharClassCache(cs)
+    for w, bits in zip(lay.header, header_bits):
+        cache.register_bits(w, bits)
+    for w, bits in zip(lay.body, body_bits):
+        cache.register_bits(w, bits)
+
+    sentinel = cs.new_wire("sentinel80")
+    cs.enforce_eq(LC.of(sentinel), LC.const(0x80), "sentinel")
+    cs.compute(sentinel, lambda: 0x80, [])
+    dkim_dfa = regexc.search_dfa(regexc.DKIM_HEADER)
+    dkim_states = dfa_scan(cs, [sentinel] + list(lay.header), dkim_dfa, cache, "dkim")
+    dkim_cnt = match_count(cs, dkim_states, dkim_dfa.accept, "dkim.cnt")
+    cs.enforce_eq(LC.of(dkim_cnt), LC.const(p.dkim_match_count), "dkim/count")
+
+    bh_dfa = regexc.search_dfa(regexc.BODY_HASH)
+    bh_states = dfa_scan(cs, list(lay.header), bh_dfa, cache, "bh")
+    bh_cnt = match_count(cs, bh_states, bh_dfa.accept, "bh.cnt")
+    cs.enforce_eq(LC.of(bh_cnt), LC.const(1), "bh/count")
+
+    bh_onehot = core.one_hot(cs, lay.body_hash_idx, p.max_header_bytes - p.bh_b64_len, "bh.idx")
+    from .venmo import _shift_window
+
+    bh_chars = _shift_window(cs, lay.header, bh_onehot, p.bh_b64_len, "bh.shift")
+    decoded = b64.base64_decode_bits(cs, bh_chars, cache, "bh.dec")
+
+    mid_words = [lay.midstate_bits[32 * i : 32 * i + 32] for i in range(8)]
+    body_digest = sha256.sha256_blocks(cs, body_bits, lay.body_blocks, init_state=mid_words, tag="sha_body")
+    for byte_i in range(32):
+        wrd, b_in_w = divmod(byte_i, 4)
+        for bit in range(8):
+            cs.enforce_eq(
+                LC.of(decoded[byte_i][bit]),
+                LC.of(body_digest[32 * wrd + 8 * (3 - b_in_w) + bit]),
+                "bh/eq",
+            )
+
+    if p.body_regex:
+        dfa = regexc.search_dfa(p.body_regex)
+        states = dfa_scan(cs, list(lay.body), dfa, cache, "brx")
+        reveal = reveal_bytes(cs, lay.body, states, sorted(dfa.accept), "brx.rev")
+        onehot = core.one_hot(cs, lay.reveal_idx, p.max_body_bytes - p.reveal_len, "brx.idx")
+        chars = _shift_window(cs, reveal, onehot, p.reveal_len, "brx.shift")
+        words = core.pack_bytes(cs, chars, 7, "brx.pack")
+        for w, pub in zip(words, lay.reveal_words):
+            cs.enforce_eq(LC.of(w), LC.of(pub), "brx/out")
+
+    return cs, lay

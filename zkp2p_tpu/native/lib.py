@@ -1,0 +1,89 @@
+"""ctypes bridge to the native BN254 library (csrc/zkp2p_native.cpp).
+
+The C++ runtime layer of the framework (the role rapidsnark's native
+field library plays in the reference, SURVEY.md §2.2) — loaded lazily,
+built on demand with make, and everything degrades to the pure-Python
+path when a toolchain is unavailable, so imports never hard-fail.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "libzkp2p_native.so")
+
+_lib = None
+_tried = False
+
+
+def _int_to_u64x4(x: int) -> np.ndarray:
+    return np.array([(x >> (64 * i)) & ((1 << 64) - 1) for i in range(4)], dtype=np.uint64)
+
+
+def _u64x4_to_int(a) -> int:
+    return int(a[0]) | int(a[1]) << 64 | int(a[2]) << 128 | int(a[3]) << 192
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.g1_fixed_base_batch.argtypes = [u64p, u64p, ctypes.c_int, u64p]
+    lib.fp_mul_std.argtypes = [u64p, u64p, u64p]
+    # quick self-check against Python ints before trusting it
+    from ..field.bn254 import P
+
+    a, b = 0x1234567890ABCDEF << 120 | 0x42, P - 12345
+    av, bv, cv = _int_to_u64x4(a), _int_to_u64x4(b), np.zeros(4, dtype=np.uint64)
+    lib.fp_mul_std(
+        av.ctypes.data_as(u64p), bv.ctypes.data_as(u64p), cv.ctypes.data_as(u64p)
+    )
+    if _u64x4_to_int(cv) != a * b % P:
+        return None
+    _lib = lib
+    return _lib
+
+
+def g1_fixed_base_batch(base: Tuple[int, int], scalars: Sequence[int]) -> Optional[List]:
+    """Batch k_i * base over G1; None if the native lib is unavailable.
+    Returns affine (x, y) int tuples, None entries for infinity."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(scalars)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    base_arr = np.concatenate([_int_to_u64x4(base[0]), _int_to_u64x4(base[1])])
+    sc = np.zeros((n, 4), dtype=np.uint64)
+    for i, s in enumerate(scalars):
+        sc[i] = _int_to_u64x4(int(s))
+    out = np.zeros((n, 8), dtype=np.uint64)
+    lib.g1_fixed_base_batch(
+        base_arr.ctypes.data_as(u64p),
+        sc.ctypes.data_as(u64p),
+        n,
+        out.ctypes.data_as(u64p),
+    )
+    res = []
+    for i in range(n):
+        x = _u64x4_to_int(out[i, :4])
+        y = _u64x4_to_int(out[i, 4:])
+        res.append(None if x == 0 and y == 0 else (x, y))
+    return res

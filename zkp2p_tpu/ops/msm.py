@@ -1,0 +1,185 @@
+"""Multi-scalar multiplication on TPU lanes.
+
+The reference's MSMs live inside snarkjs `groth16 prove` (WASM) and
+rapidsnark (C++ threads + x86 asm): 4 G1 MSMs + 1 G2 MSM over ~6.6M
+scalars per proof (SURVEY.md §3.1 hot loop 2).  TPUs have no fast random
+scatter, so bucket accumulation is reformulated as branchless dataflow
+(SURVEY.md §7 hard part #2):
+
+  1. 256 bit-plane partial sums, all planes in parallel as a 256-lane
+     batch axis: plane_sums[p] = sum_i bit[p,i] * P_i.
+  2. The base-point axis is consumed chunk by chunk inside ONE `lax.scan`
+     (fixed chunk shape -> one compiled body reused for every chunk;
+     XLA compile time scales with traced-graph size, so shape reuse is a
+     design constraint here, not a nicety).  Each chunk is masked and
+     pairwise tree-reduced (log2(chunk) complete adds).
+  3. A second 256-step scan folds the plane sums MSB-first:
+     acc = 2*acc + plane_sums[p].
+
+Cost: ~256 point-adds per base point, fully vectorised, zero scatter /
+sort / data-dependent control flow.  (Windowed Pippenger via sorted
+segment scans is the planned fast path in kernels/; this is the portable
+XLA formulation that the rest of the stack is tested against.)
+
+Sharding: split the N axis across devices, run the same scan per shard,
+then one `add` tree over the per-device partials (an ICI all-reduce with
+the group op) — see zkp2p_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..curve.jcurve import AffPoint, JacPoint, JCurve
+
+SCALAR_BITS = 256
+
+
+def bit_planes_from_limbs(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Standard-form scalar limbs (..., n, 16) uint32 -> (256, ..., n) planes,
+    MSB first (plane 0 = bit 255).
+
+    Device-side twin of `jcurve.scalar_bit_planes` so witness values produced
+    on device never round-trip to the host."""
+    planes = []
+    for j in range(SCALAR_BITS - 1, -1, -1):
+        planes.append((limbs[..., j // 16] >> (j % 16)) & 1)
+    return jnp.stack(planes)
+
+
+def tree_reduce(curve: JCurve, pts: JacPoint, axis_len: int) -> JacPoint:
+    """Sum `axis_len` Jacobian points along axis -1-of-batch (the last batch
+    axis) by pairwise halving; all other batch axes stay vectorised."""
+    n = axis_len
+    ax = -1 - curve.F.zero_limbs.ndim  # the reduced batch axis
+    while n > 1:
+        if n % 2:
+            pad_cfg = [(0, 0)] * pts[0].ndim
+            pad_cfg[ax] = (0, 1)
+            pts = tuple(jnp.pad(c, pad_cfg) for c in pts)  # zero = infinity
+            n += 1
+        lo = tuple(jax.lax.slice_in_dim(c, 0, n // 2, axis=ax) for c in pts)
+        hi = tuple(jax.lax.slice_in_dim(c, n // 2, n, axis=ax) for c in pts)
+        pts = curve.add(lo, hi)
+        n //= 2
+    return tuple(jnp.squeeze(c, axis=ax) for c in pts)
+
+
+def digit_planes_from_limbs(limbs: jnp.ndarray, window: int = 4) -> jnp.ndarray:
+    """Standard-form scalar limbs (..., n, 16) -> (256/window, ..., n)
+    base-2^window digit planes, most significant first."""
+    assert 16 % window == 0
+    planes = []
+    mask = (1 << window) - 1
+    for j in range(SCALAR_BITS - window, -1, -window):
+        planes.append((limbs[..., j // 16] >> (j % 16)) & mask)
+    return jnp.stack(planes)
+
+
+def msm_windowed(curve: JCurve, bases: AffPoint, digit_planes: jnp.ndarray, lanes: int = 64, window: int = 4) -> JacPoint:
+    """Windowed MSM: ~(2^window - 2 + 256/window) adds per point instead of
+    256 (window=4 -> ~78, a 3.3x work cut vs `msm`).
+
+    Per chunk step the (lanes,) points expand into a 2^window multiples
+    table (built with 2^window - 2 adds on narrow lanes); each digit plane
+    then SELECTS its multiple (cheap wheres) and does one masked
+    accumulate on the (n_planes, lanes) batch.  Same zero-scatter dataflow,
+    same one-adder-per-scan-body compile discipline."""
+    n_digits = digit_planes.shape[0]
+    n = bases[0].shape[0]
+    lanes = min(lanes, n)
+    pad = (-n) % lanes
+    if pad:
+        bases = tuple(jnp.pad(c, [(0, pad)] + [(0, 0)] * (c.ndim - 1)) for c in bases)
+        digit_planes = jnp.pad(digit_planes, [(0, 0), (0, pad)])
+    steps = (n + pad) // lanes
+
+    pts = tuple(c.reshape((steps, lanes) + c.shape[1:]) for c in bases)
+    planes = digit_planes.reshape(n_digits, steps, lanes).transpose(1, 0, 2)
+
+    n_mult = 1 << window
+
+    def accumulate(acc, xs):
+        pt, digits = xs  # pt: (lanes, elem) affine; digits: (n_digits, lanes)
+        base_jac = curve.from_affine(pt)
+
+        def table_step(prev, _):
+            nxt = curve.add_mixed(prev, pt)
+            return nxt, prev
+
+        # multiples 1..2^w-1: scan collects [1P..(2^w-1)P] (ys = prev of each step)
+        last, stacked = jax.lax.scan(table_step, base_jac, None, length=n_mult - 1)
+        # stacked: (2^w-1, lanes, elem) = [1P, 2P, ..., (2^w-1)P]
+        table = tuple(
+            jnp.concatenate([jnp.zeros_like(c[:1]), c], axis=0) for c in stacked
+        )  # index 0 = infinity
+
+        lane_ix = jnp.arange(digits.shape[-1])[None, :]
+        sel = tuple(c[digits, lane_ix] for c in table)  # per-lane multiple -> (n_digits, lanes, elem)
+        nxt = curve.add(acc, sel)
+        return curve.select(digits != 0, nxt, acc), None
+
+    partials, _ = jax.lax.scan(accumulate, curve.infinity((n_digits, lanes)), (pts, planes))
+
+    def fold_planes(acc, ps):
+        for _ in range(window):
+            acc = curve.double(acc)
+        return curve.add(acc, ps), None
+
+    per_lane, _ = jax.lax.scan(fold_planes, curve.infinity((lanes,)), tuple(c for c in partials))
+
+    def fold_lanes(acc, p):
+        return curve.add(acc, p), None
+
+    total, _ = jax.lax.scan(fold_lanes, curve.infinity(()), per_lane)
+    return total
+
+
+def msm(curve: JCurve, bases: AffPoint, bit_planes: jnp.ndarray, lanes: int = 64) -> JacPoint:
+    """MSM: sum_i s_i * P_i -> one Jacobian point.
+
+    bases: affine limb arrays, leading axis N ((0,0) lanes = infinity, e.g.
+    zkey padding or public-wire holes in the c_query).
+    bit_planes: (256, N) uint32 from `bit_planes_from_limbs` /
+    `scalar_bit_planes`.
+
+    Three nested scans, each with a ONE-adder body (XLA compile time scales
+    with traced-graph size, so every body is exactly one curve-add graph):
+      1. over N/lanes steps: masked `add_mixed` into (256, lanes) partials
+      2. over 256 planes per lane: MSB-first double-and-add fold -> (lanes,)
+      3. over lanes: plain add fold -> scalar point
+    Work: ~256 mixed-adds per base point; step granularity (256·lanes
+    lanes per step) keeps the VPU busy and loop overhead amortised."""
+    n = bases[0].shape[0]
+    lanes = min(lanes, n)
+    pad = (-n) % lanes
+    if pad:
+        bases = tuple(jnp.pad(c, [(0, pad)] + [(0, 0)] * (c.ndim - 1)) for c in bases)
+        bit_planes = jnp.pad(bit_planes, [(0, 0), (0, pad)])
+    steps = (n + pad) // lanes
+
+    # point i = step*lanes + lane; planes: (steps, 256, lanes)
+    pts = tuple(c.reshape((steps, lanes) + c.shape[1:]) for c in bases)
+    planes = bit_planes.reshape(SCALAR_BITS, steps, lanes).transpose(1, 0, 2)
+
+    def accumulate(acc, xs):
+        pt, bits = xs  # pt: (lanes, elem) affine, bits: (256, lanes)
+        bcast = tuple(jnp.broadcast_to(c[None], (SCALAR_BITS,) + c.shape) for c in pt)
+        nxt = curve.add_mixed(acc, bcast)
+        return curve.select(bits.astype(bool), nxt, acc), None
+
+    partials, _ = jax.lax.scan(accumulate, curve.infinity((SCALAR_BITS, lanes)), (pts, planes))
+
+    def fold_planes(acc, ps):
+        return curve.add(curve.double(acc), ps), None
+
+    per_lane, _ = jax.lax.scan(
+        fold_planes, curve.infinity((lanes,)), tuple(c for c in partials)
+    )
+
+    def fold_lanes(acc, p):
+        return curve.add(acc, p), None
+
+    total, _ = jax.lax.scan(fold_lanes, curve.infinity(()), per_lane)
+    return total

@@ -1,0 +1,106 @@
+"""Radix-2 NTT / iNTT over BN254 Fr on TPU lanes.
+
+The reference's H-polynomial FFTs run inside snarkjs/rapidsnark over the
+2^23-point domain (6.6M constraints -> next pow2; SURVEY.md §2.7, §7 step 3).
+Here each stage is a reshape + one batched Montgomery mul + add/sub —
+pure elementwise dataflow on (..., m, 16) limb tensors, `vmap`-able over
+proof batches and shardable over the coefficient axis (all-to-all at the
+stage boundary where the butterfly stride crosses the shard width).
+
+Twiddle tables are generated ON DEVICE in log m doubling steps
+(`_twiddle_powers`), so domain setup for 2^23 costs m Montgomery muls on
+TPU instead of m Python bigint muls on host.
+
+Differentially tested against the host oracle `snark.fft_host` (itself
+exercised by the Groth16 host tests).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field.bn254 import R, fr_domain_root, fr_inv
+from ..field.jfield import FR, NUM_LIMBS
+
+
+def _bit_reverse_perm(m: int) -> np.ndarray:
+    k = m.bit_length() - 1
+    idx = np.arange(m)
+    rev = np.zeros(m, dtype=np.int64)
+    for b in range(k):
+        rev |= ((idx >> b) & 1) << (k - 1 - b)
+    return rev
+
+
+def _twiddle_powers(w: int, count: int) -> jnp.ndarray:
+    """[w^0 .. w^(count-1)] in Montgomery form, built by log2(count) doublings:
+    powers[j + 2^i] = powers[j] * w^(2^i)."""
+    cur = FR.one_mont[None, :]
+    e = 1
+    while cur.shape[0] < count:
+        factor = jnp.asarray(FR.to_mont_host(pow(w, e, R)))
+        cur = jnp.concatenate([cur, FR.mul(cur, factor)], axis=0)
+        e *= 2
+    return cur[:count]
+
+
+@lru_cache(maxsize=None)
+def domain(log_m: int):
+    """Precomputed tables for the 2^log_m domain (cached per process).
+
+    Built under `ensure_compile_time_eval` so a first call from inside a
+    traced function still produces concrete device arrays (safe to cache)."""
+    m = 1 << log_m
+    w = fr_domain_root(log_m)
+    with jax.ensure_compile_time_eval():
+        return {
+            "m": m,
+            "perm": _bit_reverse_perm(m),
+            "tw": _twiddle_powers(w, m // 2),
+            "tw_inv": _twiddle_powers(fr_inv(w), m // 2),
+            "m_inv_mont": jnp.asarray(FR.to_mont_host(fr_inv(m))),
+        }
+
+
+def _ntt_core(x: jnp.ndarray, tw: jnp.ndarray, perm: np.ndarray) -> jnp.ndarray:
+    """Iterative DIT butterfly ladder on (..., m, 16) Montgomery limbs."""
+    m = x.shape[-2]
+    x = x[..., perm, :]
+    length = 1
+    while length < m:
+        # Stage twiddles: w^(j * m/(2*length)) for j < length.
+        stage_tw = tw[:: m // (2 * length)][:length]  # (length, 16)
+        blocks = x.reshape(x.shape[:-2] + (m // (2 * length), 2, length, NUM_LIMBS))
+        a = blocks[..., 0, :, :]
+        b = FR.mul(blocks[..., 1, :, :], stage_tw)
+        x = jnp.concatenate([FR.add(a, b)[..., None, :, :], FR.sub(a, b)[..., None, :, :]], axis=-3)
+        x = x.reshape(x.shape[:-4] + (m, NUM_LIMBS))
+        length *= 2
+    return x
+
+
+def ntt(x: jnp.ndarray, log_m: int) -> jnp.ndarray:
+    """Evaluations of the coefficient vector on the 2^log_m roots domain."""
+    d = domain(log_m)
+    return _ntt_core(x, d["tw"], d["perm"])
+
+
+def intt(x: jnp.ndarray, log_m: int) -> jnp.ndarray:
+    d = domain(log_m)
+    y = _ntt_core(x, d["tw_inv"], d["perm"])
+    return FR.mul(y, d["m_inv_mont"])
+
+
+@lru_cache(maxsize=None)
+def _coset_powers(g: int, log_m: int) -> jnp.ndarray:
+    with jax.ensure_compile_time_eval():
+        return _twiddle_powers(g, 1 << log_m)
+
+
+def coset_shift(coeffs: jnp.ndarray, g: int, log_m: int) -> jnp.ndarray:
+    """coeff[i] *= g^i — moves evaluation onto the coset g*H (host scalar g)."""
+    return FR.mul(coeffs, _coset_powers(g, log_m))

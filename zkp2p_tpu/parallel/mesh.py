@@ -1,0 +1,88 @@
+"""Device-mesh parallelism: sharded MSM + batched proving over ICI.
+
+The reference's only parallelism is artifact chunking + rapidsnark's
+shared-memory threads (SURVEY.md §2.7); the TPU build gets real
+distributed axes:
+
+  - "batch": data parallelism over independent proofs (vmap + sharding),
+    the batched-onramp configuration of BASELINE.json.
+  - "shard": model parallelism over the MSM base-point axis — each device
+    accumulates bucket/plane partial sums for its slice of the zkey, and
+    ONE group-operation all-reduce (all_gather + local Jacobian fold)
+    combines them over ICI.  This is the Pippenger partial-sum allreduce
+    of SURVEY.md §2.7 expressed with XLA collectives instead of NCCL.
+
+Everything is `shard_map` over a `jax.sharding.Mesh`, so the same program
+runs on 1 chip, a v5e-8 slice, or (with a "dcn" outer axis) multi-host —
+the driver's `dryrun_multichip` exercises it on virtual CPU devices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..curve.jcurve import AffPoint, G1J, G2J, JacPoint, JCurve
+from ..ops.msm import SCALAR_BITS, msm
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
+    devs = jax.devices()[: n_devices or len(jax.devices())]
+    return Mesh(np.array(devs), (axis,))
+
+
+def _fold_gathered(curve: JCurve, gathered: JacPoint, n: int) -> JacPoint:
+    """Fold the per-device partial points (leading axis n) with a scan —
+    the 'reduce' half of the group-op all-reduce."""
+
+    def body(acc, p):
+        return curve.add(acc, p), None
+
+    acc, _ = jax.lax.scan(body, curve.infinity(()), gathered)
+    return acc
+
+
+def msm_sharded(
+    curve: JCurve,
+    bases: AffPoint,
+    bit_planes: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "shard",
+    lanes: int = 64,
+) -> JacPoint:
+    """MSM with the base-point axis sharded over `mesh`'s `axis`.
+
+    bases components must have N divisible by the mesh size (pad with the
+    (0,0) infinity sentinel + zero planes first).  Returns the full sum,
+    replicated on every device."""
+    n_dev = mesh.shape[axis]
+    n = bases[0].shape[0]
+    assert n % n_dev == 0, "pad the base axis to the mesh size first"
+
+    def local(bs, planes):
+        part = msm(curve, bs, planes, lanes=lanes)
+        gathered = jax.lax.all_gather(part, axis)  # (n_dev,) points on ICI
+        return _fold_gathered(curve, gathered, n_dev)
+
+    in_specs = (
+        tuple(P(axis) for _ in bases),
+        P(None, axis),
+    )
+    out_specs = tuple(P() for _ in range(3))
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+    return fn(bases, bit_planes)
+
+
+def pad_to_multiple(bases: AffPoint, bit_planes: jnp.ndarray, multiple: int) -> Tuple[AffPoint, jnp.ndarray]:
+    n = bases[0].shape[0]
+    pad = (-n) % multiple
+    if pad:
+        bases = tuple(jnp.pad(c, [(0, pad)] + [(0, 0)] * (c.ndim - 1)) for c in bases)
+        bit_planes = jnp.pad(bit_planes, [(0, 0), (0, pad)])
+    return bases, bit_planes

@@ -1,0 +1,217 @@
+"""The proving pipeline CLI — `prover=tpu` beside snarkjs/rapidsnark.
+
+Command-for-command parity with the reference's L2 scripts
+(`dizkus-scripts/1..6_*.sh`, `circuit/scripts/*`, SURVEY.md §2.3):
+
+  setup    ~ 1_compile.sh + 3_gen_both_zkeys.sh + 4_gen_vkey.sh +
+             generate_contract.sh: build the circuit, run the dev setup,
+             write keys.pkl + verification_key.json + verifier.sol
+  prove    ~ 2_gen_wtns.sh + 5/6_gen_proof: email/eml (or input.json) in,
+             proof.json + public.json out, TPU prover
+  verify   ~ verify_proof_groth16.sh: pairing check against the vkey
+  batch    ~ the batching service of BASELINE.json: a directory of inputs
+             proved as ONE vmapped batch
+
+Config is flags + env (CIRCUIT_NAME/BUILD_DIR convention of
+`dizkus-scripts/circuit.env.example`), centralised here instead of
+scattered shell env files (SURVEY.md §5 config).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import pickle
+import sys
+import time
+
+
+def _log(*a):
+    print("[zkp2p-tpu]", *a, file=sys.stderr, flush=True)
+
+
+def _build_circuit(name: str, header: int, body: int):
+    if name == "venmo":
+        from ..models.venmo import VenmoParams, build_venmo_circuit
+
+        params = VenmoParams(max_header_bytes=header, max_body_bytes=body)
+        cs, lay = build_venmo_circuit(params)
+        return cs, (params, lay)
+    if name == "sha256":
+        from ..gadgets import core, sha256
+        from ..snark.r1cs import ConstraintSystem
+
+        cs = ConstraintSystem("sha256")
+        msg = cs.new_wires(header, "msg")
+        bits = core.assert_bytes(cs, msg)
+        sha256.sha256_blocks(cs, bits, None)
+        return cs, (None, msg)
+    if name == "toy":
+        # smoke-test circuit: public out = (x*y)^2 over two byte inputs
+        from ..field.bn254 import R
+        from ..snark.r1cs import LC, ConstraintSystem
+
+        cs = ConstraintSystem("toy")
+        out = cs.new_public("out")
+        x = cs.new_wire("x")
+        y = cs.new_wire("y")
+        z = cs.new_wire("z")
+        cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+        cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
+        cs.compute(z, lambda a, b: a * b % R, [x, y])
+        return cs, (None, [x, y, out])
+    raise SystemExit(f"unknown circuit {name!r} (have: venmo, sha256, toy)")
+
+
+def cmd_setup(args):
+    from ..formats.proof_json import dump, vkey_to_json
+    from ..formats.solidity import export_verifier
+    from ..snark.groth16 import setup
+
+    os.makedirs(args.build_dir, exist_ok=True)
+    t0 = time.time()
+    _log(f"building circuit {args.circuit} ...")
+    cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
+    _log(f"constraints={cs.num_constraints} wires={cs.num_wires} ({time.time()-t0:.0f}s)")
+    _log("running development setup (production: import a ceremony zkey instead)")
+    pk, vk = setup(cs, seed=args.seed)
+    with open(os.path.join(args.build_dir, "keys.pkl"), "wb") as f:
+        pickle.dump((pk, vk), f)
+    dump(vkey_to_json(vk), os.path.join(args.build_dir, "verification_key.json"))
+    with open(os.path.join(args.build_dir, "verifier.sol"), "w") as f:
+        f.write(export_verifier(vk))
+    _log(f"setup done in {time.time()-t0:.0f}s -> {args.build_dir}/")
+
+
+def _load_keys(build_dir):
+    with open(os.path.join(build_dir, "keys.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+def _witness_for(args, cs, meta):
+    params, lay = meta
+    if args.circuit == "venmo":
+        from ..inputs.email import generate_inputs, make_test_key, make_venmo_email
+
+        if args.eml:
+            raise SystemExit("raw .eml parsing lands with the DKIM frontend; use --demo")
+        key = make_test_key(1)
+        email = make_venmo_email(key)
+        inputs = generate_inputs(email, key.n, args.order_id, args.claim_id, params, lay)
+        return cs.witness(inputs.public_signals, inputs.seed), inputs.public_signals
+    elif args.circuit == "toy":
+        from ..field.bn254 import R
+
+        data = (args.message or "35").encode().ljust(2, b"\x00")[:2]
+        x_v, y_v = data[0], data[1]
+        out_v = pow(x_v * y_v, 2, R)
+        x, y, _ = meta[1]
+        return cs.witness([out_v], {x: x_v, y: y_v}), [out_v]
+    else:
+        from ..inputs.sha_host import sha256_pad
+
+        data = (args.message or "zkp2p").encode()
+        padded, _ = sha256_pad(data, len(meta[1]))
+        return cs.witness([], {w: b for w, b in zip(meta[1], padded)}), []
+
+
+def cmd_prove(args):
+    from ..formats.proof_json import dump, proof_to_json, public_to_json
+    from ..prover.groth16_tpu import device_pk, prove_tpu
+
+    cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
+    pk, vk = _load_keys(args.build_dir)
+    dpk = device_pk(pk, cs)
+    w, pub = _witness_for(args, cs, meta)
+    t0 = time.time()
+    proof = prove_tpu(dpk, w)
+    _log(f"proved in {time.time()-t0:.1f}s (incl. first-call compile)")
+    dump(proof_to_json(proof), args.proof)
+    dump(public_to_json(pub or w[1 : cs.num_public + 1]), args.public)
+    _log(f"wrote {args.proof} {args.public}")
+
+
+def cmd_verify(args):
+    from ..formats.proof_json import load, proof_from_json, vkey_from_json
+    from ..snark.groth16 import verify
+
+    vk = vkey_from_json(load(os.path.join(args.build_dir, "verification_key.json")))
+    proof = proof_from_json(load(args.proof))
+    pub = [int(x) for x in load(args.public)]
+    ok = verify(vk, proof, pub)
+    print("OK" if ok else "INVALID")
+    sys.exit(0 if ok else 1)
+
+
+def cmd_batch(args):
+    """Prove every input in a directory as one vmapped batch."""
+    from ..formats.proof_json import dump, proof_to_json
+    from ..inputs.sha_host import sha256_pad
+    from ..prover.groth16_tpu import device_pk, prove_tpu_batch
+
+    cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
+    pk, vk = _load_keys(args.build_dir)
+    dpk = device_pk(pk, cs)
+    files = sorted(glob.glob(os.path.join(args.indir, "*.json")))
+    if not files:
+        raise SystemExit(f"no inputs in {args.indir}")
+    wits = []
+    for fp in files:
+        with open(fp) as f:
+            msg = json.load(f)["message"].encode()
+        padded, _ = sha256_pad(msg, len(meta[1]))
+        wits.append(cs.witness([], {w: b for w, b in zip(meta[1], padded)}))
+    t0 = time.time()
+    proofs = prove_tpu_batch(dpk, wits)
+    dt = time.time() - t0
+    _log(f"batch of {len(wits)} proved in {dt:.1f}s -> {len(wits)/dt:.2f} proofs/s")
+    os.makedirs(args.outdir, exist_ok=True)
+    for fp, proof in zip(files, proofs):
+        out = os.path.join(args.outdir, os.path.basename(fp).replace(".json", ".proof.json"))
+        dump(proof_to_json(proof), out)
+    _log(f"wrote {len(proofs)} proofs to {args.outdir}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("zkp2p-tpu", description=__doc__)
+    ap.add_argument("--build-dir", default=os.environ.get("BUILD_DIR", "build"))
+    ap.add_argument("--circuit", default=os.environ.get("CIRCUIT_NAME", "sha256"))
+    ap.add_argument("--max-header", type=int, default=256)
+    ap.add_argument("--max-body", type=int, default=192)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("setup", help="build circuit + dev keys + vkey + verifier.sol")
+    s.add_argument("--seed", default="zkp2p-tpu-dev")
+    s.set_defaults(fn=cmd_setup)
+
+    s = sub.add_parser("prove", help="prove one input on TPU")
+    s.add_argument("--eml", help="email file (venmo circuit)")
+    s.add_argument("--demo", action="store_true", help="use the synthetic signed email")
+    s.add_argument("--message", help="message (sha256 circuit)")
+    s.add_argument("--order-id", type=int, default=1)
+    s.add_argument("--claim-id", type=int, default=0)
+    s.add_argument("--proof", default="proof.json")
+    s.add_argument("--public", default="public.json")
+    s.set_defaults(fn=cmd_prove)
+
+    s = sub.add_parser("verify", help="verify proof.json against the vkey")
+    s.add_argument("--proof", default="proof.json")
+    s.add_argument("--public", default="public.json")
+    s.set_defaults(fn=cmd_verify)
+
+    s = sub.add_parser("batch", help="prove a directory of inputs as one batch")
+    s.add_argument("--indir", required=True)
+    s.add_argument("--outdir", required=True)
+    s.set_defaults(fn=cmd_batch)
+
+    args = ap.parse_args(argv)
+    from ..utils.jaxcfg import enable_cache
+
+    enable_cache()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""The batched proving service: queue -> pad to batch -> prove -> verify
+sample -> emit (the BASELINE.json north-star service shape).
+
+Failure semantics mirror the reference UI's explicit state machine
+(`SubmitOrderGenerateProofForm.tsx:45-56,171-220`): each request ends in
+  done | error-bad-input | error-failed-to-prove
+with the error recorded next to the request — no silent drops; plus the
+verify-after-prove self-check the pipeline scripts do
+(`5_gen_proof.sh:15-22` runs `snarkjs groth16 verify` right after prove).
+
+Requests are JSON files in a spool directory (the S3/queue stand-in);
+results and errors are written alongside.  Single-process, deliberately
+simple: the scheduling story (latency vs batch fill, SURVEY.md §7 hard
+part #6) is a bench-driven knob, not a framework constraint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..utils.trace import trace
+
+
+@dataclass
+class Request:
+    path: str
+    payload: Dict
+    witness: Optional[list] = None
+    error: Optional[str] = None
+
+
+class ProvingService:
+    def __init__(
+        self,
+        cs,
+        dpk,
+        vk,
+        witness_fn: Callable[[Dict], list],
+        public_fn: Callable[[list], list],
+        batch_size: int = 4,
+        max_wait_s: float = 2.0,
+    ):
+        """witness_fn: request payload -> witness vector (raises on bad
+        input); public_fn: witness -> public signals."""
+        self.cs = cs
+        self.dpk = dpk
+        self.vk = vk
+        self.witness_fn = witness_fn
+        self.public_fn = public_fn
+        self.batch_size = batch_size
+        self.max_wait_s = max_wait_s
+
+    # ------------------------------------------------------------ one pass
+
+    def process_dir(self, spool: str) -> Dict[str, int]:
+        """One spool sweep; returns counters. Files: <name>.req.json in,
+        <name>.proof.json / <name>.error.json out."""
+        from ..formats.proof_json import dump, proof_to_json, public_to_json
+        from ..prover.groth16_tpu import prove_tpu_batch
+        from ..snark.groth16 import verify
+
+        stats = {"done": 0, "error-bad-input": 0, "error-failed-to-prove": 0}
+        pending: List[Request] = []
+        for fn in sorted(os.listdir(spool)):
+            if not fn.endswith(".req.json"):
+                continue
+            base = fn[: -len(".req.json")]
+            if os.path.exists(os.path.join(spool, base + ".proof.json")) or os.path.exists(
+                os.path.join(spool, base + ".error.json")
+            ):
+                continue
+            with open(os.path.join(spool, fn)) as f:
+                pending.append(Request(path=os.path.join(spool, base), payload=json.load(f)))
+
+        # input validation stage
+        ready: List[Request] = []
+        for req in pending:
+            try:
+                with trace("service/witness"):
+                    req.witness = self.witness_fn(req.payload)
+                    self.cs.check_witness(req.witness)
+                ready.append(req)
+            except Exception as e:  # noqa: BLE001 — recorded, not silenced
+                req.error = f"error-bad-input: {e}"
+                self._emit_error(req, "error-bad-input", e)
+                stats["error-bad-input"] += 1
+
+        for i in range(0, len(ready), self.batch_size):
+            batch = ready[i : i + self.batch_size]
+            try:
+                with trace("service/prove", n=len(batch)):
+                    proofs = prove_tpu_batch(self.dpk, [r.witness for r in batch])
+                # verify a sample from every batch before emitting
+                sample_pub = self.public_fn(batch[0].witness)
+                if not verify(self.vk, proofs[0], sample_pub):
+                    raise RuntimeError("sample proof failed verification")
+                for req, proof in zip(batch, proofs):
+                    dump(proof_to_json(proof), req.path + ".proof.json")
+                    dump(public_to_json(self.public_fn(req.witness)), req.path + ".public.json")
+                    stats["done"] += 1
+            except Exception as e:  # noqa: BLE001
+                for req in batch:
+                    self._emit_error(req, "error-failed-to-prove", e)
+                    stats["error-failed-to-prove"] += 1
+        return stats
+
+    @staticmethod
+    def _emit_error(req: Request, state: str, exc: Exception) -> None:
+        with open(req.path + ".error.json", "w") as f:
+            json.dump(
+                {"state": state, "error": str(exc), "trace": traceback.format_exc(limit=3), "ts": time.time()},
+                f,
+                indent=1,
+            )
+
+    # ------------------------------------------------------------- daemon
+
+    def run(self, spool: str, poll_s: float = 1.0, max_sweeps: Optional[int] = None) -> None:
+        sweeps = 0
+        while max_sweeps is None or sweeps < max_sweeps:
+            stats = self.process_dir(spool)
+            if any(stats.values()):
+                print(f"[service] {stats}", flush=True)
+            sweeps += 1
+            time.sleep(poll_s)

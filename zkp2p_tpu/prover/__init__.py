@@ -1,0 +1,3 @@
+from .groth16_tpu import DeviceProvingKey, device_pk, prove_tpu, prove_tpu_batch
+
+__all__ = ["DeviceProvingKey", "device_pk", "prove_tpu", "prove_tpu_batch"]

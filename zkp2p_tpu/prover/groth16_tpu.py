@@ -1,0 +1,266 @@
+"""The TPU Groth16 prover: witness limbs in, proof points out.
+
+This is the `prover=tpu` backend the build exists for (BASELINE.json
+north star) — the drop-in for snarkjs `groth16 prove` /
+rapidsnark (`dizkus-scripts/5_gen_proof.sh`, `6_gen_proof_rapidsnark.sh`):
+same zkey material + witness in, same proof out, verified by the same
+pairing equation (`contracts/Verifier.sol:340-380`).
+
+Dataflow (one jitted program, SURVEY.md §7 step 6):
+
+  witness w (mont limbs, n_wires x 16)
+    ├─ Az/Bz/Cz: gather coeffs -> Montgomery mul -> modular segment-sum
+    │  over rows (the sparse matvec; zero scatter)
+    ├─ H: iNTT -> coset shift -> NTT -> (a·b - c)·Z⁻¹ -> iNTT -> unshift
+    └─ 4 G1 MSMs + 1 G2 MSM over bit planes (ops.msm)
+  host: the ~10 scalar ops that blind with (r, s) and assemble (A, B, C)
+
+Determinism contract: given the same (witness, r, s) this emits the exact
+proof `snark.groth16.prove_host` does — the two provers are diffed
+point-by-point in tests, the same way the reference pins a known-good
+proof vector in `test/ramp.test.js:193-196`.
+
+Batching: `prove_tpu_batch` vmaps the whole pipeline over independent
+witnesses sharing one key — the reference has no analog (browser proves
+one email at a time); this is the TPU data-parallel axis.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..curve.host import G1Point, G2Point, g1_add, g1_mul, g1_neg, g2_add, g2_mul
+from ..curve.jcurve import (
+    AffPoint,
+    G1J,
+    G2J,
+    g1_jac_to_host,
+    g1_to_affine_arrays,
+    g2_jac_to_host,
+    g2_to_affine_arrays,
+)
+from ..field.bn254 import R, fr_inv
+from ..field.jfield import FR, NUM_LIMBS, lazy_segment_sum_mod
+from ..ops.msm import bit_planes_from_limbs, msm
+from ..ops.ntt import coset_shift, intt, ntt
+from ..snark.groth16 import COSET_G, Proof, ProvingKey, domain_size_for, qap_rows
+from ..snark.r1cs import ConstraintSystem
+
+
+@dataclass
+class DeviceProvingKey:
+    """Proving key resident as device arrays (the zkey, TPU-shaped)."""
+
+    n_public: int
+    n_wires: int
+    log_m: int
+    # Sparse QAP rows (including public binding rows), one triple per matrix:
+    # canonical Montgomery coefficients, wire gather indices, row segment ids.
+    a_coeff: jnp.ndarray
+    a_wire: jnp.ndarray
+    a_row: jnp.ndarray
+    b_coeff: jnp.ndarray
+    b_wire: jnp.ndarray
+    b_row: jnp.ndarray
+    c_coeff: jnp.ndarray
+    c_wire: jnp.ndarray
+    c_row: jnp.ndarray
+    # MSM bases (affine Montgomery limbs; (0,0) = infinity hole).
+    a_bases: AffPoint
+    b1_bases: AffPoint
+    b2_bases: AffPoint
+    c_bases: AffPoint
+    h_bases: AffPoint  # padded to m lanes (last lane infinity)
+    z_inv_coset: jnp.ndarray  # 1/Z(g·w^j) — constant on the coset
+    # Host-side blinding points for final assembly.
+    alpha_1: G1Point
+    beta_1: G1Point
+    beta_2: G2Point
+    delta_1: G1Point
+    delta_2: G2Point
+
+
+_DPK_ARRAY_FIELDS = (
+    "a_coeff", "a_wire", "a_row", "b_coeff", "b_wire", "b_row",
+    "c_coeff", "c_wire", "c_row", "a_bases", "b1_bases", "b2_bases",
+    "c_bases", "h_bases", "z_inv_coset",
+)
+_DPK_META_FIELDS = ("n_public", "n_wires", "log_m", "alpha_1", "beta_1", "beta_2", "delta_1", "delta_2")
+
+
+def _dpk_flatten(d: "DeviceProvingKey"):
+    return tuple(getattr(d, f) for f in _DPK_ARRAY_FIELDS), tuple(getattr(d, f) for f in _DPK_META_FIELDS)
+
+
+def _dpk_unflatten(meta, children) -> "DeviceProvingKey":
+    return DeviceProvingKey(**dict(zip(_DPK_ARRAY_FIELDS, children)), **dict(zip(_DPK_META_FIELDS, meta)))
+
+
+jax.tree_util.register_pytree_node(DeviceProvingKey, _dpk_flatten, _dpk_unflatten)
+
+
+def _rows_to_arrays(rows, matrix: int, m: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    coeffs: List[np.ndarray] = []
+    wires: List[int] = []
+    row_ids: List[int] = []
+    for j, triple in enumerate(rows):
+        for wire, coeff in triple[matrix].items():
+            coeffs.append(FR.to_mont_host(coeff % R))
+            wires.append(wire)
+            row_ids.append(j)
+    if not coeffs:  # degenerate all-zero matrix
+        coeffs, wires, row_ids = [FR.to_mont_host(0)], [0], [m - 1]
+    return (
+        jnp.asarray(np.stack(coeffs)),
+        jnp.asarray(np.array(wires, dtype=np.int32)),
+        jnp.asarray(np.array(row_ids, dtype=np.int32)),
+    )
+
+
+def device_pk(pk: ProvingKey, cs: ConstraintSystem) -> DeviceProvingKey:
+    """Host ProvingKey + R1CS -> device arrays.  One-time load, amortised
+    over every proof (the TPU analog of the browser's IndexedDB zkey cache,
+    `app/src/helpers/zkp.ts:56-61`)."""
+    rows = qap_rows(cs)
+    m = domain_size_for(cs)
+    log_m = m.bit_length() - 1
+    a = _rows_to_arrays(rows, 0, m)
+    b = _rows_to_arrays(rows, 1, m)
+    c = _rows_to_arrays(rows, 2, m)
+    h_pts = list(pk.h_query) + [None] * (m - len(pk.h_query))
+    z_coset = (pow(COSET_G, m, R) - 1) % R
+    return DeviceProvingKey(
+        n_public=pk.n_public,
+        n_wires=cs.num_wires,
+        log_m=log_m,
+        a_coeff=a[0], a_wire=a[1], a_row=a[2],
+        b_coeff=b[0], b_wire=b[1], b_row=b[2],
+        c_coeff=c[0], c_wire=c[1], c_row=c[2],
+        a_bases=g1_to_affine_arrays(pk.a_query),
+        b1_bases=g1_to_affine_arrays(pk.b1_query),
+        b2_bases=g2_to_affine_arrays(pk.b2_query),
+        c_bases=g1_to_affine_arrays(pk.c_query),
+        h_bases=g1_to_affine_arrays(h_pts),
+        z_inv_coset=jnp.asarray(FR.to_mont_host(fr_inv(z_coset))),
+        alpha_1=pk.alpha_1,
+        beta_1=pk.beta_1,
+        beta_2=pk.beta_2,
+        delta_1=pk.delta_1,
+        delta_2=pk.delta_2,
+    )
+
+
+def witness_to_device(witness: Sequence[int]) -> jnp.ndarray:
+    """Host witness ints -> Montgomery limb matrix (n_wires, 16)."""
+    return jnp.asarray(np.stack([FR.to_mont_host(w % R) for w in witness]))
+
+
+def _matvec(coeff, wire, row, w_mont, m):
+    vals = FR.mul(coeff, w_mont[wire])
+    return lazy_segment_sum_mod(FR, vals, row, m)
+
+
+def h_evals(dpk: DeviceProvingKey, w_mont: jnp.ndarray) -> jnp.ndarray:
+    """Coefficients of H = (A·B - C)/Z on device, (m, 16) mont limbs.
+
+    Same ladder as the host oracle `snark.groth16.compute_h_coeffs`, but
+    every step batched on limb lanes."""
+    m = 1 << dpk.log_m
+    a_ev = _matvec(dpk.a_coeff, dpk.a_wire, dpk.a_row, w_mont, m)
+    b_ev = _matvec(dpk.b_coeff, dpk.b_wire, dpk.b_row, w_mont, m)
+    c_ev = _matvec(dpk.c_coeff, dpk.c_wire, dpk.c_row, w_mont, m)
+    a_cos = ntt(coset_shift(intt(a_ev, dpk.log_m), COSET_G, dpk.log_m), dpk.log_m)
+    b_cos = ntt(coset_shift(intt(b_ev, dpk.log_m), COSET_G, dpk.log_m), dpk.log_m)
+    c_cos = ntt(coset_shift(intt(c_ev, dpk.log_m), COSET_G, dpk.log_m), dpk.log_m)
+    h_cos = FR.mul(FR.sub(FR.mul(a_cos, b_cos), c_cos), dpk.z_inv_coset)
+    return coset_shift(intt(h_cos, dpk.log_m), fr_inv(COSET_G), dpk.log_m)
+
+
+def _h_and_planes(dpk: DeviceProvingKey, w_mont: jnp.ndarray):
+    h = h_evals(dpk, w_mont)
+    return bit_planes_from_limbs(FR.from_mont(w_mont)), bit_planes_from_limbs(FR.from_mont(h))
+
+
+def _msm_g1(bases, planes):
+    return msm(G1J, bases, planes)
+
+
+def _msm_g2(bases, planes):
+    return msm(G2J, bases, planes)
+
+
+# Stage-wise jits, NOT one fused program: the three wire-scalar G1 MSMs
+# (a, b1, c) share one compiled executable (same shapes), the G2 and
+# h-query MSMs get their own.  XLA compile time scales with traced-graph
+# size, so executable reuse across the proof pipeline matters more than
+# whole-program fusion; intermediates stay on device between stages.
+_jit_h_planes = jax.jit(_h_and_planes)
+_jit_msm_g1 = jax.jit(_msm_g1)
+_jit_msm_g2 = jax.jit(_msm_g2)
+_jit_h_planes_batch = jax.jit(jax.vmap(_h_and_planes, in_axes=(None, 0)))
+_jit_msm_g1_batch = jax.jit(jax.vmap(_msm_g1, in_axes=(None, 0)))
+_jit_msm_g2_batch = jax.jit(jax.vmap(_msm_g2, in_axes=(None, 0)))
+
+
+def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = False):
+    """The five big MSMs; everything else about the proof is host-cheap."""
+    jh, m1, m2 = (
+        (_jit_h_planes_batch, _jit_msm_g1_batch, _jit_msm_g2_batch)
+        if batched
+        else (_jit_h_planes, _jit_msm_g1, _jit_msm_g2)
+    )
+    w_planes, h_planes = jh(dpk, w_mont)
+    return (
+        m1(dpk.a_bases, w_planes),
+        m1(dpk.b1_bases, w_planes),
+        m2(dpk.b2_bases, w_planes),
+        m1(dpk.c_bases, w_planes),
+        m1(dpk.h_bases, h_planes),
+    )
+
+
+def _assemble(dpk: DeviceProvingKey, acc, r: int, s: int) -> Proof:
+    a_acc, b1_acc, b2_acc, c_acc, h_acc = acc
+    pi_a = g1_add(g1_add(dpk.alpha_1, a_acc), g1_mul(dpk.delta_1, r))
+    pi_b = g2_add(g2_add(dpk.beta_2, b2_acc), g2_mul(dpk.delta_2, s))
+    pi_b1 = g1_add(g1_add(dpk.beta_1, b1_acc), g1_mul(dpk.delta_1, s))
+    pi_c = g1_add(c_acc, h_acc)
+    pi_c = g1_add(pi_c, g1_mul(pi_a, s))
+    pi_c = g1_add(pi_c, g1_mul(pi_b1, r))
+    pi_c = g1_add(pi_c, g1_neg(g1_mul(dpk.delta_1, r * s % R)))
+    return Proof(a=pi_a, b=pi_b, c=pi_c)
+
+
+def prove_tpu(
+    dpk: DeviceProvingKey,
+    witness: Sequence[int],
+    r: Optional[int] = None,
+    s: Optional[int] = None,
+) -> Proof:
+    if r is None:
+        r = 1 + secrets.randbelow(R - 1)
+    if s is None:
+        s = 1 + secrets.randbelow(R - 1)
+    acc = _prove_device(dpk, witness_to_device(witness))
+    a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (acc[0], acc[1], acc[3], acc[4]))
+    b2 = g2_jac_to_host(acc[2])[0]
+    return _assemble(dpk, (a, b1, b2, c, hq), r, s)
+
+
+def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -> List[Proof]:
+    """vmap the full device pipeline over a batch of witnesses (the
+    batch=64 configuration in BASELINE.json)."""
+    w = jnp.stack([witness_to_device(wit) for wit in witnesses])
+    accs = _prove_device(dpk, w, batched=True)
+    a, b1, c, hq = (g1_jac_to_host(accs[i]) for i in (0, 1, 3, 4))
+    b2 = g2_jac_to_host(accs[2])
+    return [
+        _assemble(dpk, (a[i], b1[i], b2[i], c[i], hq[i]), 1 + secrets.randbelow(R - 1), 1 + secrets.randbelow(R - 1))
+        for i in range(len(witnesses))
+    ]

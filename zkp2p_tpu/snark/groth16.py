@@ -33,11 +33,14 @@ from ..curve.host import (
     G1_GENERATOR,
     G2_GENERATOR,
     g1_add,
+    g1_gen_mul,
+    g1_gen_mul_batch,
     g1_is_on_curve,
     g1_mul,
     g1_msm,
     g1_neg,
     g2_add,
+    g2_gen_mul,
     g2_is_on_curve,
     g2_msm,
     g2_mul,
@@ -148,35 +151,39 @@ def setup(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[ProvingKey
     delta_inv = fr_inv(delta)
     gamma_inv = fr_inv(gamma)
 
-    a_query = [g1_mul(g1, v) for v in a_tau]
-    b1_query = [g1_mul(g1, v) for v in b_tau]
-    b2_query = [g2_mul(g2, v) for v in b_tau]
+    # fixed-base batches: native C++ when built (csrc/), Python windowed
+    # tables otherwise — setup is one g1 mul per wire per query
+    a_query = g1_gen_mul_batch(a_tau)
+    b1_query = g1_gen_mul_batch(b_tau)
+    b2_query = [g2_gen_mul(v) for v in b_tau]
 
-    c_query: List[Optional[G1Point]] = []
-    ic: List[G1Point] = []
-    for i in range(n_wires):
-        val = (beta * a_tau[i] + alpha * b_tau[i] + c_tau[i]) % R
-        if i <= cs.num_public:
-            ic.append(g1_mul(g1, val * gamma_inv % R))
-            c_query.append(None)
-        else:
-            c_query.append(g1_mul(g1, val * delta_inv % R))
+    vals = [(beta * a_tau[i] + alpha * b_tau[i] + c_tau[i]) % R for i in range(n_wires)]
+    scaled = [
+        v * (gamma_inv if i <= cs.num_public else delta_inv) % R
+        for i, v in enumerate(vals)
+    ]
+    pts = g1_gen_mul_batch(scaled)
+    c_query: List[Optional[G1Point]] = [
+        None if i <= cs.num_public else pts[i] for i in range(n_wires)
+    ]
+    ic: List[G1Point] = pts[: cs.num_public + 1]
 
-    h_query = []
     z_delta = z_tau * delta_inv % R
+    h_scalars = []
     tpow = 1
     for _ in range(m - 1):
-        h_query.append(g1_mul(g1, tpow * z_delta % R))
+        h_scalars.append(tpow * z_delta % R)
         tpow = tpow * tau % R
+    h_query = g1_gen_mul_batch(h_scalars)
 
     pk = ProvingKey(
         n_public=cs.num_public,
         domain_size=m,
-        alpha_1=g1_mul(g1, alpha),
-        beta_1=g1_mul(g1, beta),
-        beta_2=g2_mul(g2, beta),
-        delta_1=g1_mul(g1, delta),
-        delta_2=g2_mul(g2, delta),
+        alpha_1=g1_gen_mul(alpha),
+        beta_1=g1_gen_mul(beta),
+        beta_2=g2_gen_mul(beta),
+        delta_1=g1_gen_mul(delta),
+        delta_2=g2_gen_mul(delta),
         a_query=a_query,
         b1_query=b1_query,
         b2_query=b2_query,
@@ -187,7 +194,7 @@ def setup(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[ProvingKey
         n_public=cs.num_public,
         alpha_1=pk.alpha_1,
         beta_2=pk.beta_2,
-        gamma_2=g2_mul(g2, gamma),
+        gamma_2=g2_gen_mul(gamma),
         delta_2=pk.delta_2,
         ic=ic,
     )

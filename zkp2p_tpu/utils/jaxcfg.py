@@ -1,0 +1,22 @@
+"""JAX runtime configuration helpers (shared by CLI / bench / tests).
+
+The limb-arithmetic graphs are wide and XLA compiles them slowly; the
+persistent compilation cache turns that into a once-per-checkout cost —
+on every entry path, not just pytest (tests/conftest.py does the same).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_cache(path: str | None = None) -> None:
+    import jax
+
+    cache = path or os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"),
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

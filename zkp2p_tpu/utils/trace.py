@@ -1,0 +1,70 @@
+"""Structured stage tracing for the proving service.
+
+The reference's observability is `start=$(date +%s)` brackets in shell
+scripts, `console.time("zk-dl"/"zk-gen")` and a UI stopwatch
+(SURVEY.md §5 tracing).  This is the structured version: nested stage
+timers with one JSON-lines sink, plus optional JAX profiler capture for
+xprof when JAX_TRACE_DIR is set.
+
+    with trace("prove", batch=16):
+        with trace("h_poly"):
+            ...
+    dump_trace()  ->  [{"stage": "prove", "ms": ..., "batch": 16, ...}]
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+_records: List[Dict[str, Any]] = []
+_stack: List[str] = []
+
+
+@contextlib.contextmanager
+def trace(stage: str, **attrs):
+    _stack.append(stage)
+    path = "/".join(_stack)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _records.append({"stage": path, "ms": round((time.perf_counter() - t0) * 1e3, 3), **attrs})
+        _stack.pop()
+
+
+def records() -> List[Dict[str, Any]]:
+    return list(_records)
+
+
+def reset() -> None:
+    _records.clear()
+
+
+def dump_trace(path: Optional[str] = None) -> None:
+    out = "\n".join(json.dumps(r) for r in _records)
+    if path:
+        with open(path, "a") as f:
+            f.write(out + "\n")
+    else:
+        print(out, file=sys.stderr)
+
+
+@contextlib.contextmanager
+def jax_profile(name: str = "zkp2p"):
+    """xprof capture when JAX_TRACE_DIR is set; no-op otherwise."""
+    trace_dir = os.environ.get("JAX_TRACE_DIR")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(os.path.join(trace_dir, name))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
