@@ -54,6 +54,25 @@ def test_venmo_witness_end_to_end(circuit, key):
 
 
 @pytest.mark.slow
+def test_body_hash_idx_cannot_point_elsewhere(circuit, key):
+    """Soundness regression (ADVICE r1, high): body_hash_idx must be tied
+    to the bh= regex match.  Pointing it at other base64-alphabet header
+    bytes must break a constraint — the shift consumes the regex reveal
+    mask (zero outside the match), mirroring circuit.circom:127-132."""
+    cs, lay = circuit
+    email = make_venmo_email(key, raw_id="1234567891234567891", amount="30", body_filler=40)
+    inputs = generate_inputs(email, key.n, order_id=1, claim_id=0, params=PARAMS, layout=lay)
+    # Point the idx at the subject line (valid b64-alphabet chars) instead
+    # of the bh= value.
+    seed = dict(inputs.seed)
+    honest_idx = seed[lay.body_hash_idx]
+    seed[lay.body_hash_idx] = max(0, honest_idx - 30)
+    w_bad = cs.witness(inputs.public_signals, seed)
+    with pytest.raises(AssertionError):
+        cs.check_witness(w_bad)
+
+
+@pytest.mark.slow
 def test_venmo_witness_different_email(circuit, key):
     cs, lay = circuit
     email = make_venmo_email(key, raw_id="9876543210987654321", amount="125", body_filler=10)

@@ -68,8 +68,25 @@ def _ser_pub(pt: Point) -> bytes:
 
 
 def _parse_pub(data: bytes) -> Point:
-    assert data[0] == 4 and len(data) == 65
-    return (int.from_bytes(data[1:33], "big"), int.from_bytes(data[33:], "big"))
+    """Parse + validate an uncompressed public key.
+
+    Rejects anything not a finite point on secp256k1 itself: coordinates
+    must be < p and satisfy y^2 = x^3 + 7.  The add/double formulas never
+    use the curve's b, so small-order points on twist curves would pass
+    arithmetically — combined with the MAC check acting as an oracle that
+    is the classic invalid-curve key-recovery attack on the static
+    identity key.  Validation here closes it for both encrypt (recipient
+    key) and decrypt (attacker-supplied ephemeral key).
+    """
+    if len(data) != 65 or data[0] != 4:
+        raise ValueError("bad public key encoding")
+    x = int.from_bytes(data[1:33], "big")
+    y = int.from_bytes(data[33:], "big")
+    if x >= _P or y >= _P:
+        raise ValueError("public key coordinate out of range")
+    if (y * y - (x * x * x + 7)) % _P != 0:
+        raise ValueError("point not on secp256k1")
+    return (x, y)
 
 
 # ---------------------------------------------------------------- AES
@@ -193,6 +210,8 @@ def encrypt_message(message: bytes, recipient_pub: bytes, rng: Optional[bytes] =
     eph_priv = int.from_bytes(rng or os.urandom(32), "big") % _N or 1
     eph_pub = _mul(_G, eph_priv)
     shared = _mul(_parse_pub(recipient_pub), eph_priv)
+    if shared is None:
+        raise ValueError("degenerate ECDH shared secret")
     enc_key, mac_key = _kdf(shared[0])
     iv = (rng and hashlib.sha256(rng).digest()[:16]) or os.urandom(16)
     ct = _aes_ctr(enc_key, iv, message)
@@ -204,6 +223,8 @@ def decrypt_message(blob: bytes, account: Account) -> bytes:
     eph_pub = _parse_pub(blob[:65])
     iv, mac, ct = blob[65:81], blob[81:113], blob[113:]
     shared = _mul(eph_pub, account.private_key)
+    if shared is None:
+        raise ValueError("degenerate ECDH shared secret")
     enc_key, mac_key = _kdf(shared[0])
     if not hmac.compare_digest(mac, hmac.new(mac_key, iv + ct, hashlib.sha256).digest()):
         raise ValueError("ECIES MAC mismatch")

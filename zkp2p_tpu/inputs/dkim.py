@@ -101,6 +101,24 @@ class DkimSignature:
     raw_header: bytes  # the full dkim-signature header line
 
 
+def _strip_b_tag(dkim_raw: bytes) -> bytes:
+    """Empty the b= tag's value in a raw DKIM-Signature header (RFC 6376
+    §3.7), locating it positionally at tag level — ';' delimits tags, and a
+    tag name is the bytes before the first '=' modulo folding whitespace.
+    A regex over the folded raw value can misfire on a 'b=' byte sequence
+    inside another tag's value (e.g. a bh= base64 value whose final chars
+    fold to '\\r\\n b='), blanking the wrong tag."""
+    header, _, value = dkim_raw.partition(b":")
+    segs = value.split(b";")
+    for i, seg in enumerate(segs):
+        name = re.sub(rb"[\s\r\n]+", b"", seg.split(b"=", 1)[0])
+        if name == b"b" and b"=" in seg:
+            prefix = seg[: seg.index(b"=") + 1]
+            segs[i] = prefix
+            break
+    return header + b":" + b";".join(segs)
+
+
 def parse_dkim_signature(raw: bytes) -> DkimSignature:
     value = raw.split(b":", 1)[1]
     unfolded = re.sub(rb"\r\n[ \t]+", b" ", value).decode()
@@ -174,8 +192,7 @@ def extract_and_verify(raw_eml: bytes, keys: Optional[KeyRegistry] = None) -> Dk
         pool = pools.get(name, [])
         if pool:
             picked.append(pool.pop())
-    # dkim-signature itself, with b= value emptied, no trailing CRLF
-    stripped = re.sub(rb"([;\s]b=)[^;]*", rb"\1", dkim_raw, count=1)
+    stripped = _strip_b_tag(dkim_raw)
     parts = [hc(h) + b"\r\n" for h in picked]
     parts.append(hc(stripped))
     signed_data = b"".join(parts)

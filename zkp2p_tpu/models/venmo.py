@@ -162,8 +162,14 @@ def build_venmo_circuit(p: VenmoParams) -> tuple[ConstraintSystem, VenmoLayout]:
     cs.enforce_eq(LC.of(bh_cnt), LC.const(1), "bh/count")
 
     # ---- bh= extraction + body hash equality (circuit.circom:115-156)
+    # Soundness: shift the REGEX-MASKED bytes, not the raw header — the
+    # reference shifts body_hash_regex.reveal (circuit.circom:127-132),
+    # which is zero everywhere except the matched bh= value, so a prover
+    # cannot point body_hash_idx at arbitrary base64-looking header bytes
+    # (e.g. an attacker-chosen subject substring) and forge a body.
+    bh_reveal = reveal_bytes(cs, lay.header, bh_states, _bh_value_states(bh_dfa), "bh.rev")
     bh_onehot = core.one_hot(cs, lay.body_hash_idx, p.max_header_bytes - p.bh_b64_len, "bh.idx")
-    bh_chars = _shift_window(cs, lay.header, bh_onehot, p.bh_b64_len, "bh.shift")
+    bh_chars = _shift_window(cs, bh_reveal, bh_onehot, p.bh_b64_len, "bh.shift")
     decoded = b64.base64_decode_bits(cs, bh_chars, cache, "bh.dec")
 
     mid_words = [lay.midstate_bits[32 * i : 32 * i + 32] for i in range(8)]
@@ -217,6 +223,22 @@ def build_venmo_circuit(p: VenmoParams) -> tuple[ConstraintSystem, VenmoLayout]:
     cs.compute(lay.claim_sq, lambda v: v * v % R, [lay.claim_id])
 
     return cs, lay
+
+
+def _bh_value_states(dfa) -> List[int]:
+    """States inside the bh= base64 value of the BODY_HASH DFA: exactly
+    those from which ';' then ' ' completes the match.  Only the value
+    component of `...bh=[0-9A-Za-z+/=]+; ` can end the match this way (the
+    inner `[a-z]+=[^;]+; ` tag-value loop continues to more tags, never to
+    accept), so the reveal mask is 1 precisely on the matched b64 chars —
+    verified against a canonical relaxed-canonicalized header in tests."""
+    out = []
+    for s in range(dfa.n_states):
+        z = int(dfa.next[s, ord(";")])
+        if z != -1 and int(dfa.next[z, ord(" ")]) in dfa.accept:
+            out.append(s)
+    assert out, "BODY_HASH DFA has no value states"
+    return out
 
 
 def _amount_reveal_states(dfa) -> List[int]:

@@ -36,10 +36,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO):
-        try:
-            subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
-        except Exception:
+    # Always (re)build from the committed source: a stale or prebuilt .so
+    # must never be loaded in preference to the reviewed C++ (the binary is
+    # gitignored; `make` is a no-op when the .so is already newer than the
+    # source, so this costs one stat on the warm path).
+    try:
+        subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
+    except Exception:
+        if not os.path.exists(_SO):
             return None
     try:
         lib = ctypes.CDLL(_SO)
@@ -48,7 +52,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.g1_fixed_base_batch.argtypes = [u64p, u64p, ctypes.c_int, u64p]
     lib.fp_mul_std.argtypes = [u64p, u64p, u64p]
-    # quick self-check against Python ints before trusting it
+    # Self-check before trusting it: one field mul against Python ints AND
+    # one fixed-base scalar mul against the host curve oracle, so a library
+    # with subtly wrong curve ops (used for trusted-setup point generation)
+    # is rejected, not just one with a broken multiplier.
     from ..field.bn254 import P
 
     a, b = 0x1234567890ABCDEF << 120 | 0x42, P - 12345
@@ -59,6 +66,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _u64x4_to_int(cv) != a * b % P:
         return None
     _lib = lib
+    from ..curve.host import G1_GEN, g1_mul
+
+    k = 0xDEADBEEFCAFEF00D1234567890ABCDEF
+    got = g1_fixed_base_batch(G1_GEN, [k])
+    if got is None or got[0] != g1_mul(G1_GEN, k):
+        _lib = None
+        return None
     return _lib
 
 
