@@ -67,13 +67,28 @@ def build_or_load():
     return cs, pk, vk, msg
 
 
-def main():
+def _init_backend():
+    """jax.devices() with a fallback: if the TPU (axon) backend fails to
+    initialise — the round-1 failure mode — re-exec on CPU so the bench
+    still produces a number + a JSON record instead of a crash."""
     import jax
 
     from zkp2p_tpu.utils.jaxcfg import enable_cache
 
     enable_cache()
-    devs = jax.devices()
+    try:
+        devs = jax.devices()
+    except Exception as e:
+        if os.environ.get("BENCH_NO_FALLBACK"):
+            raise
+        log(f"backend init failed ({e!r}); re-exec on CPU fallback")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_FALLBACK="cpu", BENCH_NO_FALLBACK="1")
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    return devs
+
+
+def main():
+    devs = _init_backend()
     log("devices:", devs)
 
     from zkp2p_tpu.inputs.sha_host import sha256_pad
@@ -113,12 +128,14 @@ def main():
     proofs_per_sec = BATCH / best
     vs = (proofs_per_sec * cs.num_constraints / BASELINE_CONSTRAINTS) / BASELINE_PROOFS_PER_SEC
     log(f"batch={BATCH} best={best:.2f}s -> {proofs_per_sec:.3f} proofs/s on {cs.num_constraints} constraints")
+    plat = devs[0].platform if devs else "?"
+    fb = " CPU-FALLBACK" if os.environ.get("BENCH_FALLBACK") else ""
     print(
         json.dumps(
             {
                 "metric": "groth16_proofs_per_sec_constraint_normalized",
                 "value": round(proofs_per_sec, 4),
-                "unit": f"proofs/s @ {cs.num_constraints} constraints (batch={BATCH}, 1 chip)",
+                "unit": f"proofs/s @ {cs.num_constraints} constraints (batch={BATCH}, 1 {plat}{fb})",
                 "vs_baseline": round(vs, 4),
             }
         )
@@ -126,4 +143,20 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # always leave a JSON record for the driver
+        import traceback
+
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": "bench_failed",
+                    "value": 0,
+                    "unit": f"error: {type(exc).__name__}: {exc}"[:300],
+                    "vs_baseline": 0,
+                }
+            )
+        )
+        sys.exit(1)

@@ -18,6 +18,8 @@ Layout contract (shared with the host oracle ``zkp2p_tpu.field.bn254``):
 
 from __future__ import annotations
 
+from functools import lru_cache
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -75,31 +77,51 @@ def _carry_canon(x: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
     return (r + _shift_up(g, 1)) & MASK
 
 
+@lru_cache(maxsize=None)
+def _conv_onehot(n: int, m: int) -> jnp.ndarray:
+    """(2*n*m, n+m+1) 0/1 f32 matrix folding lo/hi partial-product planes
+    onto their limb offsets: flat index (p, i, j) -> column i + j + p."""
+    L = n + m + 1
+    w = np.zeros((2, n, m, L), dtype=np.float32)
+    for i in range(n):
+        for j in range(m):
+            w[0, i, j, i + j] = 1.0
+            w[1, i, j, i + j + 1] = 1.0
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(w.reshape(2 * n * m, L))
+
+
 def _mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Full product of two 16-limb values -> 32 canonical limbs.
 
-    Schoolbook convolution with lo/hi-plane accumulation: every partial
-    product a_i*b_j < 2^32 is split into two 16-bit halves which are
-    scatter-added (static offsets) into a 33-limb uint32 accumulator; the
-    accumulator maxes out near 32*2^16 < 2^22, far below uint32 overflow.
+    Schoolbook convolution as ONE f32 matmul: every partial product
+    a_i*b_j < 2^32 is split into 16-bit halves (each exact in f32), and a
+    precomputed 0/1 matrix folds the (2,16,16) planes onto their limb
+    offsets.  Each output limb sums <= 32 values < 2^16, so the f32
+    accumulation stays integral (< 2^21 << 2^24) — bit-exact, and the
+    contraction runs on the TPU MXU (systolic array) instead of unrolling
+    into dozens of VPU pad/add ops per multiply (which also made traced
+    graphs ~10x bigger and XLA compiles ~10x slower).
     """
-    prods = a[..., :, None] * b[..., None, :]  # (..., 16, 16) uint32
-    lo = prods & MASK
-    hi = prods >> LIMB_BITS
     n = a.shape[-1]
     m = b.shape[-1]
-    L = n + m + 1
-    # Shear rows to their limb offset with static pads, then one tree-sum —
-    # no dynamic-update-slice chain (an n-step serial graph XLA compiles and
-    # executes far slower than pad+reduce).
-    lead = [(0, 0)] * (lo.ndim - 2)
-    rows = [
-        jnp.pad(lo[..., i, :], lead + [(i, L - m - i)])
-        + jnp.pad(hi[..., i, :], lead + [(i + 1, L - m - i - 1)])
-        for i in range(n)
-    ]
-    acc = jnp.sum(jnp.stack(rows, axis=-2), axis=-2)  # max ~2n*2^16 << 2^32
-    return _carry_canon(acc, n + m)
+    prods = a[..., :, None] * b[..., None, :]  # (..., n, m) uint32
+    lo = (prods & MASK).astype(jnp.float32)
+    hi = (prods >> LIMB_BITS).astype(jnp.float32)
+    planes = jnp.concatenate(
+        [lo.reshape(*lo.shape[:-2], n * m), hi.reshape(*hi.shape[:-2], n * m)], axis=-1
+    )
+    # Precision.HIGHEST: TPU DEFAULT f32 matmul truncates operands to
+    # bf16 MXU passes (8 mantissa bits — NOT exact for 16-bit limbs);
+    # HIGHEST runs the full-f32 pass schedule, keeping every partial and
+    # sum integral and bit-exact.
+    acc = jax.lax.dot_general(
+        planes,
+        _conv_onehot(n, m),
+        (((planes.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (..., n+m+1), integral f32 < 2^21
+    return _carry_canon(acc.astype(jnp.uint32), n + m)
 
 
 class JPrimeField:

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ..curve.jcurve import AffPoint, JacPoint, JCurve
+from ..field.jfield import LIMB_BITS
 
 SCALAR_BITS = 256
 
@@ -41,11 +42,13 @@ def bit_planes_from_limbs(limbs: jnp.ndarray) -> jnp.ndarray:
     MSB first (plane 0 = bit 255).
 
     Device-side twin of `jcurve.scalar_bit_planes` so witness values produced
-    on device never round-trip to the host."""
-    planes = []
-    for j in range(SCALAR_BITS - 1, -1, -1):
-        planes.append((limbs[..., j // 16] >> (j % 16)) & 1)
-    return jnp.stack(planes)
+    on device never round-trip to the host.  Vectorised (one shift + one
+    transpose), not a 256-step Python loop — trace size matters."""
+    shifts = jnp.arange(LIMB_BITS, dtype=jnp.uint32)
+    bits = (limbs[..., None] >> shifts) & 1  # (..., 16, 16) limb x bit
+    flat = bits.reshape(*limbs.shape[:-1], SCALAR_BITS)  # LSB first
+    flat = jnp.flip(flat, axis=-1)  # MSB first
+    return jnp.moveaxis(flat, -1, 0)
 
 
 def tree_reduce(curve: JCurve, pts: JacPoint, axis_len: int) -> JacPoint:
@@ -68,13 +71,16 @@ def tree_reduce(curve: JCurve, pts: JacPoint, axis_len: int) -> JacPoint:
 
 def digit_planes_from_limbs(limbs: jnp.ndarray, window: int = 4) -> jnp.ndarray:
     """Standard-form scalar limbs (..., n, 16) -> (256/window, ..., n)
-    base-2^window digit planes, most significant first."""
+    base-2^window digit planes, most significant first.  Vectorised like
+    `bit_planes_from_limbs`."""
     assert 16 % window == 0
-    planes = []
-    mask = (1 << window) - 1
-    for j in range(SCALAR_BITS - window, -1, -window):
-        planes.append((limbs[..., j // 16] >> (j % 16)) & mask)
-    return jnp.stack(planes)
+    per_limb = 16 // window
+    shifts = jnp.arange(per_limb, dtype=jnp.uint32) * window
+    mask = jnp.uint32((1 << window) - 1)
+    digits = (limbs[..., None] >> shifts) & mask  # (..., 16, per_limb)
+    flat = digits.reshape(*limbs.shape[:-1], 16 * per_limb)  # LS digit first
+    flat = jnp.flip(flat, axis=-1)
+    return jnp.moveaxis(flat, -1, 0)
 
 
 def msm_windowed(curve: JCurve, bases: AffPoint, digit_planes: jnp.ndarray, lanes: int = 64, window: int = 4) -> JacPoint:

@@ -29,7 +29,7 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..curve.jcurve import AffPoint, G1J, G2J, JacPoint, JCurve
-from ..ops.msm import SCALAR_BITS, msm
+from ..ops.msm import SCALAR_BITS, msm, msm_windowed
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
@@ -51,22 +51,27 @@ def _fold_gathered(curve: JCurve, gathered: JacPoint, n: int) -> JacPoint:
 def msm_sharded(
     curve: JCurve,
     bases: AffPoint,
-    bit_planes: jnp.ndarray,
+    planes: jnp.ndarray,
     mesh: Mesh,
     axis: str = "shard",
     lanes: int = 64,
+    window: int = 0,
 ) -> JacPoint:
     """MSM with the base-point axis sharded over `mesh`'s `axis`.
 
     bases components must have N divisible by the mesh size (pad with the
-    (0,0) infinity sentinel + zero planes first).  Returns the full sum,
-    replicated on every device."""
+    (0,0) infinity sentinel + zero planes first).  `planes` is bit planes
+    (window=0, 256 rows) or 2^window digit planes (the prover's fast path,
+    rows = 256/window).  Returns the full sum, replicated on every device."""
     n_dev = mesh.shape[axis]
     n = bases[0].shape[0]
     assert n % n_dev == 0, "pad the base axis to the mesh size first"
 
-    def local(bs, planes):
-        part = msm(curve, bs, planes, lanes=lanes)
+    def local(bs, pl):
+        if window:
+            part = msm_windowed(curve, bs, pl, lanes=lanes, window=window)
+        else:
+            part = msm(curve, bs, pl, lanes=lanes)
         gathered = jax.lax.all_gather(part, axis)  # (n_dev,) points on ICI
         return _fold_gathered(curve, gathered, n_dev)
 
@@ -76,7 +81,7 @@ def msm_sharded(
     )
     out_specs = tuple(P() for _ in range(3))
     fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
-    return fn(bases, bit_planes)
+    return fn(bases, planes)
 
 
 def pad_to_multiple(bases: AffPoint, bit_planes: jnp.ndarray, multiple: int) -> Tuple[AffPoint, jnp.ndarray]:

@@ -50,8 +50,28 @@ from ..pairing.pairing import pairing_product_is_one
 from .fft_host import coset_shift, intt, ntt
 from .r1cs import ConstraintSystem
 
-# Multiplicative coset generator for the H-polynomial evaluation domain.
-COSET_G = 5
+def coset_gen(log_m: int) -> int:
+    """Coset generator for the H-polynomial evaluation domain — the
+    snarkjs/rapidsnark convention: AB-C is evaluated on the ODD points of
+    the doubled domain (shift = w_{2m}, `groth16_prove`'s batchApplyKey
+    with inc = Fr.w[power+1]), so Z(g·w^j) = w_{2m}^m - 1 = -2, a
+    constant.  Adopting the identical convention makes imported snarkjs
+    `.zkey` section-9 points (formats.zkey) work with no translation."""
+    return fr_domain_root(log_m + 1)
+
+
+def _batch_inv(xs: List[int]) -> List[int]:
+    """Montgomery trick: n inverses for 3n muls + one exponentiation."""
+    n = len(xs)
+    prefix = [1] * (n + 1)
+    for i, x in enumerate(xs):
+        prefix[i + 1] = prefix[i] * x % R
+    inv_all = fr_inv(prefix[n])
+    out = [0] * n
+    for i in range(n - 1, -1, -1):
+        out[i] = prefix[i] * inv_all % R
+        inv_all = inv_all * xs[i] % R
+    return out
 
 
 @dataclass
@@ -67,7 +87,12 @@ class ProvingKey:
     b1_query: List[G1Point]  # [B_i(tau)]1 per wire
     b2_query: List[G2Point]  # [B_i(tau)]2 per wire
     c_query: List[Optional[G1Point]]  # [(beta A_i + alpha B_i + C_i)/delta]1, None for public wires
-    h_query: List[G1Point]  # [tau^i Z(tau)/delta]1, i < domain_size - 1
+    # Coset-Lagrange H basis (snarkjs zkey section 9 shape), one point per
+    # domain element j: [L'_j(tau) * Z(tau) / (delta * Z(g))]1 where L'_j is
+    # the Lagrange basis on the coset g*H.  The prover MSMs the raw coset
+    # evaluations d_j = (A*B - C)(g w^j) against these — no division by Z,
+    # no final iNTT (d_j = H(g w^j) * Z(g), and the Z(g) is folded in here).
+    h_query: List[G1Point]
 
 
 @dataclass
@@ -168,12 +193,22 @@ def setup(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[ProvingKey
     ]
     ic: List[G1Point] = pts[: cs.num_public + 1]
 
-    z_delta = z_tau * delta_inv % R
-    h_scalars = []
-    tpow = 1
-    for _ in range(m - 1):
-        h_scalars.append(tpow * z_delta % R)
-        tpow = tpow * tau % R
+    # Coset-Lagrange H points: L'_j(tau) = L_j(tau/g) with L_j the standard
+    # Lagrange basis on H, so
+    #   hcl_j = ((tau')^m - 1) * w^j / (m (tau' - w^j)) * Z(tau)/(delta Z(g))
+    # with tau' = tau/g.  One batched inversion for the m denominators.
+    g = coset_gen(m.bit_length() - 1)
+    tau_p = tau * fr_inv(g) % R
+    z_tau_p = (pow(tau_p, m, R) - 1) % R
+    z_coset = (pow(g, m, R) - 1) % R  # == -2 by the odd-interleave choice
+    scale = z_tau_p * minv % R * z_tau % R * fr_inv(delta * z_coset % R) % R
+    wjs = []
+    wj = 1
+    for _ in range(m):
+        wjs.append(wj)
+        wj = wj * w % R
+    denom_inv = _batch_inv([(tau_p - wj) % R for wj in wjs])
+    h_scalars = [scale * wj % R * di % R for wj, di in zip(wjs, denom_inv)]
     h_query = g1_gen_mul_batch(h_scalars)
 
     pk = ProvingKey(
@@ -201,37 +236,34 @@ def setup(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[ProvingKey
     return pk, vk
 
 
-def compute_h_coeffs(cs: ConstraintSystem, witness: Sequence[int]) -> List[int]:
-    """Coefficients of h(X) = (A(X)B(X) - C(X)) / Z(X), degree <= m-2.
+def coset_quotient_evals(cs: ConstraintSystem, witness: Sequence[int]) -> List[int]:
+    """d_j = (A·B - C)(g·w^j): the raw coset evaluations the prover MSMs
+    against the coset-Lagrange h_query (snarkjs `groth16 prove` dataflow).
 
     Lagrange-basis row dot-products -> iNTT -> coset NTT -> pointwise
-    (a*b - c) * Z^{-1} -> coset iNTT.  On the coset g*H, Z(g w^j) = g^m - 1
-    is a constant, so the division is a single scalar multiply.
+    a*b - c.  No division: Z is constant on the coset and folded into the
+    h_query points at setup.  C evaluations on the original domain equal
+    A∘B pointwise for a satisfying witness (every binding row has B = 0),
+    so only the A and B matrices are ever evaluated — exactly why the
+    snarkjs .zkey coefficient section stores just those two.
     This exact dataflow is what zkp2p_tpu.prover runs as batched TPU NTTs.
     """
     rows = qap_rows(cs)
     m = domain_size_for(cs)
     a_ev = [0] * m
     b_ev = [0] * m
-    c_ev = [0] * m
-    for j, (ra, rb, rc) in enumerate(rows):
+    for j, (ra, rb, _rc) in enumerate(rows):
         a_ev[j] = sum(coeff * witness[wi] for wi, coeff in ra.items()) % R
         b_ev[j] = sum(coeff * witness[wi] for wi, coeff in rb.items()) % R
-        c_ev[j] = sum(coeff * witness[wi] for wi, coeff in rc.items()) % R
+    c_ev = [a * b % R for a, b in zip(a_ev, b_ev)]
     a_c = intt(a_ev)
     b_c = intt(b_ev)
     c_c = intt(c_ev)
-    g = COSET_G
+    g = coset_gen(m.bit_length() - 1)
     a_cos = ntt(coset_shift(a_c, g))
     b_cos = ntt(coset_shift(b_c, g))
     c_cos = ntt(coset_shift(c_c, g))
-    z_on_coset = (pow(g, m, R) - 1) % R
-    z_inv = fr_inv(z_on_coset)
-    h_cos = [(a * b - c) * z_inv % R for a, b, c in zip(a_cos, b_cos, c_cos)]
-    h_shifted = intt(h_cos)
-    h = coset_shift(h_shifted, fr_inv(g))
-    assert h[m - 1] == 0, "h degree too high (witness unsatisfied?)"
-    return h[: m - 1]
+    return [(a * b - c) % R for a, b, c in zip(a_cos, b_cos, c_cos)]
 
 
 def prove_host(
@@ -247,7 +279,7 @@ def prove_host(
         r = 1 + secrets.randbelow(R - 1)
     if s is None:
         s = 1 + secrets.randbelow(R - 1)
-    h = compute_h_coeffs(cs, witness)
+    h = coset_quotient_evals(cs, witness)
 
     a_acc = g1_msm(pk.a_query, witness)
     pi_a = g1_add(g1_add(pk.alpha_1, a_acc), g1_mul(pk.delta_1, r))
