@@ -18,11 +18,11 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..field.bn254 import R
-from ..gadgets import base64 as b64
 from ..gadgets import core, rsa, sha256
-from ..gadgets.regex import CharClassCache, dfa_scan, match_count, reveal_bytes
+from ..gadgets.regex import CharClassCache, dfa_scan, reveal_bytes
 from ..regexc import compiler as regexc
 from ..snark.r1cs import LC, ConstraintSystem
+from . import common
 
 
 @dataclass
@@ -85,42 +85,31 @@ def build_email_verify(p: EmailVerifyParams):
     for w, bits in zip(lay.body, body_bits):
         cache.register_bits(w, bits)
 
-    sentinel = cs.new_wire("sentinel80")
-    cs.enforce_eq(LC.of(sentinel), LC.const(0x80), "sentinel")
-    cs.compute(sentinel, lambda: 0x80, [])
-    dkim_dfa = regexc.search_dfa(regexc.DKIM_HEADER)
-    dkim_states = dfa_scan(cs, [sentinel] + list(lay.header), dkim_dfa, cache, "dkim")
-    dkim_cnt = match_count(cs, dkim_states, dkim_dfa.accept, "dkim.cnt")
-    cs.enforce_eq(LC.of(dkim_cnt), LC.const(p.dkim_match_count), "dkim/count")
+    common.dkim_header_match(cs, lay.header, cache, p.dkim_match_count)
 
-    bh_dfa = regexc.search_dfa(regexc.BODY_HASH)
-    bh_states = dfa_scan(cs, list(lay.header), bh_dfa, cache, "bh")
-    bh_cnt = match_count(cs, bh_states, bh_dfa.accept, "bh.cnt")
-    cs.enforce_eq(LC.of(bh_cnt), LC.const(1), "bh/count")
-
-    bh_onehot = core.one_hot(cs, lay.body_hash_idx, p.max_header_bytes - p.bh_b64_len, "bh.idx")
-    from .venmo import _shift_window
-
-    bh_chars = _shift_window(cs, lay.header, bh_onehot, p.bh_b64_len, "bh.shift")
-    decoded = b64.base64_decode_bits(cs, bh_chars, cache, "bh.dec")
-
-    mid_words = [lay.midstate_bits[32 * i : 32 * i + 32] for i in range(8)]
-    body_digest = sha256.sha256_blocks(cs, body_bits, lay.body_blocks, init_state=mid_words, tag="sha_body")
-    for byte_i in range(32):
-        wrd, b_in_w = divmod(byte_i, 4)
-        for bit in range(8):
-            cs.enforce_eq(
-                LC.of(decoded[byte_i][bit]),
-                LC.of(body_digest[32 * wrd + 8 * (3 - b_in_w) + bit]),
-                "bh/eq",
-            )
+    # bh= extraction + body hash equality — shared soundness-critical block
+    # (shifts the regex-masked reveal, NOT the raw header; the round-2 bug
+    # here was shifting lay.header directly, letting a prover point
+    # body_hash_idx at arbitrary base64-alphabet bytes of the signed
+    # header).  See models.common.constrain_body_hash.
+    common.constrain_body_hash(
+        cs,
+        lay.header,
+        body_bits,
+        lay.body_blocks,
+        lay.midstate_bits,
+        lay.body_hash_idx,
+        cache,
+        p.max_header_bytes,
+        p.bh_b64_len,
+    )
 
     if p.body_regex:
         dfa = regexc.search_dfa(p.body_regex)
         states = dfa_scan(cs, list(lay.body), dfa, cache, "brx")
         reveal = reveal_bytes(cs, lay.body, states, sorted(dfa.accept), "brx.rev")
         onehot = core.one_hot(cs, lay.reveal_idx, p.max_body_bytes - p.reveal_len, "brx.idx")
-        chars = _shift_window(cs, reveal, onehot, p.reveal_len, "brx.shift")
+        chars = common.shift_window(cs, reveal, onehot, p.reveal_len, "brx.shift")
         words = core.pack_bytes(cs, chars, 7, "brx.pack")
         for w, pub in zip(words, lay.reveal_words):
             cs.enforce_eq(LC.of(w), LC.of(pub), "brx/out")

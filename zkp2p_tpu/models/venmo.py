@@ -35,12 +35,13 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Sequence
 
 from ..field.bn254 import R
-from ..gadgets import base64 as b64
+
 from ..gadgets import core, rsa, sha256
 from ..gadgets.poseidon import poseidon
 from ..gadgets.regex import CharClassCache, dfa_scan, match_count, reveal_bytes
 from ..regexc import compiler as regexc
 from ..snark.r1cs import LC, ConstraintSystem
+from . import common
 
 
 @dataclass
@@ -80,30 +81,10 @@ class VenmoLayout:
     claim_sq: int = 0
 
 
-def _shift_window(
-    cs: ConstraintSystem,
-    data: Sequence[int],
-    idx_onehot: Sequence[int],
-    width: int,
-    tag: str,
-) -> List[int]:
-    """out[j] = Σ_i onehot[i] · data[i+j] — the reveal-shift matrix
-    (`circuit.circom:115-132,189-194`): O(len·width) products, which in the
-    JAX witness tracer becomes a windowed gather (SURVEY.md §3.5)."""
-    out = []
-    L = len(data)
-    for j in range(width):
-        prods = []
-        for i, ind in enumerate(idx_onehot):
-            if i + j >= L:
-                continue
-            p = core.and_gate(cs, ind, data[i + j], f"{tag}.p{j}.{i}")
-            prods.append(p)
-        w = cs.new_wire(f"{tag}.out{j}")
-        cs.enforce_eq(core.lc_sum(prods), LC.of(w), f"{tag}/sum{j}")
-        cs.compute(w, lambda *ps: sum(ps) % R, prods)
-        out.append(w)
-    return out
+# Shared with models.email_verify — hoisted to models.common so soundness
+# fixes land in one place (see the round-2 bh= divergence).
+_shift_window = common.shift_window
+_bh_value_states = common.bh_value_states
 
 
 def build_venmo_circuit(p: VenmoParams) -> tuple[ConstraintSystem, VenmoLayout]:
@@ -147,43 +128,21 @@ def build_venmo_circuit(p: VenmoParams) -> tuple[ConstraintSystem, VenmoLayout]:
         cache.register_bits(w, bits)
     for w, bits in zip(lay.body, body_bits):
         cache.register_bits(w, bits)
-    # \x80 start sentinel prepended (dkim_header_regex.circom:11-14)
-    sentinel = cs.new_wire("sentinel80")
-    cs.enforce_eq(LC.of(sentinel), LC.const(0x80), "sentinel")
-    cs.compute(sentinel, lambda: 0x80, [])
-    dkim_dfa = regexc.search_dfa(regexc.DKIM_HEADER)
-    dkim_states = dfa_scan(cs, [sentinel] + list(lay.header), dkim_dfa, cache, "dkim")
-    dkim_cnt = match_count(cs, dkim_states, dkim_dfa.accept, "dkim.cnt")
-    cs.enforce_eq(LC.of(dkim_cnt), LC.const(p.dkim_match_count), "dkim/count")
-
-    bh_dfa = regexc.search_dfa(regexc.BODY_HASH)
-    bh_states = dfa_scan(cs, list(lay.header), bh_dfa, cache, "bh")
-    bh_cnt = match_count(cs, bh_states, bh_dfa.accept, "bh.cnt")
-    cs.enforce_eq(LC.of(bh_cnt), LC.const(1), "bh/count")
+    common.dkim_header_match(cs, lay.header, cache, p.dkim_match_count)
 
     # ---- bh= extraction + body hash equality (circuit.circom:115-156)
-    # Soundness: shift the REGEX-MASKED bytes, not the raw header — the
-    # reference shifts body_hash_regex.reveal (circuit.circom:127-132),
-    # which is zero everywhere except the matched bh= value, so a prover
-    # cannot point body_hash_idx at arbitrary base64-looking header bytes
-    # (e.g. an attacker-chosen subject substring) and forge a body.
-    bh_reveal = reveal_bytes(cs, lay.header, bh_states, _bh_value_states(bh_dfa), "bh.rev")
-    bh_onehot = core.one_hot(cs, lay.body_hash_idx, p.max_header_bytes - p.bh_b64_len, "bh.idx")
-    bh_chars = _shift_window(cs, bh_reveal, bh_onehot, p.bh_b64_len, "bh.shift")
-    decoded = b64.base64_decode_bits(cs, bh_chars, cache, "bh.dec")
-
-    mid_words = [lay.midstate_bits[32 * i : 32 * i + 32] for i in range(8)]
-    body_digest = sha256.sha256_blocks(cs, body_bits, body_blocks, init_state=mid_words, tag="sha_body")
-    # body digest: 8 words x 32 LE bits; decoded: per-byte LE bits.
-    # digest byte 4w+b (big-endian in word) = word bits [8*(3-b) .. +8)
-    for byte_i in range(32):
-        wrd, b_in_w = divmod(byte_i, 4)
-        for bit in range(8):
-            cs.enforce_eq(
-                LC.of(decoded[byte_i][bit]),
-                LC.of(body_digest[32 * wrd + 8 * (3 - b_in_w) + bit]),
-                "bh/eq",
-            )
+    # Shared soundness-critical block: see models.common.constrain_body_hash.
+    common.constrain_body_hash(
+        cs,
+        lay.header,
+        body_bits,
+        body_blocks,
+        lay.midstate_bits,
+        lay.body_hash_idx,
+        cache,
+        p.max_header_bytes,
+        p.bh_b64_len,
+    )
 
     # ---- offramper id regex + reveal + hash (circuit.circom:162-218)
     # The `+`-terminated pattern re-accepts on every id char, so the match
