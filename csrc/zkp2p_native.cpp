@@ -347,3 +347,374 @@ void fp_mul_std(const u64 *a, const u64 *b, u64 *c) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------- Fq2 / G2
+//
+// Fq2 = Fq[u]/(u^2 + 1); G2 is the twist curve over Fq2.  Needed for the
+// b2_query of trusted setup (one G2 fixed-base mul per wire — at venmo
+// scale that is millions of muls, unreachable for Python bigints).
+
+struct Fp2 {
+  u64 c0[4], c1[4];
+};
+
+static inline void fp2_add(Fp2 &r, const Fp2 &a, const Fp2 &b) {
+  add_mod(r.c0, a.c0, b.c0);
+  add_mod(r.c1, a.c1, b.c1);
+}
+static inline void fp2_sub(Fp2 &r, const Fp2 &a, const Fp2 &b) {
+  sub_mod(r.c0, a.c0, b.c0);
+  sub_mod(r.c1, a.c1, b.c1);
+}
+static void fp2_mul(Fp2 &r, const Fp2 &a, const Fp2 &b) {
+  // Karatsuba: v0 = a0 b0, v1 = a1 b1; c0 = v0 - v1; c1 = (a0+a1)(b0+b1) - v0 - v1
+  u64 v0[4], v1[4], s[4], t[4], u[4];
+  mont_mul(v0, a.c0, b.c0);
+  mont_mul(v1, a.c1, b.c1);
+  add_mod(s, a.c0, a.c1);
+  add_mod(t, b.c0, b.c1);
+  mont_mul(u, s, t);
+  sub_mod(r.c0, v0, v1);
+  sub_mod(u, u, v0);
+  sub_mod(r.c1, u, v1);
+}
+static inline void fp2_sqr(Fp2 &r, const Fp2 &a) { fp2_mul(r, a, a); }
+static inline bool fp2_is_zero(const Fp2 &a) {
+  return is_zero4(a.c0) && is_zero4(a.c1);
+}
+
+struct G2Jac {
+  Fp2 X, Y, Z;
+};
+
+static void g2_double(G2Jac &r, const G2Jac &p) {
+  if (fp2_is_zero(p.Z)) {
+    r = p;
+    return;
+  }
+  Fp2 A, B, C, D, E, F, t, t2;
+  fp2_sqr(A, p.X);
+  fp2_sqr(B, p.Y);
+  fp2_sqr(C, B);
+  fp2_add(t, p.X, B);
+  fp2_sqr(t, t);
+  fp2_sub(t, t, A);
+  fp2_sub(t, t, C);
+  fp2_add(D, t, t);
+  fp2_add(E, A, A);
+  fp2_add(E, E, A);
+  fp2_sqr(F, E);
+  fp2_add(t, D, D);
+  fp2_sub(r.X, F, t);
+  fp2_sub(t, D, r.X);
+  fp2_mul(t, E, t);
+  fp2_add(t2, C, C);
+  fp2_add(t2, t2, t2);
+  fp2_add(t2, t2, t2);
+  Fp2 y3;
+  fp2_sub(y3, t, t2);
+  fp2_mul(t, p.Y, p.Z);
+  fp2_add(r.Z, t, t);
+  r.Y = y3;
+}
+
+static const u64 ONE_MONT[4] = {0xd35d438dc58f0d9dULL, 0x0a78eb28f5c70b3dULL,
+                                0x666ea36f7879462cULL, 0x0e0a77c19a07df2fULL};
+
+static void g2_add_mixed(G2Jac &r, const G2Jac &p, const Fp2 &x2, const Fp2 &y2) {
+  if (fp2_is_zero(x2) && fp2_is_zero(y2)) {
+    r = p;
+    return;
+  }
+  if (fp2_is_zero(p.Z)) {
+    r.X = x2;
+    r.Y = y2;
+    memcpy(r.Z.c0, ONE_MONT, 32);
+    memset(r.Z.c1, 0, 32);
+    return;
+  }
+  Fp2 Z1Z1, U2, S2, H, HH, HHH, V, Rr, t, t2;
+  fp2_sqr(Z1Z1, p.Z);
+  fp2_mul(U2, x2, Z1Z1);
+  fp2_mul(t, y2, p.Z);
+  fp2_mul(S2, t, Z1Z1);
+  fp2_sub(H, U2, p.X);
+  fp2_sub(Rr, S2, p.Y);
+  if (fp2_is_zero(H)) {
+    if (fp2_is_zero(Rr)) {
+      g2_double(r, p);
+      return;
+    }
+    memset(&r, 0, sizeof(r));
+    return;
+  }
+  fp2_sqr(HH, H);
+  fp2_mul(HHH, H, HH);
+  fp2_mul(V, p.X, HH);
+  fp2_sqr(t, Rr);
+  fp2_sub(t, t, HHH);
+  Fp2 v2;
+  fp2_add(v2, V, V);
+  fp2_sub(r.X, t, v2);
+  fp2_sub(t, V, r.X);
+  fp2_mul(t, Rr, t);
+  fp2_mul(t2, p.Y, HHH);
+  fp2_sub(r.Y, t, t2);
+  Fp2 z3;
+  fp2_mul(z3, p.Z, H);
+  r.Z = z3;
+}
+
+static void g2_add(G2Jac &acc, const G2Jac &e) {
+  if (fp2_is_zero(e.Z)) return;
+  if (fp2_is_zero(acc.Z)) {
+    acc = e;
+    return;
+  }
+  Fp2 Z1Z1, Z2Z2, U1, U2, S1, S2, H, Rr, t;
+  fp2_sqr(Z1Z1, acc.Z);
+  fp2_sqr(Z2Z2, e.Z);
+  fp2_mul(U1, acc.X, Z2Z2);
+  fp2_mul(U2, e.X, Z1Z1);
+  fp2_mul(t, acc.Y, e.Z);
+  fp2_mul(S1, t, Z2Z2);
+  fp2_mul(t, e.Y, acc.Z);
+  fp2_mul(S2, t, Z1Z1);
+  fp2_sub(H, U2, U1);
+  fp2_sub(Rr, S2, S1);
+  if (fp2_is_zero(H)) {
+    if (fp2_is_zero(Rr)) {
+      G2Jac d;
+      g2_double(d, acc);
+      acc = d;
+      return;
+    }
+    memset(&acc, 0, sizeof(acc));
+    return;
+  }
+  Fp2 HH, HHH, V, x3, y3, z3, t2, v2;
+  fp2_sqr(HH, H);
+  fp2_mul(HHH, H, HH);
+  fp2_mul(V, U1, HH);
+  fp2_sqr(t, Rr);
+  fp2_sub(t, t, HHH);
+  fp2_add(v2, V, V);
+  fp2_sub(x3, t, v2);
+  fp2_sub(t, V, x3);
+  fp2_mul(t, Rr, t);
+  fp2_mul(t2, S1, HHH);
+  fp2_sub(y3, t, t2);
+  fp2_mul(t, acc.Z, e.Z);
+  fp2_mul(z3, t, H);
+  acc.X = x3;
+  acc.Y = y3;
+  acc.Z = z3;
+}
+
+static void fp2_inv(Fp2 &r, const Fp2 &a) {
+  // (a0 + a1 u)^-1 = (a0 - a1 u) / (a0^2 + a1^2)
+  u64 n0[4], n1[4], d[4], di[4];
+  mont_sqr(n0, a.c0);
+  mont_sqr(n1, a.c1);
+  add_mod(d, n0, n1);
+  mont_inv(di, d);
+  mont_mul(r.c0, a.c0, di);
+  u64 neg[4];
+  sub_mod(neg, (const u64 *)ZERO, a.c1);
+  mont_mul(r.c1, neg, di);
+}
+
+extern "C" {
+
+// G1 fixed-base batch, Montgomery-form output, batch-inverted
+// normalization (one field inversion for the whole batch instead of one
+// per point — the Montgomery trick).  out: n * 8 u64 (x, y) Montgomery;
+// (0,0) = infinity.
+void g1_fixed_base_batch_mont(const u64 *base_xy, const u64 *scalars, int n, u64 *out_xy) {
+  static G1Jac table[32][256];
+  u64 bx[4], by[4];
+  fp_to_mont(base_xy, bx, 1);
+  fp_to_mont(base_xy + 4, by, 1);
+
+  G1Jac wbase;
+  memcpy(wbase.X, bx, 32);
+  memcpy(wbase.Y, by, 32);
+  memcpy(wbase.Z, ONE_MONT, 32);
+  for (int w = 0; w < 32; ++w) {
+    memset(&table[w][0], 0, sizeof(G1Jac));
+    u64 zi[4], zi2[4], zi3[4], ax[4], ay[4];
+    mont_inv(zi, wbase.Z);
+    mont_sqr(zi2, zi);
+    mont_mul(zi3, zi2, zi);
+    mont_mul(ax, wbase.X, zi2);
+    mont_mul(ay, wbase.Y, zi3);
+    for (int d = 1; d < 256; ++d) jac_add_mixed(table[w][d], table[w][d - 1], ax, ay);
+    for (int k = 0; k < 8; ++k) jac_double(wbase, wbase);
+  }
+
+  G1Jac *accs = new G1Jac[n];
+  for (int i = 0; i < n; ++i) {
+    const u64 *s = scalars + 4 * i;
+    G1Jac acc;
+    memset(&acc, 0, sizeof(acc));
+    for (int w = 0; w < 32; ++w) {
+      int d = (int)((s[w / 8] >> ((w % 8) * 8)) & 0xff);
+      if (!d) continue;
+      const G1Jac &e = table[w][d];
+      if (is_zero4(acc.Z)) {
+        acc = e;
+      } else {
+        // full Jacobian add (table entries are Jacobian)
+        u64 Z1Z1[4], Z2Z2[4], U1[4], U2[4], S1[4], S2[4], H[4], Rr[4], t[4];
+        mont_sqr(Z1Z1, acc.Z);
+        mont_sqr(Z2Z2, e.Z);
+        mont_mul(U1, acc.X, Z2Z2);
+        mont_mul(U2, e.X, Z1Z1);
+        mont_mul(t, acc.Y, e.Z);
+        mont_mul(S1, t, Z2Z2);
+        mont_mul(t, e.Y, acc.Z);
+        mont_mul(S2, t, Z1Z1);
+        sub_mod(H, U2, U1);
+        sub_mod(Rr, S2, S1);
+        if (is_zero4(H)) {
+          if (is_zero4(Rr)) {
+            jac_double(acc, acc);
+            continue;
+          }
+          memset(&acc, 0, sizeof(acc));
+          continue;
+        }
+        u64 HH[4], HHH[4], V[4], x3[4], y3[4], z3[4], t2[4], v2[4];
+        mont_sqr(HH, H);
+        mont_mul(HHH, H, HH);
+        mont_mul(V, U1, HH);
+        mont_sqr(t, Rr);
+        sub_mod(t, t, HHH);
+        add_mod(v2, V, V);
+        sub_mod(x3, t, v2);
+        sub_mod(t, V, x3);
+        mont_mul(t, Rr, t);
+        mont_mul(t2, S1, HHH);
+        sub_mod(y3, t, t2);
+        mont_mul(t, acc.Z, e.Z);
+        mont_mul(z3, t, H);
+        memcpy(acc.X, x3, 32);
+        memcpy(acc.Y, y3, 32);
+        memcpy(acc.Z, z3, 32);
+      }
+    }
+    accs[i] = acc;
+  }
+
+  // Batch inversion of all Zs (Montgomery trick), skipping infinities.
+  u64 *prefix = new u64[4 * (n + 1)];
+  memcpy(prefix, ONE_MONT, 32);
+  for (int i = 0; i < n; ++i) {
+    const u64 *z = accs[i].Z;
+    if (is_zero4(z)) {
+      memcpy(prefix + 4 * (i + 1), prefix + 4 * i, 32);
+    } else {
+      mont_mul(prefix + 4 * (i + 1), prefix + 4 * i, z);
+    }
+  }
+  u64 inv_all[4];
+  mont_inv(inv_all, prefix + 4 * n);
+  for (int i = n - 1; i >= 0; --i) {
+    u64 *o = out_xy + 8 * i;
+    if (is_zero4(accs[i].Z)) {
+      memset(o, 0, 64);
+      continue;
+    }
+    u64 zi[4], zi2[4], zi3[4];
+    mont_mul(zi, prefix + 4 * i, inv_all);        // Z_i^-1
+    mont_mul(inv_all, inv_all, accs[i].Z);        // strip Z_i
+    mont_sqr(zi2, zi);
+    mont_mul(zi3, zi2, zi);
+    mont_mul(o, accs[i].X, zi2);
+    mont_mul(o + 4, accs[i].Y, zi3);
+  }
+  delete[] prefix;
+  delete[] accs;
+}
+
+// G2 fixed-base batch, Montgomery output.  base: (x.c0, x.c1, y.c0, y.c1)
+// standard form (16 u64); out: n * 16 u64 Montgomery; all-zero = infinity.
+void g2_fixed_base_batch_mont(const u64 *base, const u64 *scalars, int n, u64 *out) {
+  static G2Jac table[32][256];
+  Fp2 bx, by;
+  fp_to_mont(base, bx.c0, 1);
+  fp_to_mont(base + 4, bx.c1, 1);
+  fp_to_mont(base + 8, by.c0, 1);
+  fp_to_mont(base + 12, by.c1, 1);
+
+  G2Jac wbase;
+  wbase.X = bx;
+  wbase.Y = by;
+  memcpy(wbase.Z.c0, ONE_MONT, 32);
+  memset(wbase.Z.c1, 0, 32);
+  for (int w = 0; w < 32; ++w) {
+    memset(&table[w][0], 0, sizeof(G2Jac));
+    Fp2 zi, zi2, zi3, ax, ay;
+    fp2_inv(zi, wbase.Z);
+    fp2_sqr(zi2, zi);
+    fp2_mul(zi3, zi2, zi);
+    fp2_mul(ax, wbase.X, zi2);
+    fp2_mul(ay, wbase.Y, zi3);
+    for (int d = 1; d < 256; ++d) g2_add_mixed(table[w][d], table[w][d - 1], ax, ay);
+    G2Jac t;
+    for (int k = 0; k < 8; ++k) {
+      g2_double(t, wbase);
+      wbase = t;
+    }
+  }
+
+  G2Jac *accs = new G2Jac[n];
+  for (int i = 0; i < n; ++i) {
+    const u64 *s = scalars + 4 * i;
+    G2Jac acc;
+    memset(&acc, 0, sizeof(acc));
+    for (int w = 0; w < 32; ++w) {
+      int d = (int)((s[w / 8] >> ((w % 8) * 8)) & 0xff);
+      if (!d) continue;
+      g2_add(acc, table[w][d]);
+    }
+    accs[i] = acc;
+  }
+
+  // Batch inversion in Fq2 via prefix products.
+  Fp2 *prefix = new Fp2[n + 1];
+  memcpy(prefix[0].c0, ONE_MONT, 32);
+  memset(prefix[0].c1, 0, 32);
+  for (int i = 0; i < n; ++i) {
+    if (fp2_is_zero(accs[i].Z)) {
+      prefix[i + 1] = prefix[i];
+    } else {
+      fp2_mul(prefix[i + 1], prefix[i], accs[i].Z);
+    }
+  }
+  Fp2 inv_all;
+  fp2_inv(inv_all, prefix[n]);
+  for (int i = n - 1; i >= 0; --i) {
+    u64 *o = out + 16 * i;
+    if (fp2_is_zero(accs[i].Z)) {
+      memset(o, 0, 128);
+      continue;
+    }
+    Fp2 zi, zi2, zi3, mx, my, t;
+    fp2_mul(zi, prefix[i], inv_all);
+    fp2_mul(t, inv_all, accs[i].Z);
+    inv_all = t;
+    fp2_sqr(zi2, zi);
+    fp2_mul(zi3, zi2, zi);
+    fp2_mul(mx, accs[i].X, zi2);
+    fp2_mul(my, accs[i].Y, zi3);
+    memcpy(o, mx.c0, 32);
+    memcpy(o + 4, mx.c1, 32);
+    memcpy(o + 8, my.c0, 32);
+    memcpy(o + 12, my.c1, 32);
+  }
+  delete[] prefix;
+  delete[] accs;
+}
+
+}  // extern "C"

@@ -37,6 +37,48 @@ def test_fixed_base_batch_matches_oracle():
         assert pt == g1_mul(G1_GENERATOR, k), k
 
 
+def test_g1_mont_limbs_matches_oracle():
+    """The Montgomery-limb fast path (batch-inverted normalization) emits
+    exactly what g1_to_affine_arrays(host points) would."""
+    import numpy as np
+
+    from zkp2p_tpu.field.jfield import FQ
+
+    ks = [rng.randrange(R) for _ in range(40)] + [0, 1, R - 1]
+    res = native.g1_fixed_base_batch_mont_limbs(G1_GENERATOR, ks)
+    assert res is not None
+    xs, ys = res
+    for i, k in enumerate(ks):
+        pt = g1_mul(G1_GENERATOR, k)
+        if pt is None:
+            assert not xs[i].any() and not ys[i].any()
+        else:
+            assert np.array_equal(xs[i], FQ.to_mont_host(pt[0])), k
+            assert np.array_equal(ys[i], FQ.to_mont_host(pt[1])), k
+
+
+def test_g2_mont_limbs_matches_oracle():
+    import numpy as np
+
+    from zkp2p_tpu.curve.host import G2_GENERATOR, g2_mul
+    from zkp2p_tpu.field.jfield import FQ
+
+    ks = [rng.randrange(R) for _ in range(15)] + [0, 1, R - 1]
+    res = native.g2_fixed_base_batch_mont_limbs(G2_GENERATOR, ks)
+    assert res is not None
+    xs, ys = res
+    for i, k in enumerate(ks):
+        pt = g2_mul(G2_GENERATOR, k)
+        if pt is None:
+            assert not xs[i].any() and not ys[i].any()
+        else:
+            x, y = pt
+            assert np.array_equal(xs[i, 0], FQ.to_mont_host(x.c0)), k
+            assert np.array_equal(xs[i, 1], FQ.to_mont_host(x.c1)), k
+            assert np.array_equal(ys[i, 0], FQ.to_mont_host(y.c0)), k
+            assert np.array_equal(ys[i, 1], FQ.to_mont_host(y.c1)), k
+
+
 def test_setup_uses_native_and_matches():
     """setup must produce identical keys whether or not the native path is
     active (same seed -> same tau -> same points)."""

@@ -1,0 +1,59 @@
+"""Array-path setup vs the Python-object reference setup (same seed)."""
+
+import numpy as np
+import pytest
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.native import lib as native
+from zkp2p_tpu.snark.groth16 import setup
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None, reason="native toolchain unavailable")
+
+
+def _circuit():
+    cs = ConstraintSystem("sd")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x) + LC.const(3), LC.of(y), LC.of(z), "mul")
+    cs.enforce(LC.of(z), LC.of(z) - LC.of(x), LC.of(out), "sq")
+    cs.compute(z, lambda a, b: (a + 3) * b % R, [x, y])
+    return cs, x, y
+
+
+def test_setup_device_matches_reference():
+    from zkp2p_tpu.prover.groth16_tpu import device_pk
+    from zkp2p_tpu.prover.setup_device import setup_device
+
+    cs, x, y = _circuit()
+    pk, vk = setup(cs, seed="sd-test")
+    want = device_pk(pk, cs)
+    got, vk2 = setup_device(cs, seed="sd-test")
+
+    for f in ("a_coeff", "a_wire", "a_row", "b_coeff", "b_wire", "b_row"):
+        np.testing.assert_array_equal(np.asarray(getattr(got, f)), np.asarray(getattr(want, f)), err_msg=f)
+    for f in ("a_bases", "b1_bases", "b2_bases", "c_bases", "h_bases"):
+        for i, (g, w) in enumerate(zip(getattr(got, f), getattr(want, f))):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=f"{f}[{i}]")
+    assert (got.alpha_1, got.beta_1, got.beta_2, got.delta_1, got.delta_2) == (
+        pk.alpha_1, pk.beta_1, pk.beta_2, pk.delta_1, pk.delta_2
+    )
+    assert vk2.ic == vk.ic and vk2.gamma_2 == vk.gamma_2
+
+
+@pytest.mark.slow
+def test_setup_device_proves():
+    from zkp2p_tpu.prover.groth16_tpu import prove_tpu
+    from zkp2p_tpu.prover.setup_device import setup_device
+    from zkp2p_tpu.snark.groth16 import verify
+
+    cs, x, y = _circuit()
+    dpk, vk = setup_device(cs, seed="sd-test")
+    z = (4 + 3) * 5 % R
+    out = z * (z - 4) % R
+    w = cs.witness([out], {x: 4, y: 5})
+    cs.check_witness(w)
+    proof = prove_tpu(dpk, w, r=31, s=37)
+    assert verify(vk, proof, [out])

@@ -1,0 +1,316 @@
+"""snarkjs `.zkey` read/write — monolithic AND b..k-chunked.
+
+The reference's entire key pipeline is zkey-shaped: setup emits
+`circuit_final.zkey` (`dizkus-scripts/3_gen_both_zkeys.sh:18-65`), the
+browser downloads it as ten chunks `circuit.zkeyb..k`
+(`app/src/helpers/zkp.ts:13`, `upload_chunked_keys_to_s3.sh:13-23`), and
+every prover consumes it.  Supporting the format both ways means
+
+  - a ceremony key produced by the actual reference toolchain can be
+    imported and proven with `prover=tpu` (drop-in compatibility), and
+  - our development setup can be exported for stock snarkjs to prove /
+    verify against (differential verification), and
+  - the CLI's key persistence is a documented public format instead of
+    pickle (round-1 advisor finding).
+
+Format (iden3 binfile, magic "zkey", version 1; snarkjs
+src/zkey_utils.js): sections [type u32][size u64][payload]:
+
+  1 header        : protocol id u32 (1 = groth16)
+  2 groth16 header: n8q u32, q, n8r u32, r, nVars u32, nPublic u32,
+                    domainSize u32, alpha1 G1, beta1 G1, beta2 G2,
+                    gamma2 G2, delta1 G1, delta2 G2
+  3 IC            : (nPublic+1) G1
+  4 coeffs        : nCoeffs u32, then [matrix u32, row u32, wire u32,
+                    value Fr] — matrices A(0)/B(1) only, INCLUDING the
+                    public-input binding rows appended after the R1CS
+                    rows (row = nConstraints + i, wire = i, value = 1),
+                    exactly our `snark.groth16.qap_rows` convention
+  5..8 A/B1/B2/C  : per-wire query points (C omits wires 0..nPublic)
+  9 H             : domainSize G1 points — the coset-Lagrange basis
+                    (our setup adopts the identical odd-coset convention,
+                    `snark.groth16.coset_gen`)
+  10 contributions: ceremony transcript (opaque here)
+
+All field elements are little-endian **Montgomery** form (R = 2^256),
+per snarkjs `toRprLEM`/`fromRprLEM`; infinity is all-zero bytes.
+
+Chunked form: the forks split the byte stream into equal slices with
+suffixes b..k; `read_zkey` accepts either one path or the chunk list and
+`split_zkey` produces the chunks (`zkp.ts:13` suffix convention).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..curve.host import G1Point, G2Point
+from ..field.bn254 import MONT_R, P, R
+from ..field.tower import Fq2
+from ..snark.groth16 import ProvingKey, VerifyingKey
+
+ZKEY_MAGIC = b"zkey"
+_Q_INV = pow(MONT_R, -1, P)
+_R_INV = pow(MONT_R, -1, R)
+N8 = 32
+
+CHUNK_SUFFIXES = "bcdefghijk"  # app/src/helpers/zkp.ts:13
+
+
+# ------------------------------------------------------------ primitives
+
+
+def _fq_to_m(x: int) -> bytes:
+    return (x * MONT_R % P).to_bytes(N8, "little")
+
+
+def _fq_from_m(b: bytes) -> int:
+    return int.from_bytes(b, "little") * _Q_INV % P
+
+
+def _fr_to_m(x: int) -> bytes:
+    return (x * MONT_R % R).to_bytes(N8, "little")
+
+
+def _fr_from_m(b: bytes) -> int:
+    return int.from_bytes(b, "little") * _R_INV % R
+
+
+def _g1_bytes(pt: G1Point) -> bytes:
+    if pt is None:
+        return b"\x00" * (2 * N8)
+    return _fq_to_m(pt[0]) + _fq_to_m(pt[1])
+
+
+def _g1_parse(b: bytes) -> G1Point:
+    if b == b"\x00" * (2 * N8):
+        return None
+    return (_fq_from_m(b[:N8]), _fq_from_m(b[N8:]))
+
+
+def _g2_bytes(pt: G2Point) -> bytes:
+    if pt is None:
+        return b"\x00" * (4 * N8)
+    x, y = pt
+    return _fq_to_m(x.c0) + _fq_to_m(x.c1) + _fq_to_m(y.c0) + _fq_to_m(y.c1)
+
+
+def _g2_parse(b: bytes) -> G2Point:
+    if b == b"\x00" * (4 * N8):
+        return None
+    vals = [_fq_from_m(b[i * N8 : (i + 1) * N8]) for i in range(4)]
+    return (Fq2(vals[0], vals[1]), Fq2(vals[2], vals[3]))
+
+
+# ------------------------------------------------------------ data model
+
+
+@dataclass
+class ZkeyData:
+    n_vars: int
+    n_public: int
+    domain_size: int
+    alpha_1: G1Point
+    beta_1: G1Point
+    beta_2: G2Point
+    gamma_2: G2Point
+    delta_1: G1Point
+    delta_2: G2Point
+    ic: List[G1Point]
+    # (matrix 0/1, row, wire, value) — includes public binding rows
+    coeffs: List[Tuple[int, int, int, int]]
+    a_query: List[G1Point]
+    b1_query: List[G1Point]
+    b2_query: List[G2Point]
+    c_query: List[Optional[G1Point]]  # None for wires 0..n_public
+    h_query: List[G1Point]
+
+    def to_proving_key(self) -> ProvingKey:
+        return ProvingKey(
+            n_public=self.n_public,
+            domain_size=self.domain_size,
+            alpha_1=self.alpha_1,
+            beta_1=self.beta_1,
+            beta_2=self.beta_2,
+            delta_1=self.delta_1,
+            delta_2=self.delta_2,
+            a_query=self.a_query,
+            b1_query=self.b1_query,
+            b2_query=self.b2_query,
+            c_query=self.c_query,
+            h_query=self.h_query,
+        )
+
+    def to_verifying_key(self) -> VerifyingKey:
+        return VerifyingKey(
+            n_public=self.n_public,
+            alpha_1=self.alpha_1,
+            beta_2=self.beta_2,
+            gamma_2=self.gamma_2,
+            delta_2=self.delta_2,
+            ic=list(self.ic),
+        )
+
+    def qap_row_arrays(self) -> Tuple[List[Dict[int, int]], List[Dict[int, int]]]:
+        """Coeff section -> per-row A and B wire->value dicts (the shape
+        `prover.groth16_tpu.device_pk_from_rows` consumes)."""
+        n_rows = max((row for _m, row, _w, _v in self.coeffs), default=-1) + 1
+        a: List[Dict[int, int]] = [dict() for _ in range(n_rows)]
+        b: List[Dict[int, int]] = [dict() for _ in range(n_rows)]
+        for m, row, wire, value in self.coeffs:
+            tgt = a if m == 0 else b
+            tgt[row][wire] = (tgt[row].get(wire, 0) + value) % R
+        return a, b
+
+
+# ------------------------------------------------------------------ write
+
+
+def write_zkey(path: str, pk: ProvingKey, vk: VerifyingKey, qap_rows) -> None:
+    """Serialize our key material as a snarkjs-readable zkey.
+
+    `qap_rows` is `snark.groth16.qap_rows(cs)` — R1CS rows + the appended
+    public binding rows, written to the coeff section the same way
+    snarkjs's setup does."""
+    sections: List[Tuple[int, bytes]] = []
+    sections.append((1, struct.pack("<I", 1)))  # groth16
+
+    hdr = struct.pack("<I", N8) + P.to_bytes(N8, "little")
+    hdr += struct.pack("<I", N8) + R.to_bytes(N8, "little")
+    n_vars = len(pk.a_query)
+    hdr += struct.pack("<III", n_vars, pk.n_public, pk.domain_size)
+    hdr += _g1_bytes(pk.alpha_1) + _g1_bytes(pk.beta_1) + _g2_bytes(pk.beta_2)
+    hdr += _g2_bytes(vk.gamma_2) + _g1_bytes(pk.delta_1) + _g2_bytes(pk.delta_2)
+    sections.append((2, hdr))
+
+    sections.append((3, b"".join(_g1_bytes(p) for p in vk.ic)))
+
+    coeffs = bytearray()
+    n_coeffs = 0
+    for m in (0, 1):
+        for row, triple in enumerate(qap_rows):
+            for wire, value in triple[m].items():
+                coeffs += struct.pack("<III", m, row, wire) + _fr_to_m(value)
+                n_coeffs += 1
+    sections.append((4, struct.pack("<I", n_coeffs) + bytes(coeffs)))
+
+    sections.append((5, b"".join(_g1_bytes(p) for p in pk.a_query)))
+    sections.append((6, b"".join(_g1_bytes(p) for p in pk.b1_query)))
+    sections.append((7, b"".join(_g2_bytes(p) for p in pk.b2_query)))
+    sections.append(
+        (8, b"".join(_g1_bytes(p) for p in pk.c_query[pk.n_public + 1 :]))
+    )
+    sections.append((9, b"".join(_g1_bytes(p) for p in pk.h_query)))
+    sections.append((10, struct.pack("<I", 0)))  # no contributions (dev setup)
+
+    with open(path, "wb") as f:
+        f.write(ZKEY_MAGIC)
+        f.write(struct.pack("<II", 1, len(sections)))
+        for stype, payload in sections:
+            f.write(struct.pack("<IQ", stype, len(payload)))
+            f.write(payload)
+
+
+def split_zkey(path: str, n_chunks: int = 10) -> List[str]:
+    """Monolithic zkey -> `path` + suffix chunks b..k (`zkp.ts:13`)."""
+    if not 1 <= n_chunks <= len(CHUNK_SUFFIXES):
+        raise ValueError(f"n_chunks must be 1..{len(CHUNK_SUFFIXES)} (suffixes {CHUNK_SUFFIXES})")
+    with open(path, "rb") as f:
+        data = f.read()
+    per = (len(data) + n_chunks - 1) // n_chunks
+    out = []
+    for i in range(n_chunks):
+        p = path + CHUNK_SUFFIXES[i]
+        with open(p, "wb") as f:
+            f.write(data[i * per : (i + 1) * per])
+        out.append(p)
+    return out
+
+
+# ------------------------------------------------------------------- read
+
+
+def read_zkey(path_or_chunks) -> ZkeyData:
+    """Parse a zkey from one path or an ordered chunk-path list."""
+    if isinstance(path_or_chunks, (list, tuple)):
+        data = b""
+        for p in path_or_chunks:
+            with open(p, "rb") as f:
+                data += f.read()
+    else:
+        with open(path_or_chunks, "rb") as f:
+            data = f.read()
+    assert data[:4] == ZKEY_MAGIC, f"bad magic {data[:4]!r}"
+    _version, n_sections = struct.unpack_from("<II", data, 4)
+    off = 12
+    sections: Dict[int, bytes] = {}
+    for _ in range(n_sections):
+        stype, size = struct.unpack_from("<IQ", data, off)
+        off += 12
+        sections[stype] = data[off : off + size]
+        off += size
+
+    (protocol,) = struct.unpack_from("<I", sections[1], 0)
+    assert protocol == 1, f"not a groth16 zkey (protocol {protocol})"
+
+    hdr = sections[2]
+    o = 0
+    (n8q,) = struct.unpack_from("<I", hdr, o)
+    o += 4
+    q = int.from_bytes(hdr[o : o + n8q], "little")
+    o += n8q
+    assert n8q == N8 and q == P, "not a BN254 zkey"
+    (n8r,) = struct.unpack_from("<I", hdr, o)
+    o += 4
+    r = int.from_bytes(hdr[o : o + n8r], "little")
+    o += n8r
+    assert n8r == N8 and r == R
+    n_vars, n_public, domain_size = struct.unpack_from("<III", hdr, o)
+    o += 12
+    alpha_1 = _g1_parse(hdr[o : o + 64]); o += 64
+    beta_1 = _g1_parse(hdr[o : o + 64]); o += 64
+    beta_2 = _g2_parse(hdr[o : o + 128]); o += 128
+    gamma_2 = _g2_parse(hdr[o : o + 128]); o += 128
+    delta_1 = _g1_parse(hdr[o : o + 64]); o += 64
+    delta_2 = _g2_parse(hdr[o : o + 128]); o += 128
+
+    ic = [_g1_parse(sections[3][i * 64 : (i + 1) * 64]) for i in range(n_public + 1)]
+
+    cbuf = sections[4]
+    (n_coeffs,) = struct.unpack_from("<I", cbuf, 0)
+    coeffs = []
+    o = 4
+    for _ in range(n_coeffs):
+        m, row, wire = struct.unpack_from("<III", cbuf, o)
+        o += 12
+        coeffs.append((m, row, wire, _fr_from_m(cbuf[o : o + N8])))
+        o += N8
+
+    a_query = [_g1_parse(sections[5][i * 64 : (i + 1) * 64]) for i in range(n_vars)]
+    b1_query = [_g1_parse(sections[6][i * 64 : (i + 1) * 64]) for i in range(n_vars)]
+    b2_query = [_g2_parse(sections[7][i * 128 : (i + 1) * 128]) for i in range(n_vars)]
+    n_priv = n_vars - n_public - 1
+    c_priv = [_g1_parse(sections[8][i * 64 : (i + 1) * 64]) for i in range(n_priv)]
+    c_query: List[Optional[G1Point]] = [None] * (n_public + 1) + c_priv
+    h_query = [_g1_parse(sections[9][i * 64 : (i + 1) * 64]) for i in range(domain_size)]
+
+    return ZkeyData(
+        n_vars=n_vars,
+        n_public=n_public,
+        domain_size=domain_size,
+        alpha_1=alpha_1,
+        beta_1=beta_1,
+        beta_2=beta_2,
+        gamma_2=gamma_2,
+        delta_1=delta_1,
+        delta_2=delta_2,
+        ic=ic,
+        coeffs=coeffs,
+        a_query=a_query,
+        b1_query=b1_query,
+        b2_query=b2_query,
+        c_query=c_query,
+        h_query=h_query,
+    )

@@ -89,13 +89,15 @@ def make_test_key(seed: int = 1) -> TestRsaKey:
 
 @dataclass
 class SyntheticEmail:
-    """A miniature Venmo receipt: canonicalized header + QP body, signed."""
+    """A circuit-facing email: canonicalized signed header + canonical
+    body + RSA signature (synthetic OR parsed from a real .eml)."""
 
     header: bytes  # canonicalized, incl. dkim-signature header with bh=
     body: bytes
     signature: int
     raw_id: str
     amount: str
+    modulus: int | None = None  # DKIM RSA modulus when resolved from a registry
 
 
 def make_venmo_email(
@@ -190,19 +192,31 @@ def generate_email_verify_inputs(email: SyntheticEmail, modulus: int, params, la
 # ------------------------------------------------------------ real emails
 
 
-def email_from_eml(raw_eml: bytes, keys=None) -> SyntheticEmail:
-    """Real .eml -> the circuit-facing email object: DKIM-canonicalized
-    signed header data + canonical body + signature, with the Venmo id and
-    amount located in the content (generate_input.ts:191-231 semantics)."""
-    import re as _re
-
+def _verified_eml(raw_eml: bytes, keys):
+    """Shared .eml preamble: registry default, canonicalize, check body
+    hash + (when the key is known) the RSA signature."""
     from .dkim import extract_and_verify
 
+    if keys is None:
+        from .known_keys import default_registry
+
+        keys = default_registry()
     v = extract_and_verify(raw_eml, keys)
     if not v.body_hash_ok:
         raise ValueError("DKIM body hash mismatch")
     if v.signature_ok is False:
         raise ValueError("DKIM signature invalid")
+    return v
+
+
+def email_from_eml(raw_eml: bytes, keys=None) -> SyntheticEmail:
+    """Real .eml -> the circuit-facing email object: DKIM-canonicalized
+    signed header data + canonical body + signature, with the Venmo id and
+    amount located in the content (generate_input.ts:191-231 semantics).
+    DKIM keys resolve from known_keys.default_registry when none given."""
+    import re as _re
+
+    v = _verified_eml(raw_eml, keys)
     m = _re.search(rb"user_id=3D([0-9=\r\n]+)", v.body_canon)
     raw_id = m.group(1).replace(b"=\r\n", b"").decode() if m else ""
     # the subject may not be in the signed set (h=); fall back to the raw
@@ -215,7 +229,30 @@ def email_from_eml(raw_eml: bytes, keys=None) -> SyntheticEmail:
         signature=v.signature,
         raw_id=raw_id,
         amount=amount,
+        modulus=v.modulus,
     )
+
+
+def email_verify_from_eml(raw_eml: bytes, keys=None):
+    """Real .eml -> (email object, modulus) for the EmailVerify family:
+    DKIM verify against the key registry (known_keys.default_registry
+    when none given), extract the @handle the TwitterResetRegex reveals
+    (`twitter_reset_regex.circom:5`).  Validated against the reference
+    fixture `app/src/__fixtures__/email/zktestemail.test-eml`."""
+    import re as _re
+
+    v = _verified_eml(raw_eml, keys)
+    m = _re.search(rb"meant for @([A-Za-z0-9_]+)", v.body_canon)
+    handle = m.group(1).decode() if m else ""
+    email = SyntheticEmail(
+        header=v.signed_data,
+        body=v.body_canon,
+        signature=v.signature,
+        raw_id=handle,
+        amount="0",
+        modulus=v.modulus,
+    )
+    return email, v.modulus
 
 
 # --------------------------------------------------------- input generation

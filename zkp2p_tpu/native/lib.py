@@ -51,6 +51,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return None
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.g1_fixed_base_batch.argtypes = [u64p, u64p, ctypes.c_int, u64p]
+    lib.g1_fixed_base_batch_mont.argtypes = [u64p, u64p, ctypes.c_int, u64p]
+    lib.g2_fixed_base_batch_mont.argtypes = [u64p, u64p, ctypes.c_int, u64p]
     lib.fp_mul_std.argtypes = [u64p, u64p, u64p]
     # Self-check before trusting it: one field mul against Python ints AND
     # one fixed-base scalar mul against the host curve oracle, so a library
@@ -101,3 +103,56 @@ def g1_fixed_base_batch(base: Tuple[int, int], scalars: Sequence[int]) -> Option
         y = _u64x4_to_int(out[i, 4:])
         res.append(None if x == 0 and y == 0 else (x, y))
     return res
+
+
+def _scalars_to_u64(scalars: Sequence[int]) -> np.ndarray:
+    """(n, 4) u64 little-endian — via one bytes join, not a Python limb
+    loop (to_bytes is C-speed; this path handles millions of scalars)."""
+    buf = b"".join(int(s).to_bytes(32, "little") for s in scalars)
+    return np.frombuffer(buf, dtype="<u8").reshape(len(scalars), 4)
+
+
+def _u64_to_limbs16(a: np.ndarray) -> np.ndarray:
+    """(..., 4) u64 -> (..., 16) u32 of 16-bit limbs (the jfield layout)."""
+    return np.ascontiguousarray(a).view("<u2").astype(np.uint32).reshape(*a.shape[:-1], 16)
+
+
+def g1_fixed_base_batch_mont_limbs(base: Tuple[int, int], scalars: Sequence[int]):
+    """Batch k_i * base over G1, emitted directly as Montgomery (n, 16)
+    u32 limb arrays (the DeviceProvingKey base layout) — skips every
+    per-point Python conversion.  None if the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(scalars)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    base_arr = np.concatenate([_int_to_u64x4(base[0]), _int_to_u64x4(base[1])])
+    sc = np.ascontiguousarray(_scalars_to_u64(scalars))
+    out = np.zeros((n, 8), dtype=np.uint64)
+    lib.g1_fixed_base_batch_mont(
+        base_arr.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, out.ctypes.data_as(u64p)
+    )
+    limbs = _u64_to_limbs16(out.reshape(n, 2, 4))  # (n, 2, 16)
+    return limbs[:, 0], limbs[:, 1]
+
+
+def g2_fixed_base_batch_mont_limbs(base, scalars: Sequence[int]):
+    """Batch k_i * base over G2 -> Montgomery (n, 2, 16) u32 limb arrays
+    (x, y as Fq2 pairs).  `base` is a host G2Point ((Fq2, Fq2) affine).
+    None if the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(scalars)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    x, y = base
+    base_arr = np.concatenate(
+        [_int_to_u64x4(x.c0), _int_to_u64x4(x.c1), _int_to_u64x4(y.c0), _int_to_u64x4(y.c1)]
+    )
+    sc = np.ascontiguousarray(_scalars_to_u64(scalars))
+    out = np.zeros((n, 16), dtype=np.uint64)
+    lib.g2_fixed_base_batch_mont(
+        base_arr.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, out.ctypes.data_as(u64p)
+    )
+    limbs = _u64_to_limbs16(out.reshape(n, 4, 4))  # (n, 4, 16): x0 x1 y0 y1
+    return limbs[:, 0:2], limbs[:, 2:4]

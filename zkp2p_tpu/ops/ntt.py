@@ -67,20 +67,40 @@ def domain(log_m: int):
 
 
 def _ntt_core(x: jnp.ndarray, tw: jnp.ndarray, perm: np.ndarray) -> jnp.ndarray:
-    """Iterative DIT butterfly ladder on (..., m, 16) Montgomery limbs."""
+    """Iterative DIT butterfly ladder on (..., m, 16) Montgomery limbs.
+
+    ONE `fori_loop` stage body with gather-based butterflies instead of an
+    unrolled per-stage reshape ladder: XLA compile time scales with traced
+    graph size, and at the production domain (2^23, log m = 23 stages) the
+    unrolled form made every prover compile minutes-long.  All stage
+    geometry (butterfly stride, twiddle stride) is computed from the
+    traced stage index with shifts, so the compiled body is shared by all
+    log m iterations."""
     m = x.shape[-2]
+    if m == 1:
+        return x
+    log_m = m.bit_length() - 1
     x = x[..., perm, :]
-    length = 1
-    while length < m:
-        # Stage twiddles: w^(j * m/(2*length)) for j < length.
-        stage_tw = tw[:: m // (2 * length)][:length]  # (length, 16)
-        blocks = x.reshape(x.shape[:-2] + (m // (2 * length), 2, length, NUM_LIMBS))
-        a = blocks[..., 0, :, :]
-        b = FR.mul(blocks[..., 1, :, :], stage_tw)
-        x = jnp.concatenate([FR.add(a, b)[..., None, :, :], FR.sub(a, b)[..., None, :, :]], axis=-3)
-        x = x.reshape(x.shape[:-4] + (m, NUM_LIMBS))
-        length *= 2
-    return x
+    half = m // 2
+    j = jnp.arange(half, dtype=jnp.int32)
+    k = jnp.arange(m, dtype=jnp.int32)
+
+    def stage(s, xs):
+        length = jnp.left_shift(jnp.int32(1), s)
+        mask = length - 1
+        pos = j & mask
+        i0 = ((j >> s) << (s + 1)) | pos  # butterfly low index
+        i1 = i0 | length
+        twj = pos << (log_m - 1 - s)  # stage twiddle stride m/(2*length)
+        a = jnp.take(xs, i0, axis=-2)
+        b = FR.mul(jnp.take(xs, i1, axis=-2), jnp.take(tw, twj, axis=0))
+        cat = jnp.concatenate([FR.add(a, b), FR.sub(a, b)], axis=-2)
+        # Inverse permutation: output k holds sum (bit s of k clear) or
+        # difference (set) of butterfly ((k>>(s+1))<<s) | (k & mask).
+        jk = (((k >> (s + 1)) << s) | (k & mask)) + ((k >> s) & 1) * half
+        return jnp.take(cat, jk, axis=-2)
+
+    return jax.lax.fori_loop(0, log_m, stage, x)
 
 
 def ntt(x: jnp.ndarray, log_m: int) -> jnp.ndarray:

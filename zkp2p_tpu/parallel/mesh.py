@@ -19,7 +19,7 @@ the driver's `dryrun_multichip` exercises it on virtual CPU devices.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import jax
@@ -48,6 +48,30 @@ def _fold_gathered(curve: JCurve, gathered: JacPoint, n: int) -> JacPoint:
     return acc
 
 
+@lru_cache(maxsize=None)
+def _msm_sharded_fn(curve: JCurve, n_bases: int, mesh: Mesh, axis: str, lanes: int, window: int):
+    """Cached jitted shard_map executable per (curve, mesh, msm config).
+
+    Same reuse story as parallel.ntt._ntt_sharded_fn: one executable per
+    curve/config, shared by the a/b1/c MSMs of every prove (jit re-keys on
+    operand shapes, so differing base counts still share the callable)."""
+
+    def local(bs, pl):
+        if window:
+            part = msm_windowed(curve, bs, pl, lanes=lanes, window=window)
+        else:
+            part = msm(curve, bs, pl, lanes=lanes)
+        gathered = jax.lax.all_gather(part, axis)  # (n_dev,) points on ICI
+        return _fold_gathered(curve, gathered, mesh.shape[axis])
+
+    in_specs = (
+        tuple(P(axis) for _ in range(n_bases)),
+        P(None, axis),
+    )
+    out_specs = tuple(P() for _ in range(3))
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False))
+
+
 def msm_sharded(
     curve: JCurve,
     bases: AffPoint,
@@ -66,22 +90,7 @@ def msm_sharded(
     n_dev = mesh.shape[axis]
     n = bases[0].shape[0]
     assert n % n_dev == 0, "pad the base axis to the mesh size first"
-
-    def local(bs, pl):
-        if window:
-            part = msm_windowed(curve, bs, pl, lanes=lanes, window=window)
-        else:
-            part = msm(curve, bs, pl, lanes=lanes)
-        gathered = jax.lax.all_gather(part, axis)  # (n_dev,) points on ICI
-        return _fold_gathered(curve, gathered, n_dev)
-
-    in_specs = (
-        tuple(P(axis) for _ in bases),
-        P(None, axis),
-    )
-    out_specs = tuple(P() for _ in range(3))
-    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
-    return fn(bases, planes)
+    return _msm_sharded_fn(curve, len(bases), mesh, axis, lanes, window)(bases, planes)
 
 
 def pad_to_multiple(bases: AffPoint, bit_planes: jnp.ndarray, multiple: int) -> Tuple[AffPoint, jnp.ndarray]:

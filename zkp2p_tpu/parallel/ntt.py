@@ -94,21 +94,18 @@ def _transpose_all_to_all(x: jnp.ndarray, axis: str, rows: int, cols: int, n_dev
     return swapped.transpose(2, 0, 1, 3).reshape(lc, rows, NUM_LIMBS)
 
 
-def ntt_sharded(
-    x: jnp.ndarray,
-    log_m: int,
-    mesh: Mesh,
-    axis: str = "shard",
-    inverse: bool = False,
-) -> jnp.ndarray:
-    """NTT/iNTT of a natural-order (m, 16) Montgomery vector, sharded on
-    its leading axis over `mesh`'s `axis`.  Returns the natural-order
-    result with the same sharding.  Exactly equal to ops.ntt / ops.intt.
-    """
+@lru_cache(maxsize=None)
+def _ntt_sharded_fn(log_m: int, mesh: Mesh, axis: str, inverse: bool):
+    """Cached jitted shard_map executable per (domain, mesh, direction).
+
+    Without this every `ntt_sharded` call built a fresh shard_map closure,
+    so the six transforms of one H-evaluation compiled six separate
+    executables (~7 min of XLA on a 1-core host, and 6x the work on TPU
+    too).  Cached, a prove compiles exactly two NTT executables (forward +
+    inverse) shared by the a/b/c ladders and all later proves."""
     r, c, log_r, log_c = _factor(log_m)
     n_dev = mesh.shape[axis]
     assert c % n_dev == 0 and r % n_dev == 0, "mesh must divide both factors"
-    cross = _cross_twiddles(log_m, inverse)
     d = domain(log_m)
 
     def local(xs: jnp.ndarray, cross_blk: jnp.ndarray) -> jnp.ndarray:
@@ -133,11 +130,26 @@ def ntt_sharded(
             out = FR.mul(out, d["m_inv_mont"])
         return out
 
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None, None)),
-        out_specs=P(axis, None),
-        check_rep=False,
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None, None)),
+            out_specs=P(axis, None),
+            check_rep=False,
+        )
     )
-    return fn(x, cross)
+
+
+def ntt_sharded(
+    x: jnp.ndarray,
+    log_m: int,
+    mesh: Mesh,
+    axis: str = "shard",
+    inverse: bool = False,
+) -> jnp.ndarray:
+    """NTT/iNTT of a natural-order (m, 16) Montgomery vector, sharded on
+    its leading axis over `mesh`'s `axis`.  Returns the natural-order
+    result with the same sharding.  Exactly equal to ops.ntt / ops.intt.
+    """
+    return _ntt_sharded_fn(log_m, mesh, axis, inverse)(x, _cross_twiddles(log_m, inverse))

@@ -5,7 +5,8 @@ Command-for-command parity with the reference's L2 scripts
 
   setup    ~ 1_compile.sh + 3_gen_both_zkeys.sh + 4_gen_vkey.sh +
              generate_contract.sh: build the circuit, run the dev setup,
-             write keys.pkl + verification_key.json + verifier.sol
+             write circuit_final.zkey (snarkjs format, optionally b..k
+             chunked) + verification_key.json + verifier.sol
   prove    ~ 2_gen_wtns.sh + 5/6_gen_proof: email/eml (or input.json) in,
              proof.json + public.json out, TPU prover
   verify   ~ verify_proof_groth16.sh: pairing check against the vkey
@@ -23,7 +24,6 @@ import argparse
 import glob
 import json
 import os
-import pickle
 import sys
 import time
 
@@ -38,6 +38,12 @@ def _build_circuit(name: str, header: int, body: int):
 
         params = VenmoParams(max_header_bytes=header, max_body_bytes=body)
         cs, lay = build_venmo_circuit(params)
+        return cs, (params, lay)
+    if name == "email_verify":
+        from ..models.email_verify import EmailVerifyParams, build_email_verify
+
+        params = EmailVerifyParams(max_header_bytes=header, max_body_bytes=body)
+        cs, lay = build_email_verify(params)
         return cs, (params, lay)
     if name == "sha256":
         from ..gadgets import core, sha256
@@ -62,13 +68,14 @@ def _build_circuit(name: str, header: int, body: int):
         cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
         cs.compute(z, lambda a, b: a * b % R, [x, y])
         return cs, (None, [x, y, out])
-    raise SystemExit(f"unknown circuit {name!r} (have: venmo, sha256, toy)")
+    raise SystemExit(f"unknown circuit {name!r} (have: venmo, email_verify, sha256, toy)")
 
 
 def cmd_setup(args):
     from ..formats.proof_json import dump, vkey_to_json
     from ..formats.solidity import export_verifier
-    from ..snark.groth16 import setup
+    from ..formats.zkey import split_zkey, write_zkey
+    from ..snark.groth16 import qap_rows, setup
 
     os.makedirs(args.build_dir, exist_ok=True)
     t0 = time.time()
@@ -77,53 +84,115 @@ def cmd_setup(args):
     _log(f"constraints={cs.num_constraints} wires={cs.num_wires} ({time.time()-t0:.0f}s)")
     _log("running development setup (production: import a ceremony zkey instead)")
     pk, vk = setup(cs, seed=args.seed)
-    with open(os.path.join(args.build_dir, "keys.pkl"), "wb") as f:
-        pickle.dump((pk, vk), f)
+    zkey_path = os.path.join(args.build_dir, "circuit_final.zkey")
+    write_zkey(zkey_path, pk, vk, qap_rows(cs))
+    if args.chunks:
+        split_zkey(zkey_path, args.chunks)
+        _log(f"wrote {args.chunks} zkey chunks (b..) beside {zkey_path}")
     dump(vkey_to_json(vk), os.path.join(args.build_dir, "verification_key.json"))
     with open(os.path.join(args.build_dir, "verifier.sol"), "w") as f:
         f.write(export_verifier(vk))
     _log(f"setup done in {time.time()-t0:.0f}s -> {args.build_dir}/")
 
 
-def _load_keys(build_dir):
-    with open(os.path.join(build_dir, "keys.pkl"), "rb") as f:
-        return pickle.load(f)
+def _load_zkey(args):
+    """The key material always travels as a snarkjs-format .zkey (never
+    pickle): --zkey overrides (monolithic path or glob of b..k chunks),
+    default is the build dir's circuit_final.zkey."""
+    from ..formats.zkey import read_zkey
+
+    if getattr(args, "zkey", None):
+        paths = sorted(glob.glob(args.zkey)) if any(c in args.zkey for c in "*?[") else args.zkey
+        if isinstance(paths, list) and not paths:
+            raise SystemExit(f"no zkey matches {args.zkey}")
+        return read_zkey(paths)
+    return read_zkey(os.path.join(args.build_dir, "circuit_final.zkey"))
 
 
-def _witness_for(args, cs, meta):
+def _check_zkey_matches(zk, cs):
+    """Fail fast on a key/circuit mismatch instead of deep in jitted code."""
+    from ..snark.groth16 import domain_size_for
+
+    if zk.n_vars != cs.num_wires or zk.domain_size != domain_size_for(cs):
+        raise SystemExit(
+            f"zkey does not match circuit: zkey has {zk.n_vars} wires / domain "
+            f"{zk.domain_size}, circuit has {cs.num_wires} / {domain_size_for(cs)} "
+            "(check --circuit/--max-header/--max-body against the setup)"
+        )
+
+
+def _witness_for(args, cs, meta, source=None):
+    """Build (witness, public_signals) for one input.  `source` is an
+    input file path (.eml or .json) — None falls back to --eml/--message
+    flags or the synthetic demo email."""
     params, lay = meta
     if args.circuit == "venmo":
-        from ..inputs.email import generate_inputs, make_test_key, make_venmo_email
+        from ..inputs.email import email_from_eml, generate_inputs, make_test_key, make_venmo_email
 
-        if args.eml:
-            raise SystemExit("raw .eml parsing lands with the DKIM frontend; use --demo")
-        key = make_test_key(1)
-        email = make_venmo_email(key)
-        inputs = generate_inputs(email, key.n, args.order_id, args.claim_id, params, lay)
+        src = source or getattr(args, "eml", None)
+        if src:
+            with open(src, "rb") as f:
+                email = email_from_eml(f.read())
+            if email.modulus is None:
+                raise SystemExit("unknown DKIM key; add it to inputs.known_keys")
+            modulus = email.modulus
+        else:
+            key = make_test_key(1)
+            email = make_venmo_email(key)
+            modulus = key.n
+        inputs = generate_inputs(email, modulus, args.order_id, args.claim_id, params, lay)
+        return cs.witness(inputs.public_signals, inputs.seed), inputs.public_signals
+    elif args.circuit == "email_verify":
+        from ..inputs.email import (
+            email_verify_from_eml,
+            generate_email_verify_inputs,
+            make_test_key,
+            make_twitter_email,
+        )
+
+        src = source or getattr(args, "eml", None)
+        if src:
+            with open(src, "rb") as f:
+                email, modulus = email_verify_from_eml(f.read())
+            if modulus is None:
+                raise SystemExit("unknown DKIM key; add it to inputs.known_keys")
+        else:
+            key = make_test_key(1)
+            email, modulus = make_twitter_email(key), key.n
+        inputs = generate_email_verify_inputs(email, modulus, params, lay)
         return cs.witness(inputs.public_signals, inputs.seed), inputs.public_signals
     elif args.circuit == "toy":
         from ..field.bn254 import R
 
-        data = (args.message or "35").encode().ljust(2, b"\x00")[:2]
+        msg = args.message
+        if source:
+            with open(source) as f:
+                msg = json.load(f)["message"]
+        data = (msg or "35").encode().ljust(2, b"\x00")[:2]
         x_v, y_v = data[0], data[1]
         out_v = pow(x_v * y_v, 2, R)
-        x, y, _ = meta[1]
+        x, y, _ = lay
         return cs.witness([out_v], {x: x_v, y: y_v}), [out_v]
     else:
         from ..inputs.sha_host import sha256_pad
 
-        data = (args.message or "zkp2p").encode()
-        padded, _ = sha256_pad(data, len(meta[1]))
-        return cs.witness([], {w: b for w, b in zip(meta[1], padded)}), []
+        msg = args.message
+        if source:
+            with open(source) as f:
+                msg = json.load(f)["message"]
+        data = (msg or "zkp2p").encode()
+        padded, _ = sha256_pad(data, len(lay))
+        return cs.witness([], {w: b for w, b in zip(lay, padded)}), []
 
 
 def cmd_prove(args):
     from ..formats.proof_json import dump, proof_to_json, public_to_json
-    from ..prover.groth16_tpu import device_pk, prove_tpu
+    from ..prover.groth16_tpu import device_pk_from_zkey, prove_tpu
 
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
-    pk, vk = _load_keys(args.build_dir)
-    dpk = device_pk(pk, cs)
+    zk = _load_zkey(args)
+    _check_zkey_matches(zk, cs)
+    dpk = device_pk_from_zkey(zk)
     w, pub = _witness_for(args, cs, meta)
     t0 = time.time()
     proof = prove_tpu(dpk, w)
@@ -146,31 +215,38 @@ def cmd_verify(args):
 
 
 def cmd_batch(args):
-    """Prove every input in a directory as one vmapped batch."""
-    from ..formats.proof_json import dump, proof_to_json
-    from ..inputs.sha_host import sha256_pad
-    from ..prover.groth16_tpu import device_pk, prove_tpu_batch
+    """Prove every input in a directory as one vmapped batch —
+    circuit-generic: .eml files for the email circuits, .json
+    ({"message": ...}) for sha256/toy, all through the same per-circuit
+    witness builder as `prove`."""
+    from ..formats.proof_json import dump, proof_to_json, public_to_json
+    from ..prover.groth16_tpu import device_pk_from_zkey, prove_tpu_batch
 
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
-    pk, vk = _load_keys(args.build_dir)
-    dpk = device_pk(pk, cs)
-    files = sorted(glob.glob(os.path.join(args.indir, "*.json")))
+    zk = _load_zkey(args)
+    _check_zkey_matches(zk, cs)
+    dpk = device_pk_from_zkey(zk)
+    # Per-circuit input type: email circuits consume .eml, the rest .json
+    # ({"message": ...}) — one glob per circuit so a stray file of the
+    # other type can't crash the batch or collide on output basenames.
+    ext = "*.eml" if args.circuit in ("venmo", "email_verify") else "*.json"
+    files = sorted(glob.glob(os.path.join(args.indir, ext)))
     if not files:
-        raise SystemExit(f"no inputs in {args.indir}")
-    wits = []
+        raise SystemExit(f"no {ext} inputs in {args.indir}")
+    wits, pubs = [], []
     for fp in files:
-        with open(fp) as f:
-            msg = json.load(f)["message"].encode()
-        padded, _ = sha256_pad(msg, len(meta[1]))
-        wits.append(cs.witness([], {w: b for w, b in zip(meta[1], padded)}))
+        w, pub = _witness_for(args, cs, meta, source=fp)
+        wits.append(w)
+        pubs.append(pub)
     t0 = time.time()
     proofs = prove_tpu_batch(dpk, wits)
     dt = time.time() - t0
     _log(f"batch of {len(wits)} proved in {dt:.1f}s -> {len(wits)/dt:.2f} proofs/s")
     os.makedirs(args.outdir, exist_ok=True)
-    for fp, proof in zip(files, proofs):
-        out = os.path.join(args.outdir, os.path.basename(fp).replace(".json", ".proof.json"))
-        dump(proof_to_json(proof), out)
+    for fp, proof, pub in zip(files, proofs, pubs):
+        base = os.path.basename(fp).rsplit(".", 1)[0]
+        dump(proof_to_json(proof), os.path.join(args.outdir, base + ".proof.json"))
+        dump(public_to_json(pub), os.path.join(args.outdir, base + ".public.json"))
     _log(f"wrote {len(proofs)} proofs to {args.outdir}")
 
 
@@ -182,14 +258,16 @@ def main(argv=None):
     ap.add_argument("--max-body", type=int, default=192)
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    s = sub.add_parser("setup", help="build circuit + dev keys + vkey + verifier.sol")
+    s = sub.add_parser("setup", help="build circuit + dev zkey + vkey + verifier.sol")
     s.add_argument("--seed", default="zkp2p-tpu-dev")
+    s.add_argument("--chunks", type=int, default=0, help="also split the zkey into N chunks (b..)")
     s.set_defaults(fn=cmd_setup)
 
     s = sub.add_parser("prove", help="prove one input on TPU")
-    s.add_argument("--eml", help="email file (venmo circuit)")
+    s.add_argument("--eml", help="email file (venmo / email_verify circuits)")
     s.add_argument("--demo", action="store_true", help="use the synthetic signed email")
     s.add_argument("--message", help="message (sha256 circuit)")
+    s.add_argument("--zkey", help="zkey path or chunk glob (default: BUILD_DIR/circuit_final.zkey)")
     s.add_argument("--order-id", type=int, default=1)
     s.add_argument("--claim-id", type=int, default=0)
     s.add_argument("--proof", default="proof.json")
@@ -204,6 +282,10 @@ def main(argv=None):
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")
     s.add_argument("--indir", required=True)
     s.add_argument("--outdir", required=True)
+    s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.add_argument("--message", help=argparse.SUPPRESS)
+    s.add_argument("--order-id", type=int, default=1)
+    s.add_argument("--claim-id", type=int, default=0)
     s.set_defaults(fn=cmd_batch)
 
     args = ap.parse_args(argv)

@@ -107,19 +107,22 @@ def _dpk_unflatten(meta, children) -> "DeviceProvingKey":
 jax.tree_util.register_pytree_node(DeviceProvingKey, _dpk_flatten, _dpk_unflatten)
 
 
-def _rows_to_arrays(rows, matrix: int, m: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    coeffs: List[np.ndarray] = []
+def _rows_to_arrays(rows: Sequence[dict], m: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sparse QAP rows -> (coeff mont limbs, wire ids, row ids).  The
+    coefficient conversion is the vectorized bytes->limbs path — at
+    venmo-scale nnz counts a per-element limb loop costs minutes."""
+    vals: List[int] = []
     wires: List[int] = []
     row_ids: List[int] = []
-    for j, triple in enumerate(rows):
-        for wire, coeff in triple[matrix].items():
-            coeffs.append(FR.to_mont_host(coeff % R))
+    for j, terms in enumerate(rows):
+        for wire, coeff in terms.items():
+            vals.append(coeff % R)
             wires.append(wire)
             row_ids.append(j)
-    if not coeffs:  # degenerate all-zero matrix
-        coeffs, wires, row_ids = [FR.to_mont_host(0)], [0], [m - 1]
+    if not vals:  # degenerate all-zero matrix
+        vals, wires, row_ids = [0], [0], [m - 1]
     return (
-        jnp.asarray(np.stack(coeffs)),
+        jnp.asarray(FR.array_to_mont_host_fast(vals)),
         jnp.asarray(np.array(wires, dtype=np.int32)),
         jnp.asarray(np.array(row_ids, dtype=np.int32)),
     )
@@ -130,14 +133,34 @@ def device_pk(pk: ProvingKey, cs: ConstraintSystem) -> DeviceProvingKey:
     over every proof (the TPU analog of the browser's IndexedDB zkey cache,
     `app/src/helpers/zkp.ts:56-61`)."""
     rows = qap_rows(cs)
-    m = domain_size_for(cs)
+    return device_pk_from_rows(
+        pk, [t[0] for t in rows], [t[1] for t in rows], domain_size_for(cs), cs.num_wires
+    )
+
+
+def device_pk_from_zkey(zk) -> DeviceProvingKey:
+    """snarkjs zkey (formats.zkey.ZkeyData) -> device arrays: the
+    ceremony-key import path (`app/src/helpers/zkp.ts:13` chunk flow).
+    The zkey coeff section already contains the public binding rows, so
+    the QAP rows come from the file, not from a ConstraintSystem."""
+    a_rows, b_rows = zk.qap_row_arrays()
+    return device_pk_from_rows(zk.to_proving_key(), a_rows, b_rows, zk.domain_size, zk.n_vars)
+
+
+def device_pk_from_rows(
+    pk: ProvingKey,
+    a_rows: Sequence[dict],
+    b_rows: Sequence[dict],
+    m: int,
+    n_wires: int,
+) -> DeviceProvingKey:
     log_m = m.bit_length() - 1
-    a = _rows_to_arrays(rows, 0, m)
-    b = _rows_to_arrays(rows, 1, m)
+    a = _rows_to_arrays(a_rows, m)
+    b = _rows_to_arrays(b_rows, m)
     h_pts = list(pk.h_query) + [None] * (m - len(pk.h_query))
     return DeviceProvingKey(
         n_public=pk.n_public,
-        n_wires=cs.num_wires,
+        n_wires=n_wires,
         log_m=log_m,
         a_coeff=a[0], a_wire=a[1], a_row=a[2],
         b_coeff=b[0], b_wire=b[1], b_row=b[2],
@@ -254,6 +277,82 @@ def prove_tpu(
     acc = _prove_device(dpk, witness_to_device(witness))
     a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (acc[0], acc[1], acc[3], acc[4]))
     b2 = g2_jac_to_host(acc[2])[0]
+    return _assemble(dpk, (a, b1, b2, c, hq), r, s)
+
+
+def h_evals_sharded(dpk: DeviceProvingKey, w_mont: jnp.ndarray, mesh, axis: str = "shard") -> jnp.ndarray:
+    """`h_evals` with the six domain transforms sharded over `mesh`:
+    the production multi-chip path (SURVEY.md §2.7 NTT parallelism).
+
+    The sparse matvec stays replicated (it is ~1% of prove FLOPs and its
+    segment-sum does not shard cleanly); each (m, 16) vector is then laid
+    out shard-major and run through the four-step `ntt_sharded` with its
+    three ICI all-to-alls.  Requires both Bailey factors of the domain to
+    be divisible by the mesh width: m >= (mesh size)^2."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.ntt import ntt_sharded
+
+    m = 1 << dpk.log_m
+    g = coset_gen(dpk.log_m)
+    a_ev = _matvec(dpk.a_coeff, dpk.a_wire, dpk.a_row, w_mont, m)
+    b_ev = _matvec(dpk.b_coeff, dpk.b_wire, dpk.b_row, w_mont, m)
+    c_ev = FR.mul(a_ev, b_ev)
+    shard = NamedSharding(mesh, P(axis, None))
+
+    def ladder(v):
+        v = jax.device_put(v, shard)
+        v = ntt_sharded(v, dpk.log_m, mesh, axis=axis, inverse=True)
+        v = coset_shift(v, g, dpk.log_m)
+        return ntt_sharded(v, dpk.log_m, mesh, axis=axis)
+
+    a_cos, b_cos, c_cos = ladder(a_ev), ladder(b_ev), ladder(c_ev)
+    return FR.sub(FR.mul(a_cos, b_cos), c_cos)
+
+
+def prove_tpu_sharded(
+    dpk: DeviceProvingKey,
+    witness: Sequence[int],
+    mesh,
+    r: Optional[int] = None,
+    s: Optional[int] = None,
+    axis: str = "shard",
+    lanes: int = 64,
+) -> Proof:
+    """`prove_tpu` with the MSM base axis AND the NTT domain sharded over
+    `mesh` — the same dataflow a v5e slice runs, exercised by the driver's
+    `dryrun_multichip` on virtual CPU devices.  Emits the exact proof
+    `prove_host`/`prove_tpu` produce for the same (witness, r, s)."""
+    from ..parallel.mesh import msm_sharded, pad_to_multiple
+
+    if r is None:
+        r = 1 + secrets.randbelow(R - 1)
+    if s is None:
+        s = 1 + secrets.randbelow(R - 1)
+    n_dev = mesh.shape[axis]
+    w_mont = witness_to_device(witness)
+    h = h_evals_sharded(dpk, w_mont, mesh, axis)
+    w_planes = digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW)
+    h_planes = digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW)
+
+    # Pad every G1 MSM to ONE common base count: identical operand shapes
+    # -> the a/b1/c/h MSMs share a single compiled executable (padding is
+    # (0,0)-infinity bases + zero digits, masked no-ops at runtime; XLA
+    # compile time is the scarcer resource).
+    n_pad = max(dpk.n_wires, 1 << dpk.log_m)
+    n_pad += (-n_pad) % (n_dev * lanes)
+
+    def msm(curve, bases, planes):
+        b, p = pad_to_multiple(bases, planes, n_pad)
+        return msm_sharded(curve, b, p, mesh, axis=axis, lanes=lanes, window=MSM_WINDOW)
+
+    a_acc = msm(G1J, dpk.a_bases, w_planes)
+    b1_acc = msm(G1J, dpk.b1_bases, w_planes)
+    b2_acc = msm(G2J, dpk.b2_bases, w_planes)
+    c_acc = msm(G1J, dpk.c_bases, w_planes)
+    h_acc = msm(G1J, dpk.h_bases, h_planes)
+    a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (a_acc, b1_acc, c_acc, h_acc))
+    b2 = g2_jac_to_host(b2_acc)[0]
     return _assemble(dpk, (a, b1, b2, c, hq), r, s)
 
 

@@ -1,0 +1,129 @@
+"""Array-path trusted setup: ConstraintSystem -> DeviceProvingKey directly.
+
+`snark.groth16.setup` materialises every query point as a Python tuple —
+fine at gadget-test scale, hopeless at the flagship circuit's 6.4M wires
+(the reference pays 782 s on a 48-core EC2 box for the same step,
+`zkp-mooc-hackathon-submission.md:98`).  This path keeps everything in
+numpy limb arrays end to end:
+
+  tau-evaluation loops   : Python ints over sparse rows (linear, cheap)
+  fixed-base G1/G2 muls  : csrc batch kernels, Montgomery-limb output,
+                           batch-inverted normalization (native.lib)
+  QAP coeff arrays       : vectorized bytes->u16 limb decode
+
+The emitted DeviceProvingKey is bit-identical to
+`device_pk(setup(cs, seed))` for the same seed — pinned by
+tests/test_setup_device.py — and the matching VerifyingKey is a host
+object usable by `snark.groth16.verify` and the Solidity export.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..curve.host import G1_GENERATOR, G2_GENERATOR, g1_gen_mul, g2_gen_mul
+from ..field.bn254 import R, fr_domain_root, fr_inv
+from ..field.jfield import FR, FQ
+from ..native.lib import g1_fixed_base_batch_mont_limbs, g2_fixed_base_batch_mont_limbs
+from ..snark.groth16 import VerifyingKey, _batch_inv, _seeded_scalars, coset_gen, domain_size_for, qap_rows
+from ..snark.r1cs import ConstraintSystem
+from .groth16_tpu import DeviceProvingKey, _rows_to_arrays
+
+
+def setup_device(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[DeviceProvingKey, VerifyingKey]:
+    """Development setup straight to device arrays (same key material as
+    `setup(cs, seed)`).  Requires the native library (use `setup` +
+    `device_pk` for small circuits without a toolchain)."""
+    tau, alpha, beta, gamma, delta = _seeded_scalars(seed, 5)
+    rows = qap_rows(cs)
+    m = domain_size_for(cs)
+    n_wires = cs.num_wires
+
+    w = fr_domain_root(m.bit_length() - 1)
+    z_tau = (pow(tau, m, R) - 1) % R
+    minv = fr_inv(m)
+    wjs: List[int] = []
+    wj = 1
+    for _ in range(m):
+        wjs.append(wj)
+        wj = wj * w % R
+    denom_inv = _batch_inv([(tau - wj) % R for wj in wjs])
+    lag = [z_tau * wj % R * minv % R * di % R for wj, di in zip(wjs, denom_inv)]
+
+    a_tau = [0] * n_wires
+    b_tau = [0] * n_wires
+    c_tau = [0] * n_wires
+    for j, (ra, rb, rc) in enumerate(rows):
+        lj = lag[j]
+        for wi, coeff in ra.items():
+            a_tau[wi] = (a_tau[wi] + coeff * lj) % R
+        for wi, coeff in rb.items():
+            b_tau[wi] = (b_tau[wi] + coeff * lj) % R
+        for wi, coeff in rc.items():
+            c_tau[wi] = (c_tau[wi] + coeff * lj) % R
+
+    delta_inv = fr_inv(delta)
+    gamma_inv = fr_inv(gamma)
+    vals = [(beta * a_tau[i] + alpha * b_tau[i] + c_tau[i]) % R for i in range(n_wires)]
+    scaled = [
+        v * (gamma_inv if i <= cs.num_public else delta_inv) % R for i, v in enumerate(vals)
+    ]
+
+    g = coset_gen(m.bit_length() - 1)
+    tau_p = tau * fr_inv(g) % R
+    z_tau_p = (pow(tau_p, m, R) - 1) % R
+    z_coset = (pow(g, m, R) - 1) % R
+    scale = z_tau_p * minv % R * z_tau % R * fr_inv(delta * z_coset % R) % R
+    hden_inv = _batch_inv([(tau_p - wj) % R for wj in wjs])
+    h_scalars = [scale * wj % R * di % R for wj, di in zip(wjs, hden_inv)]
+
+    a_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, a_tau)
+    b1_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, b_tau)
+    b2_bases = g2_fixed_base_batch_mont_limbs(G2_GENERATOR, b_tau)
+    cq_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, scaled)
+    h_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, h_scalars)
+    if a_bases is None or b2_bases is None:
+        raise RuntimeError("native library unavailable; use snark.groth16.setup for small circuits")
+
+    # IC points (host form, few) for the verifier; zero out public rows in
+    # the device c_query (the prover never MSMs them).
+    from ..curve.host import g1_gen_mul_batch
+
+    ic = g1_gen_mul_batch(scaled[: cs.num_public + 1])
+    cx, cy = cq_bases
+    cx = cx.copy()
+    cy = cy.copy()
+    cx[: cs.num_public + 1] = 0
+    cy[: cs.num_public + 1] = 0
+
+    a_arr = _rows_to_arrays([t[0] for t in rows], m)
+    b_arr = _rows_to_arrays([t[1] for t in rows], m)
+    dpk = DeviceProvingKey(
+        n_public=cs.num_public,
+        n_wires=n_wires,
+        log_m=m.bit_length() - 1,
+        a_coeff=a_arr[0], a_wire=a_arr[1], a_row=a_arr[2],
+        b_coeff=b_arr[0], b_wire=b_arr[1], b_row=b_arr[2],
+        a_bases=tuple(jnp.asarray(x) for x in a_bases),
+        b1_bases=tuple(jnp.asarray(x) for x in b1_bases),
+        b2_bases=tuple(jnp.asarray(x) for x in b2_bases),
+        c_bases=(jnp.asarray(cx), jnp.asarray(cy)),
+        h_bases=tuple(jnp.asarray(x) for x in h_bases),
+        alpha_1=g1_gen_mul(alpha),
+        beta_1=g1_gen_mul(beta),
+        beta_2=g2_gen_mul(beta),
+        delta_1=g1_gen_mul(delta),
+        delta_2=g2_gen_mul(delta),
+    )
+    vk = VerifyingKey(
+        n_public=cs.num_public,
+        alpha_1=dpk.alpha_1,
+        beta_2=dpk.beta_2,
+        gamma_2=g2_gen_mul(gamma),
+        delta_2=dpk.delta_2,
+        ic=ic,
+    )
+    return dpk, vk
