@@ -66,6 +66,16 @@ def test_email_from_eml_extracts_venmo_fields():
 
     sig_b64 = b64encode(email.signature.to_bytes(256, "big")).decode()
     eml = email.header[:-2] + sig_b64.encode() + b"\r\n\r\n" + email.body
-    parsed = email_from_eml(eml)
+    # The synthetic email reuses the real venmo.com selector but signs the
+    # raw header block (no h= tag), which never equals the RFC 6376
+    # reconstruction — so the signature cannot validate through this path
+    # (see test_extract_and_verify_synthetic above).  Pass an EMPTY
+    # registry: email_from_eml now defaults to the known-keys registry,
+    # which would resolve the real venmo modulus and correctly reject the
+    # test-key signature; unknown keys now hard-fail unless explicitly
+    # allowed.  Field extraction is what this test pins.
+    from zkp2p_tpu.inputs.dkim import KeyRegistry
+
+    parsed = email_from_eml(eml, keys=KeyRegistry(), allow_unverified=True)
     assert parsed.raw_id == "1234567891234567891"
     assert parsed.amount == "42"

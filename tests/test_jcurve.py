@@ -35,6 +35,10 @@ from zkp2p_tpu.curve.jcurve import (
 )
 from zkp2p_tpu.field.bn254 import R
 
+# XLA-compile-heavy: opt-in via ZKP2P_RUN_SLOW=1 (default suite must stay
+# minutes on a 1-core host; the dryrun/bench paths exercise this code too)
+pytestmark = pytest.mark.slow
+
 rng = random.Random(99)
 
 

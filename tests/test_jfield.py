@@ -15,6 +15,7 @@ import pytest
 from zkp2p_tpu.field.bn254 import P, R
 from zkp2p_tpu.field import jfield
 from zkp2p_tpu.field.jfield import (
+
     FQ,
     FQ2,
     FR,
@@ -24,6 +25,10 @@ from zkp2p_tpu.field.jfield import (
     limbs_to_int,
     reduce_wide,
 )
+# XLA-compile-heavy: opt-in via ZKP2P_RUN_SLOW=1 (default suite must stay
+# minutes on a 1-core host; the dryrun/bench paths exercise this code too)
+pytestmark = pytest.mark.slow
+
 
 rng = random.Random(1234)
 

@@ -1,0 +1,44 @@
+"""Data-only .npz device-key cache round trip (prover.keycache)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.native import lib as native
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None, reason="native toolchain unavailable")
+
+
+def test_keycache_roundtrip(tmp_path):
+    from zkp2p_tpu.prover.groth16_tpu import _DPK_ARRAY_FIELDS
+    from zkp2p_tpu.prover.keycache import load_dpk, save_dpk
+    from zkp2p_tpu.prover.setup_device import setup_device
+
+    cs = ConstraintSystem("kc")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+    cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
+    cs.compute(z, lambda a, b: a * b % R, [x, y])
+    dpk, vk = setup_device(cs, seed="kc")
+
+    path = os.path.join(tmp_path, "key.npz")
+    save_dpk(path, dpk, vk)
+    dpk2, vk2 = load_dpk(path)
+
+    assert (dpk2.n_public, dpk2.n_wires, dpk2.log_m) == (dpk.n_public, dpk.n_wires, dpk.log_m)
+    for f in _DPK_ARRAY_FIELDS:
+        a, b = getattr(dpk, f), getattr(dpk2, f)
+        if isinstance(a, tuple):
+            for i, (x_, y_) in enumerate(zip(a, b)):
+                np.testing.assert_array_equal(np.asarray(x_), np.asarray(y_), err_msg=f"{f}[{i}]")
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=f)
+    assert (dpk2.alpha_1, dpk2.beta_1, dpk2.beta_2) == (dpk.alpha_1, dpk.beta_1, dpk.beta_2)
+    assert (dpk2.delta_1, dpk2.delta_2) == (dpk.delta_1, dpk.delta_2)
+    assert vk2.ic == vk.ic and vk2.gamma_2 == vk.gamma_2

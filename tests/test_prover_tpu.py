@@ -6,10 +6,16 @@ known-good proof vector in test/ramp.test.js:193-196)."""
 
 import random
 
+import pytest
+
 from zkp2p_tpu.field.bn254 import R
 from zkp2p_tpu.prover import device_pk, prove_tpu, prove_tpu_batch
 from zkp2p_tpu.snark.groth16 import prove_host, setup, verify
 from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+# XLA-compile-heavy: opt-in via ZKP2P_RUN_SLOW=1 (default suite must stay
+# minutes on a 1-core host; the dryrun/bench paths exercise this code too)
+pytestmark = pytest.mark.slow
 
 rng = random.Random(42)
 

@@ -12,6 +12,10 @@ from zkp2p_tpu.prover.groth16_tpu import device_pk
 from zkp2p_tpu.snark.groth16 import setup
 from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
 
+# prove_tpu_batch compiles per batch size: XLA-compile-heavy, opt-in
+# (ZKP2P_RUN_SLOW=1); the CLI drive and bench exercise this path too.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def world():

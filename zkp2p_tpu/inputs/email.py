@@ -192,9 +192,13 @@ def generate_email_verify_inputs(email: SyntheticEmail, modulus: int, params, la
 # ------------------------------------------------------------ real emails
 
 
-def _verified_eml(raw_eml: bytes, keys):
+def _verified_eml(raw_eml: bytes, keys, allow_unverified: bool = False):
     """Shared .eml preamble: registry default, canonicalize, check body
-    hash + (when the key is known) the RSA signature."""
+    hash + the RSA signature.  An unknown signing key is an ERROR by
+    default — silently returning unverified email objects from the
+    documented parse entry points would let a forged email flow into
+    input generation with only a None modulus as the tell.  Pass
+    allow_unverified=True for body-hash-only parsing (tests, tooling)."""
     from .dkim import extract_and_verify
 
     if keys is None:
@@ -206,17 +210,22 @@ def _verified_eml(raw_eml: bytes, keys):
         raise ValueError("DKIM body hash mismatch")
     if v.signature_ok is False:
         raise ValueError("DKIM signature invalid")
+    if v.signature_ok is None and not allow_unverified:
+        raise ValueError(
+            f"unknown DKIM key {v.sig.domain}/{v.sig.selector}; add it to "
+            "inputs.known_keys or pass allow_unverified=True"
+        )
     return v
 
 
-def email_from_eml(raw_eml: bytes, keys=None) -> SyntheticEmail:
+def email_from_eml(raw_eml: bytes, keys=None, allow_unverified: bool = False) -> SyntheticEmail:
     """Real .eml -> the circuit-facing email object: DKIM-canonicalized
     signed header data + canonical body + signature, with the Venmo id and
     amount located in the content (generate_input.ts:191-231 semantics).
     DKIM keys resolve from known_keys.default_registry when none given."""
     import re as _re
 
-    v = _verified_eml(raw_eml, keys)
+    v = _verified_eml(raw_eml, keys, allow_unverified)
     m = _re.search(rb"user_id=3D([0-9=\r\n]+)", v.body_canon)
     raw_id = m.group(1).replace(b"=\r\n", b"").decode() if m else ""
     # the subject may not be in the signed set (h=); fall back to the raw
@@ -233,7 +242,7 @@ def email_from_eml(raw_eml: bytes, keys=None) -> SyntheticEmail:
     )
 
 
-def email_verify_from_eml(raw_eml: bytes, keys=None):
+def email_verify_from_eml(raw_eml: bytes, keys=None, allow_unverified: bool = False):
     """Real .eml -> (email object, modulus) for the EmailVerify family:
     DKIM verify against the key registry (known_keys.default_registry
     when none given), extract the @handle the TwitterResetRegex reveals
@@ -241,7 +250,7 @@ def email_verify_from_eml(raw_eml: bytes, keys=None):
     fixture `app/src/__fixtures__/email/zktestemail.test-eml`."""
     import re as _re
 
-    v = _verified_eml(raw_eml, keys)
+    v = _verified_eml(raw_eml, keys, allow_unverified)
     m = _re.search(rb"meant for @([A-Za-z0-9_]+)", v.body_canon)
     handle = m.group(1).decode() if m else ""
     email = SyntheticEmail(

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 import time
 import traceback
 from dataclasses import dataclass
@@ -77,21 +79,43 @@ class ProvingService:
             with open(os.path.join(spool, fn)) as f:
                 pending.append(Request(path=os.path.join(spool, base), payload=json.load(f)))
 
-        # input validation stage
-        ready: List[Request] = []
-        for req in pending:
-            try:
-                with trace("service/witness"):
-                    req.witness = self.witness_fn(req.payload)
-                    self.cs.check_witness(req.witness)
-                ready.append(req)
-            except Exception as e:  # noqa: BLE001 — recorded, not silenced
-                req.error = f"error-bad-input: {e}"
-                self._emit_error(req, "error-bad-input", e)
-                stats["error-bad-input"] += 1
+        # Pipeline overlap (SURVEY.md §2.7 "witness ∥ prove"): witness
+        # generation is host CPU, proving is device compute — a producer
+        # thread builds batch i+1's witnesses while the device proves
+        # batch i (the queue holds at most one ready batch, so the spool
+        # never races ahead of the device).  Mirrors the reference's
+        # two-stage shell pipeline (2_gen_wtns.sh -> 5_gen_proof.sh),
+        # overlapped instead of sequential.
+        ready_q: "queue.Queue[Optional[List[Request]]]" = queue.Queue(maxsize=1)
 
-        for i in range(0, len(ready), self.batch_size):
-            batch = ready[i : i + self.batch_size]
+        def produce():
+            try:
+                for i in range(0, len(pending), self.batch_size):
+                    batch: List[Request] = []
+                    for req in pending[i : i + self.batch_size]:
+                        try:
+                            with trace("service/witness"):
+                                req.witness = self.witness_fn(req.payload)
+                                self.cs.check_witness(req.witness)
+                            batch.append(req)
+                        except Exception as e:  # noqa: BLE001 — recorded, not silenced
+                            req.error = f"error-bad-input: {e}"
+                            self._emit_error(req, "error-bad-input", e)
+                            stats["error-bad-input"] += 1
+                    if batch:
+                        ready_q.put(batch)
+            finally:
+                # The sentinel MUST go out even if this thread dies (e.g.
+                # _emit_error hitting a full disk) — otherwise the
+                # consumer blocks on ready_q.get() forever.
+                ready_q.put(None)
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        while True:
+            batch = ready_q.get()
+            if batch is None:
+                break
             try:
                 with trace("service/prove", n=len(batch)):
                     proofs = prove_tpu_batch(self.dpk, [r.witness for r in batch])
@@ -107,6 +131,7 @@ class ProvingService:
                 for req in batch:
                     self._emit_error(req, "error-failed-to-prove", e)
                     stats["error-failed-to-prove"] += 1
+        producer.join()
         return stats
 
     @staticmethod
@@ -119,6 +144,38 @@ class ProvingService:
             )
 
     # ------------------------------------------------------------- daemon
+
+    @classmethod
+    def for_venmo(cls, cs, lay, params, dpk, vk, keys=None, **kw) -> "ProvingService":
+        """Service wired for the flagship circuit: request payloads are
+        either {"eml_path": ...} (real DKIM email, keys resolved from the
+        known-keys registry) or the synthetic-demo shape {"raw_id",
+        "amount", "order_id", "claim_id"} (hermetic tests)."""
+        from ..inputs.email import email_from_eml, generate_inputs, make_test_key, make_venmo_email
+
+        demo_key = make_test_key(1)
+
+        def witness_fn(payload: Dict) -> list:
+            order_id = int(payload.get("order_id", 1))
+            claim_id = int(payload.get("claim_id", 0))
+            if "eml_path" in payload:
+                with open(payload["eml_path"], "rb") as f:
+                    email = email_from_eml(f.read(), keys)
+                if email.modulus is None:
+                    raise ValueError("unknown DKIM key")
+                modulus = email.modulus
+            else:
+                email = make_venmo_email(
+                    demo_key, raw_id=str(payload["raw_id"]), amount=str(payload["amount"])
+                )
+                modulus = demo_key.n
+            inputs = generate_inputs(email, modulus, order_id, claim_id, params, lay)
+            return cs.witness(inputs.public_signals, inputs.seed)
+
+        def public_fn(witness: list) -> list:
+            return list(witness[1 : cs.num_public + 1])
+
+        return cls(cs, dpk, vk, witness_fn, public_fn, **kw)
 
     def run(self, spool: str, poll_s: float = 1.0, max_sweeps: Optional[int] = None) -> None:
         sweeps = 0

@@ -1,0 +1,106 @@
+"""Data-only persistence for device proving keys (.npz).
+
+The interop format stays snarkjs `.zkey` (formats.zkey); this cache is
+the fast *internal* form — the DeviceProvingKey's numpy limb arrays
+written as-is, so bench/service restarts skip both setup AND the
+points->ints->limbs conversions.  Pure array data (numpy .npz), never
+pickle (round-1 advisor finding)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..curve.host import G1Point, G2Point
+from ..field.tower import Fq2
+from ..snark.groth16 import VerifyingKey
+from .groth16_tpu import _DPK_ARRAY_FIELDS, DeviceProvingKey
+
+
+def _g1_arr(pt: G1Point) -> np.ndarray:
+    if pt is None:
+        return np.zeros((2, 32), dtype=np.uint8)
+    return np.stack([
+        np.frombuffer(pt[0].to_bytes(32, "little"), dtype=np.uint8),
+        np.frombuffer(pt[1].to_bytes(32, "little"), dtype=np.uint8),
+    ])
+
+
+def _g1_from(arr: np.ndarray) -> G1Point:
+    x = int.from_bytes(arr[0].tobytes(), "little")
+    y = int.from_bytes(arr[1].tobytes(), "little")
+    return None if x == 0 and y == 0 else (x, y)
+
+
+def _g2_arr(pt: G2Point) -> np.ndarray:
+    if pt is None:
+        return np.zeros((4, 32), dtype=np.uint8)
+    x, y = pt
+    return np.stack([
+        np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
+        for v in (x.c0, x.c1, y.c0, y.c1)
+    ])
+
+
+def _g2_from(arr: np.ndarray) -> G2Point:
+    vals = [int.from_bytes(arr[i].tobytes(), "little") for i in range(4)]
+    if not any(vals):
+        return None
+    return (Fq2(vals[0], vals[1]), Fq2(vals[2], vals[3]))
+
+
+def save_dpk(path: str, dpk: DeviceProvingKey, vk: VerifyingKey) -> None:
+    data = {}
+    for f in _DPK_ARRAY_FIELDS:
+        v = getattr(dpk, f)
+        if isinstance(v, tuple):
+            for i, c in enumerate(v):
+                data[f"{f}.{i}"] = np.asarray(c)
+        else:
+            data[f] = np.asarray(v)
+    data["meta"] = np.array([dpk.n_public, dpk.n_wires, dpk.log_m], dtype=np.int64)
+    for name in ("alpha_1", "beta_1", "delta_1"):
+        data[name] = _g1_arr(getattr(dpk, name))
+    for name in ("beta_2", "delta_2"):
+        data[name] = _g2_arr(getattr(dpk, name))
+    data["vk_gamma_2"] = _g2_arr(vk.gamma_2)
+    data["vk_ic"] = np.stack([_g1_arr(p) for p in vk.ic])
+    np.savez_compressed(path, **data)
+
+
+def load_dpk(path: str) -> Tuple[DeviceProvingKey, VerifyingKey]:
+    z = np.load(path)
+    arrays = {}
+    for f in _DPK_ARRAY_FIELDS:
+        if f in z:
+            arrays[f] = jnp.asarray(z[f])
+        else:
+            parts = []
+            i = 0
+            while f"{f}.{i}" in z:
+                parts.append(jnp.asarray(z[f"{f}.{i}"]))
+                i += 1
+            arrays[f] = tuple(parts)
+    n_public, n_wires, log_m = (int(v) for v in z["meta"])
+    dpk = DeviceProvingKey(
+        n_public=n_public,
+        n_wires=n_wires,
+        log_m=log_m,
+        alpha_1=_g1_from(z["alpha_1"]),
+        beta_1=_g1_from(z["beta_1"]),
+        beta_2=_g2_from(z["beta_2"]),
+        delta_1=_g1_from(z["delta_1"]),
+        delta_2=_g2_from(z["delta_2"]),
+        **arrays,
+    )
+    vk = VerifyingKey(
+        n_public=n_public,
+        alpha_1=dpk.alpha_1,
+        beta_2=dpk.beta_2,
+        gamma_2=_g2_from(z["vk_gamma_2"]),
+        delta_2=dpk.delta_2,
+        ic=[_g1_from(z["vk_ic"][i]) for i in range(z["vk_ic"].shape[0])],
+    )
+    return dpk, vk

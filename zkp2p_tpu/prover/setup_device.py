@@ -80,24 +80,28 @@ def setup_device(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[Dev
     hden_inv = _batch_inv([(tau_p - wj) % R for wj in wjs])
     h_scalars = [scale * wj % R * di % R for wj, di in zip(wjs, hden_inv)]
 
+    # Prune the b/c queries to their non-infinity lanes (device_pk does
+    # the same from the point lists): b_tau is zero for every wire absent
+    # from B (half the circuit, measured), so both the setup-time
+    # fixed-base muls AND the prove-time b1/b2/c MSMs halve.
+    from .groth16_tpu import _prune_sel
+
+    b_sel = _prune_sel([v % R != 0 for v in b_tau])
+    c_sel = _prune_sel(
+        [i > cs.num_public and scaled[i] % R != 0 for i in range(n_wires)]
+    )
     a_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, a_tau)
-    b1_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, b_tau)
-    b2_bases = g2_fixed_base_batch_mont_limbs(G2_GENERATOR, b_tau)
-    cq_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, scaled)
+    b1_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, [b_tau[i] for i in b_sel])
+    b2_bases = g2_fixed_base_batch_mont_limbs(G2_GENERATOR, [b_tau[i] for i in b_sel])
+    cq_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, [scaled[i] for i in c_sel])
     h_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, h_scalars)
     if a_bases is None or b2_bases is None:
         raise RuntimeError("native library unavailable; use snark.groth16.setup for small circuits")
 
-    # IC points (host form, few) for the verifier; zero out public rows in
-    # the device c_query (the prover never MSMs them).
+    # IC points (host form, few) for the verifier.
     from ..curve.host import g1_gen_mul_batch
 
     ic = g1_gen_mul_batch(scaled[: cs.num_public + 1])
-    cx, cy = cq_bases
-    cx = cx.copy()
-    cy = cy.copy()
-    cx[: cs.num_public + 1] = 0
-    cy[: cs.num_public + 1] = 0
 
     a_arr = _rows_to_arrays([t[0] for t in rows], m)
     b_arr = _rows_to_arrays([t[1] for t in rows], m)
@@ -110,8 +114,10 @@ def setup_device(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[Dev
         a_bases=tuple(jnp.asarray(x) for x in a_bases),
         b1_bases=tuple(jnp.asarray(x) for x in b1_bases),
         b2_bases=tuple(jnp.asarray(x) for x in b2_bases),
-        c_bases=(jnp.asarray(cx), jnp.asarray(cy)),
+        c_bases=tuple(jnp.asarray(x) for x in cq_bases),
         h_bases=tuple(jnp.asarray(x) for x in h_bases),
+        b_sel=jnp.asarray(b_sel),
+        c_sel=jnp.asarray(c_sel),
         alpha_1=g1_gen_mul(alpha),
         beta_1=g1_gen_mul(beta),
         beta_2=g2_gen_mul(beta),

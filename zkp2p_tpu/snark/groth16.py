@@ -143,7 +143,7 @@ def domain_size_for(cs: ConstraintSystem) -> int:
 def setup(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[ProvingKey, VerifyingKey]:
     """Deterministic development setup (tau, alpha, beta, gamma, delta from
     seed).  For production, phase-2 ceremony import comes via
-    zkp2p_tpu.formats.zkey_file instead."""
+    zkp2p_tpu.formats.zkey (read_zkey -> device_pk_from_zkey) instead."""
     tau, alpha, beta, gamma, delta = _seeded_scalars(seed, 5)
     rows = qap_rows(cs)
     m = domain_size_for(cs)

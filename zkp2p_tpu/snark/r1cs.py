@@ -9,8 +9,11 @@ our circuits are built programmatically: gadgets (zkp2p_tpu.gadgets) allocate
 wires, emit rank-1 constraints  <A,w> * <B,w> = <C,w>, and register witness
 computation hooks.  Witness generation therefore lives *with* the circuit
 definition (as circom's generated WASM/C++ witness calculators do for the
-reference, dizkus-scripts/2_gen_wtns.sh), but the hot per-byte blocks also
-get vectorised JAX witness programs (zkp2p_tpu.gadgets.*.jax_witness).
+reference, dizkus-scripts/2_gen_wtns.sh).  Measured at the full-size
+flagship circuit (4.9M wires) the hook program runs in ~14 s on one core
+— vs the reference's 60 s compiled witness generator on 48 cores
+(docs/SCALE.md) — because hook values are small ints and the loop is
+allocation-free.
 
 Wire layout follows the Groth16/snarkjs convention: wire 0 is the constant
 ``1``, wires 1..n_pub are public, the rest private.
