@@ -1,0 +1,103 @@
+"""The client web surface driven over real HTTP: post order -> claim ->
+decrypt-and-verify claims (the MainPage / NewOrderForm / ClaimOrderForm /
+SubmitOrderClaimsForm arc, SURVEY §2.5)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from zkp2p_tpu.client.web import OnrampApp, serve
+from zkp2p_tpu.contracts.ramp import FakeUSDC, Ramp
+
+
+@pytest.fixture()
+def server():
+    from zkp2p_tpu.contracts.deploy import VENMO_RSA_KEY_LIMBS
+
+    usdc = FakeUSDC()
+
+    class _NoVerify:
+        """Ramp vk stand-in: /api/onramp is prover-gated and not exercised
+        here (the pairing path is covered by test_contracts)."""
+
+        n_public = 26
+
+    ramp = Ramp(VENMO_RSA_KEY_LIMBS, usdc, max_amount=100_000_000, vk=_NoVerify())
+    app = OnrampApp(ramp, usdc)
+    srv = serve(app, port=0)
+    port = srv.server_address[1]
+    yield f"http://127.0.0.1:{port}", app
+    srv.shutdown()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(), headers={"content-type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        raise AssertionError(f"{path} -> {e.code}: {e.read().decode()}") from e
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return json.loads(r.read())
+
+
+def test_order_claim_decrypt_flow(server):
+    base, app = server
+
+    # page renders
+    with urllib.request.urlopen(base + "/") as r:
+        assert b"ZKP2P" in r.read()
+
+    # on-ramper posts an order
+    out = _post(base, "/api/orders", {"address": "alice", "amount": 30_000_000, "max_amount_to_pay": 31_000_000})
+    oid = out["order_id"]
+    orders = _get(base, "/api/orders")
+    assert orders[-1]["id"] == oid and orders[-1]["status"] == "Open"
+
+    # off-ramper claims it (ECIES-encrypted venmo id + Poseidon hash)
+    out = _post(
+        base,
+        "/api/claims",
+        {"address": "bob", "venmo_id": "1234567891234567891", "order_id": oid, "min_amount_to_pay": 30_500_000},
+    )
+    cid = out["claim_id"]
+
+    # on-ramper decrypts and verifies the claim hash (Matches column)
+    views = _get(base, f"/api/claims-decrypted?address=alice&order_id={oid}")
+    assert views == [
+        {"claim_id": cid, "venmo_id": "1234567891234567891", "matches": True, "min_amount_to_pay": 30_500_000}
+    ]
+
+    # prover-gated endpoint reports unavailable without a bundle
+    req = urllib.request.Request(
+        base + "/api/onramp",
+        data=json.dumps({"address": "alice", "order_id": oid, "claim_id": cid}).encode(),
+        headers={"content-type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("expected 503")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+
+
+def test_bad_request_is_reported(server):
+    base, _ = server
+    req = urllib.request.Request(
+        base + "/api/claims",
+        data=json.dumps({"address": "bob", "venmo_id": "x", "order_id": 999, "min_amount_to_pay": 1}).encode(),
+        headers={"content-type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "error" in json.loads(e.read())

@@ -1,0 +1,258 @@
+"""The client app surface: order book + flow over HTTP (L4 of SURVEY §1).
+
+A single-file re-imagination of the reference React SPA (`app/src/` —
+`MainPage.tsx:38`, `NewOrderForm.tsx`, `ClaimOrderForm.tsx`,
+`SubmitOrderClaimsForm.tsx`, `SubmitOrderGenerateProofForm.tsx`) for a
+headless deployment: a stdlib HTTP server renders the order table and
+drives the same four flows against the in-process `Ramp` escrow:
+
+  post order      -> POST /api/orders       (NewOrderForm semantics)
+  claim order     -> POST /api/claims       (ClaimOrderForm: ECIES-encrypt
+                     the Venmo id to the on-ramper + Poseidon hash)
+  review claims   -> GET  /api/claims-decrypted (Matches / Does Not Match)
+  prove + onramp  -> POST /api/onramp       (email -> inputs -> TPU prove
+                     -> Ramp.onRamp; requires a loaded prover bundle)
+
+The page polls /api/orders every 15 s, the reference's cadence
+(`MainPage.tsx:177-185`).  No build step, no node — the product surface
+for environments where the browser prover is replaced by the TPU
+service.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..contracts.ramp import FakeUSDC, Ramp
+from .flow import OffRamper, OnRamper
+
+
+@dataclass
+class ProverBundle:
+    """Everything /api/onramp needs to prove a receipt email."""
+
+    cs: object
+    dpk: object
+    params: object
+    layout: object
+
+
+class OnrampApp:
+    """Application state: chain objects + wallet sessions."""
+
+    def __init__(self, ramp: Ramp, usdc: FakeUSDC, prover: Optional[ProverBundle] = None):
+        self.ramp = ramp
+        self.usdc = usdc
+        self.prover = prover
+        self.onrampers: Dict[str, OnRamper] = {}
+        self.offrampers: Dict[str, OffRamper] = {}
+        self.lock = threading.Lock()
+
+    # Wallet sessions: the reference derives the ECIES identity from a
+    # wallet signature (NewOrderForm.tsx:35-64); headless deployments
+    # pass the signature bytes in directly.
+    def onramper(self, address: str, signature: bytes = b"") -> OnRamper:
+        with self.lock:
+            if address not in self.onrampers:
+                self.onrampers[address] = OnRamper(
+                    address, self.ramp, signature or f"sig:{address}".encode()
+                )
+            return self.onrampers[address]
+
+    def offramper(self, address: str, venmo_id: str) -> OffRamper:
+        with self.lock:
+            off = self.offrampers.get(address)
+            if off is None or off.venmo_id != venmo_id:
+                off = OffRamper(address, self.ramp, venmo_id)
+                self.offrampers[address] = off
+            return off
+
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ZKP2P on-ramp (TPU)</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;max-width:60rem}
+ table{border-collapse:collapse;width:100%%}
+ td,th{border:1px solid #ccc;padding:.35rem .6rem;text-align:left}
+ form{margin:.8rem 0;padding:.8rem;border:1px solid #ddd;border-radius:6px}
+ input{margin:.15rem .4rem .15rem 0}
+ h1{font-size:1.3rem} h2{font-size:1.05rem}
+ #msg{color:#06c;white-space:pre-wrap}
+</style></head><body>
+<h1>ZKP2P fiat on-ramp &mdash; TPU prover edition</h1>
+<div id="msg"></div>
+<h2>Orders</h2>
+<table id="orders"><tr><th>id</th><th>on-ramper</th><th>amount</th><th>max pay</th><th>status</th></tr></table>
+<h2>New order (on-ramper)</h2>
+<form onsubmit="return post('/api/orders', this)">
+ <input name="address" placeholder="wallet" required>
+ <input name="amount" placeholder="USDC amount" required>
+ <input name="max_amount_to_pay" placeholder="max to pay" required>
+ <button>Post order</button></form>
+<h2>Claim order (off-ramper)</h2>
+<form onsubmit="return post('/api/claims', this)">
+ <input name="address" placeholder="wallet" required>
+ <input name="venmo_id" placeholder="venmo id" required>
+ <input name="order_id" placeholder="order id" required>
+ <input name="min_amount_to_pay" placeholder="min pay" required>
+ <button>Claim</button></form>
+<h2>Review claims (on-ramper)</h2>
+<form onsubmit="return get2('/api/claims-decrypted', this)">
+ <input name="address" placeholder="wallet" required>
+ <input name="order_id" placeholder="order id" required>
+ <button>Decrypt</button></form>
+<h2>Prove receipt &amp; on-ramp</h2>
+<form onsubmit="return post('/api/onramp', this)">
+ <input name="address" placeholder="wallet" required>
+ <input name="order_id" placeholder="order id" required>
+ <input name="claim_id" placeholder="claim id" required>
+ <input name="eml_path" placeholder=".eml path (server-side)">
+ <button>Prove + on-ramp</button></form>
+<script>
+async function refresh(){
+  const r = await fetch('/api/orders'); const rows = await r.json();
+  const t = document.getElementById('orders');
+  t.innerHTML = '<tr><th>id</th><th>on-ramper</th><th>amount</th><th>max pay</th><th>status</th></tr>' +
+    rows.map(o=>`<tr><td>${o.id}</td><td>${o.on_ramper}</td><td>${o.amount}</td><td>${o.max_amount_to_pay}</td><td>${o.status}</td></tr>`).join('');
+}
+function say(x){document.getElementById('msg').textContent=JSON.stringify(x,null,1)}
+async function post(url, f){
+  const body = Object.fromEntries(new FormData(f));
+  const r = await fetch(url, {method:'POST', headers:{'content-type':'application/json'}, body: JSON.stringify(body)});
+  say(await r.json()); refresh(); return false;
+}
+async function get2(url, f){
+  const q = new URLSearchParams(new FormData(f));
+  const r = await fetch(url + '?' + q); say(await r.json()); return false;
+}
+refresh(); setInterval(refresh, 15000);  // MainPage.tsx 15s polling
+</script></body></html>"""
+
+
+def make_handler(app: OnrampApp):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, obj, code: int = 200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("content-type", "application/json")
+            self.send_header("content-length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read(self) -> Dict:
+            n = int(self.headers.get("content-length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        def do_GET(self):
+            from urllib.parse import parse_qs, urlparse
+
+            u = urlparse(self.path)
+            if u.path == "/":
+                body = _PAGE.encode()
+                self.send_response(200)
+                self.send_header("content-type", "text/html")
+                self.send_header("content-length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif u.path == "/api/orders":
+                rows = [
+                    {
+                        "id": oid,
+                        "on_ramper": o.on_ramper,
+                        "amount": o.amount,
+                        "max_amount_to_pay": o.max_amount_to_pay,
+                        "status": o.status.name,
+                    }
+                    for oid, o in app.ramp.get_all_orders()
+                ]
+                self._json(rows)
+            elif u.path == "/api/claims-decrypted":
+                q = parse_qs(u.query)
+                address = q["address"][0]
+                order_id = int(q["order_id"][0])
+                views = app.onramper(address).decrypt_claims(order_id)
+                self._json(
+                    [
+                        {
+                            "claim_id": v.claim_id,
+                            "venmo_id": v.venmo_id,
+                            "matches": v.hash_matches,
+                            "min_amount_to_pay": v.min_amount_to_pay,
+                        }
+                        for v in views
+                    ]
+                )
+            else:
+                self._json({"error": "not found"}, 404)
+
+        def do_POST(self):
+            try:
+                payload = self._read()
+                if self.path == "/api/orders":
+                    ramper = app.onramper(payload["address"])
+                    oid = ramper.post_order(
+                        int(payload["amount"]), int(payload["max_amount_to_pay"])
+                    )
+                    self._json({"order_id": oid})
+                elif self.path == "/api/claims":
+                    off = app.offramper(payload["address"], payload["venmo_id"])
+                    # escrow needs USDC: demo-mint like the Goerli FakeUSDC
+                    order = app.ramp.orders[int(payload["order_id"])]
+                    app.usdc.mint(payload["address"], order.amount)
+                    app.usdc.approve(payload["address"], app.ramp.address, order.amount)
+                    on_pk = app.onramper(order.on_ramper).account.public_key_bytes
+                    cid = off.claim_order(
+                        int(payload["order_id"]), on_pk, int(payload["min_amount_to_pay"])
+                    )
+                    self._json({"claim_id": cid})
+                elif self.path == "/api/onramp":
+                    if app.prover is None:
+                        self._json({"error": "prover bundle not loaded on this server"}, 503)
+                        return
+                    from ..inputs.email import email_from_eml, make_test_key, make_venmo_email
+
+                    if payload.get("eml_path"):
+                        with open(payload["eml_path"], "rb") as f:
+                            email = email_from_eml(f.read())
+                        modulus = email.modulus
+                    else:  # synthetic demo receipt
+                        key = make_test_key(1)
+                        email = make_venmo_email(
+                            key,
+                            raw_id=str(payload.get("raw_id", "1234567891234567891")),
+                            amount=str(payload.get("amount", "30")),
+                        )
+                        modulus = key.n
+                    ramper = app.onramper(payload["address"])
+                    inputs = ramper.prove_and_onramp(
+                        app.prover.cs,
+                        app.prover.dpk,
+                        email,
+                        modulus,
+                        int(payload["order_id"]),
+                        int(payload["claim_id"]),
+                        app.prover.params,
+                        app.prover.layout,
+                    )
+                    self._json({"ok": True, "public_signals": [str(s) for s in inputs.public_signals]})
+                else:
+                    self._json({"error": "not found"}, 404)
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+    return Handler
+
+
+def serve(app: OnrampApp, port: int = 8080) -> ThreadingHTTPServer:
+    """Start the UI server (returns it; call .shutdown() to stop)."""
+    srv = ThreadingHTTPServer(("127.0.0.1", port), make_handler(app))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

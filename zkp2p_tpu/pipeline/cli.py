@@ -132,9 +132,7 @@ def _witness_for(args, cs, meta, source=None):
         src = source or getattr(args, "eml", None)
         if src:
             with open(src, "rb") as f:
-                email = email_from_eml(f.read())
-            if email.modulus is None:
-                raise SystemExit("unknown DKIM key; add it to inputs.known_keys")
+                email = email_from_eml(f.read())  # unknown keys raise in _verified_eml
             modulus = email.modulus
         else:
             key = make_test_key(1)
@@ -153,9 +151,7 @@ def _witness_for(args, cs, meta, source=None):
         src = source or getattr(args, "eml", None)
         if src:
             with open(src, "rb") as f:
-                email, modulus = email_verify_from_eml(f.read())
-            if modulus is None:
-                raise SystemExit("unknown DKIM key; add it to inputs.known_keys")
+                email, modulus = email_verify_from_eml(f.read())  # unknown keys raise
         else:
             key = make_test_key(1)
             email, modulus = make_twitter_email(key), key.n
@@ -250,6 +246,39 @@ def cmd_batch(args):
     _log(f"wrote {len(proofs)} proofs to {args.outdir}")
 
 
+def cmd_serve(args):
+    """Serve the client order-book UI (client/web.py) with the in-process
+    escrow; --with-prover loads the build dir's zkey so /api/onramp can
+    prove receipts on the TPU."""
+    import time as _time
+
+    from ..client.web import OnrampApp, ProverBundle, serve
+    from ..contracts.deploy import VENMO_RSA_KEY_LIMBS
+    from ..contracts.ramp import FakeUSDC, Ramp
+    from ..formats.proof_json import load, vkey_from_json
+
+    vk = vkey_from_json(load(os.path.join(args.build_dir, "verification_key.json")))
+    usdc = FakeUSDC()
+    ramp = Ramp(VENMO_RSA_KEY_LIMBS, usdc, max_amount=args.max_amount, vk=vk)
+    prover = None
+    if args.with_prover:
+        from ..prover.groth16_tpu import device_pk_from_zkey
+
+        cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
+        zk = _load_zkey(args)
+        _check_zkey_matches(zk, cs)
+        prover = ProverBundle(cs=cs, dpk=device_pk_from_zkey(zk), params=meta[0], layout=meta[1])
+        _log("prover bundle loaded")
+    app = OnrampApp(ramp, usdc, prover)
+    srv = serve(app, port=args.port)
+    _log(f"serving on http://127.0.0.1:{srv.server_address[1]} (ctrl-c to stop)")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.shutdown()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("zkp2p-tpu", description=__doc__)
     ap.add_argument("--build-dir", default=os.environ.get("BUILD_DIR", "build"))
@@ -278,6 +307,13 @@ def main(argv=None):
     s.add_argument("--proof", default="proof.json")
     s.add_argument("--public", default="public.json")
     s.set_defaults(fn=cmd_verify)
+
+    s = sub.add_parser("serve", help="serve the client order-book UI")
+    s.add_argument("--port", type=int, default=8080)
+    s.add_argument("--max-amount", type=int, default=10_000_000)
+    s.add_argument("--with-prover", action="store_true", help="load the zkey so /api/onramp proves")
+    s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")
     s.add_argument("--indir", required=True)

@@ -87,6 +87,7 @@ class ProvingService:
         # two-stage shell pipeline (2_gen_wtns.sh -> 5_gen_proof.sh),
         # overlapped instead of sequential.
         ready_q: "queue.Queue[Optional[List[Request]]]" = queue.Queue(maxsize=1)
+        producer_error: List[BaseException] = []
 
         def produce():
             try:
@@ -104,6 +105,8 @@ class ProvingService:
                             stats["error-bad-input"] += 1
                     if batch:
                         ready_q.put(batch)
+            except BaseException as e:  # noqa: BLE001 — re-raised by the consumer
+                producer_error.append(e)
             finally:
                 # The sentinel MUST go out even if this thread dies (e.g.
                 # _emit_error hitting a full disk) — otherwise the
@@ -132,6 +135,11 @@ class ProvingService:
                     self._emit_error(req, "error-failed-to-prove", e)
                     stats["error-failed-to-prove"] += 1
         producer.join()
+        if producer_error:
+            # Requests after the failure point got no witness, no proof
+            # and no .error.json — surfacing stats as if the sweep were
+            # complete would silently drop them.
+            raise producer_error[0]
         return stats
 
     @staticmethod
@@ -160,9 +168,7 @@ class ProvingService:
             claim_id = int(payload.get("claim_id", 0))
             if "eml_path" in payload:
                 with open(payload["eml_path"], "rb") as f:
-                    email = email_from_eml(f.read(), keys)
-                if email.modulus is None:
-                    raise ValueError("unknown DKIM key")
+                    email = email_from_eml(f.read(), keys)  # unknown keys raise
                 modulus = email.modulus
             else:
                 email = make_venmo_email(

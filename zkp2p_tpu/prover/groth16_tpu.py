@@ -243,11 +243,13 @@ def _msm_g2(bases, planes):
     return msm_windowed(G2J, bases, planes, window=MSM_WINDOW)
 
 
-# Stage-wise jits, NOT one fused program: the three wire-scalar G1 MSMs
-# (a, b1, c) share one compiled executable (same shapes), the G2 and
-# h-query MSMs get their own.  XLA compile time scales with traced-graph
-# size, so executable reuse across the proof pipeline matters more than
-# whole-program fusion; intermediates stay on device between stages.
+# Stage-wise jits, NOT one fused program: XLA compile time scales with
+# traced-graph size, so the pipeline is a handful of small executables
+# with intermediates staying on device between stages.  Since b/c
+# pruning the G1 MSMs run at three different lane counts (a: all wires,
+# b1: |b_sel|, c: |c_sel|), so jit re-specializes _msm_g1 per shape —
+# the ~50% runtime cut on b1/b2/c outweighs the extra first-proof
+# compiles (and the persistent cache amortises them across processes).
 _jit_h_planes = jax.jit(_h_and_planes)
 _jit_msm_g1 = jax.jit(_msm_g1)
 _jit_msm_g2 = jax.jit(_msm_g2)

@@ -86,14 +86,21 @@ def setup_device(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[Dev
     # fixed-base muls AND the prove-time b1/b2/c MSMs halve.
     from .groth16_tpu import _prune_sel
 
-    b_sel = _prune_sel([v % R != 0 for v in b_tau])
-    c_sel = _prune_sel(
-        [i > cs.num_public and scaled[i] % R != 0 for i in range(n_wires)]
-    )
+    b_flags = [v % R != 0 for v in b_tau]
+    c_flags = [i > cs.num_public and scaled[i] % R != 0 for i in range(n_wires)]
+    b_sel = _prune_sel(b_flags)
+    c_sel = _prune_sel(c_flags)
+    # Degenerate fallback lanes ([0] when nothing survives pruning) must
+    # be INFINITY bases: index 0 is wire one, whose gamma-scaled C point
+    # is NOT infinity — mapping the scalar to 0 here keeps the MSM a
+    # no-op for any witness.  (b_tau[0] is already 0 whenever the b
+    # fallback triggers, but map it too for uniformity.)
+    b_scalars = [b_tau[i] if b_flags[i] else 0 for i in b_sel]
+    c_scalars = [scaled[i] if c_flags[i] else 0 for i in c_sel]
     a_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, a_tau)
-    b1_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, [b_tau[i] for i in b_sel])
-    b2_bases = g2_fixed_base_batch_mont_limbs(G2_GENERATOR, [b_tau[i] for i in b_sel])
-    cq_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, [scaled[i] for i in c_sel])
+    b1_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, b_scalars)
+    b2_bases = g2_fixed_base_batch_mont_limbs(G2_GENERATOR, b_scalars)
+    cq_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, c_scalars)
     h_bases = g1_fixed_base_batch_mont_limbs(G1_GENERATOR, h_scalars)
     if a_bases is None or b2_bases is None:
         raise RuntimeError("native library unavailable; use snark.groth16.setup for small circuits")

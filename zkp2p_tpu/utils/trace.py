@@ -18,23 +18,30 @@ import contextlib
 import json
 import os
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
 _records: List[Dict[str, Any]] = []
-_stack: List[str] = []
+# Stage nesting is PER THREAD (the service overlaps a witness thread with
+# the proving thread; a shared stack would interleave their frames and
+# pop across threads).  Appends to _records are atomic under the GIL.
+_tls = threading.local()
 
 
 @contextlib.contextmanager
 def trace(stage: str, **attrs):
-    _stack.append(stage)
-    path = "/".join(_stack)
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(stage)
+    path = "/".join(stack)
     t0 = time.perf_counter()
     try:
         yield
     finally:
         _records.append({"stage": path, "ms": round((time.perf_counter() - t0) * 1e3, 3), **attrs})
-        _stack.pop()
+        stack.pop()
 
 
 def records() -> List[Dict[str, Any]]:
