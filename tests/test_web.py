@@ -101,3 +101,13 @@ def test_bad_request_is_reported(server):
     except urllib.error.HTTPError as e:
         assert e.code == 400
         assert "error" in json.loads(e.read())
+
+
+def test_wrong_wallet_signature_is_rejected(server):
+    base, _ = server
+    _post(base, "/api/orders", {"address": "carol", "signature": "s3cret", "amount": 9000000, "max_amount_to_pay": 9500000})
+    try:
+        _get(base, "/api/claims-decrypted?address=carol&order_id=1&signature=WRONG")
+        raise AssertionError("expected 403")
+    except urllib.error.HTTPError as e:
+        assert e.code == 403

@@ -53,15 +53,32 @@ class OnrampApp:
         self.lock = threading.Lock()
 
     # Wallet sessions: the reference derives the ECIES identity from a
-    # wallet signature (NewOrderForm.tsx:35-64); headless deployments
-    # pass the signature bytes in directly.
+    # wallet signature the wallet owner produces (NewOrderForm.tsx:35-64).
+    # Here the signature doubles as the session secret: the FIRST call
+    # for an address binds it, later calls must present the same bytes —
+    # otherwise any third party could replay the address and decrypt the
+    # off-ramper Venmo IDs the ECIES layer exists to hide.
     def onramper(self, address: str, signature: bytes = b"") -> OnRamper:
+        sig = signature or f"sig:{address}".encode()
         with self.lock:
-            if address not in self.onrampers:
-                self.onrampers[address] = OnRamper(
-                    address, self.ramp, signature or f"sig:{address}".encode()
-                )
-            return self.onrampers[address]
+            existing = self.onrampers.get(address)
+            if existing is None:
+                existing = OnRamper(address, self.ramp, sig)
+                existing._session_sig = sig
+                self.onrampers[address] = existing
+            elif existing._session_sig != sig:
+                raise PermissionError(f"wrong wallet signature for {address}")
+            return existing
+
+    def pubkey_of(self, address: str) -> bytes:
+        """The on-ramper's ECIES public key — public info by design (the
+        reference stores it on-chain with the order, Ramp's encryptPublicKey);
+        readable without the wallet secret."""
+        with self.lock:
+            s = self.onrampers.get(address)
+            if s is None:
+                raise ValueError(f"no on-ramper session for {address}")
+            return s.account.public_key_bytes
 
     def offramper(self, address: str, venmo_id: str) -> OffRamper:
         with self.lock:
@@ -76,7 +93,7 @@ _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>ZKP2P on-ramp (TPU)</title>
 <style>
  body{font-family:system-ui,sans-serif;margin:2rem;max-width:60rem}
- table{border-collapse:collapse;width:100%%}
+ table{border-collapse:collapse;width:100%}
  td,th{border:1px solid #ccc;padding:.35rem .6rem;text-align:left}
  form{margin:.8rem 0;padding:.8rem;border:1px solid #ddd;border-radius:6px}
  input{margin:.15rem .4rem .15rem 0}
@@ -90,6 +107,7 @@ _PAGE = """<!doctype html>
 <h2>New order (on-ramper)</h2>
 <form onsubmit="return post('/api/orders', this)">
  <input name="address" placeholder="wallet" required>
+ <input name="signature" placeholder="wallet secret" type="password">
  <input name="amount" placeholder="USDC amount" required>
  <input name="max_amount_to_pay" placeholder="max to pay" required>
  <button>Post order</button></form>
@@ -103,11 +121,13 @@ _PAGE = """<!doctype html>
 <h2>Review claims (on-ramper)</h2>
 <form onsubmit="return get2('/api/claims-decrypted', this)">
  <input name="address" placeholder="wallet" required>
+ <input name="signature" placeholder="wallet secret" type="password">
  <input name="order_id" placeholder="order id" required>
  <button>Decrypt</button></form>
 <h2>Prove receipt &amp; on-ramp</h2>
 <form onsubmit="return post('/api/onramp', this)">
  <input name="address" placeholder="wallet" required>
+ <input name="signature" placeholder="wallet secret" type="password">
  <input name="order_id" placeholder="order id" required>
  <input name="claim_id" placeholder="claim id" required>
  <input name="eml_path" placeholder=".eml path (server-side)">
@@ -151,6 +171,14 @@ def make_handler(app: OnrampApp):
             return json.loads(self.rfile.read(n) or b"{}")
 
         def do_GET(self):
+            try:
+                self._get()
+            except PermissionError as e:
+                self._json({"error": str(e)}, 403)
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+
+        def _get(self):
             from urllib.parse import parse_qs, urlparse
 
             u = urlparse(self.path)
@@ -177,7 +205,8 @@ def make_handler(app: OnrampApp):
                 q = parse_qs(u.query)
                 address = q["address"][0]
                 order_id = int(q["order_id"][0])
-                views = app.onramper(address).decrypt_claims(order_id)
+                sig = q.get("signature", [""])[0].encode()
+                views = app.onramper(address, sig).decrypt_claims(order_id)
                 self._json(
                     [
                         {
@@ -196,7 +225,7 @@ def make_handler(app: OnrampApp):
             try:
                 payload = self._read()
                 if self.path == "/api/orders":
-                    ramper = app.onramper(payload["address"])
+                    ramper = app.onramper(payload["address"], payload.get("signature", "").encode())
                     oid = ramper.post_order(
                         int(payload["amount"]), int(payload["max_amount_to_pay"])
                     )
@@ -207,7 +236,7 @@ def make_handler(app: OnrampApp):
                     order = app.ramp.orders[int(payload["order_id"])]
                     app.usdc.mint(payload["address"], order.amount)
                     app.usdc.approve(payload["address"], app.ramp.address, order.amount)
-                    on_pk = app.onramper(order.on_ramper).account.public_key_bytes
+                    on_pk = app.pubkey_of(order.on_ramper)
                     cid = off.claim_order(
                         int(payload["order_id"]), on_pk, int(payload["min_amount_to_pay"])
                     )
@@ -230,7 +259,7 @@ def make_handler(app: OnrampApp):
                             amount=str(payload.get("amount", "30")),
                         )
                         modulus = key.n
-                    ramper = app.onramper(payload["address"])
+                    ramper = app.onramper(payload["address"], payload.get("signature", "").encode())
                     inputs = ramper.prove_and_onramp(
                         app.prover.cs,
                         app.prover.dpk,
@@ -244,6 +273,8 @@ def make_handler(app: OnrampApp):
                     self._json({"ok": True, "public_signals": [str(s) for s in inputs.public_signals]})
                 else:
                     self._json({"error": "not found"}, 404)
+            except PermissionError as e:
+                self._json({"error": str(e)}, 403)
             except Exception as e:  # noqa: BLE001 — surfaced to the client
                 self._json({"error": f"{type(e).__name__}: {e}"}, 400)
 

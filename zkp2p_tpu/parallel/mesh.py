@@ -37,6 +37,23 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def make_pod_mesh(n_dcn: int, n_ici: Optional[int] = None, names=("dcn", "shard")) -> Mesh:
+    """Multi-slice mesh for pod-scale configs (the v5e-256 shape of
+    BASELINE.json): the outer `dcn` axis spans slices (data-center
+    network — carry only the proof-batch data parallelism there, one
+    all-gather of proof points per batch), the inner axis rides ICI and
+    carries the MSM/NTT sharding (msm_sharded / ntt_sharded take
+    axis=names[1] unchanged).  On a single host this builds the same
+    layout over virtual devices, which is how the driver's dryrun and the
+    tests exercise it."""
+    devs = jax.devices()
+    if n_ici is None:
+        n_ici = len(devs) // n_dcn
+    if n_ici < 1 or n_dcn * n_ici > len(devs):
+        raise ValueError(f"need {n_dcn}x{n_ici or '?'} devices, have {len(devs)}")
+    return Mesh(np.array(devs[: n_dcn * n_ici]).reshape(n_dcn, n_ici), names)
+
+
 def _fold_gathered(curve: JCurve, gathered: JacPoint, n: int) -> JacPoint:
     """Fold the per-device partial points (leading axis n) with a scan —
     the 'reduce' half of the group-op all-reduce."""

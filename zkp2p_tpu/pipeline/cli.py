@@ -246,6 +246,43 @@ def cmd_batch(args):
     _log(f"wrote {len(proofs)} proofs to {args.outdir}")
 
 
+def cmd_service(args):
+    """Run the batched proving service daemon over a spool directory
+    (queue -> witness||prove -> verify sample -> emit;
+    pipeline.service.ProvingService)."""
+    from ..pipeline.service import ProvingService
+    from ..prover.groth16_tpu import device_pk_from_zkey
+
+    if args.circuit not in ("venmo", "email_verify"):
+        raise SystemExit("service supports the email circuits (venmo, email_verify)")
+    from ..formats.proof_json import load, vkey_from_json
+
+    cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
+    zk = _load_zkey(args)
+    _check_zkey_matches(zk, cs)
+    dpk = device_pk_from_zkey(zk)
+    vk = vkey_from_json(load(os.path.join(args.build_dir, "verification_key.json")))
+    params, lay = meta
+    if args.circuit == "venmo":
+        svc = ProvingService.for_venmo(cs, lay, params, dpk, vk, batch_size=args.batch)
+    else:
+
+        def witness_fn(payload):
+            from ..inputs.email import email_verify_from_eml, generate_email_verify_inputs
+
+            with open(payload["eml_path"], "rb") as f:
+                email, modulus = email_verify_from_eml(f.read())
+            inputs = generate_email_verify_inputs(email, modulus, params, lay)
+            return cs.witness(inputs.public_signals, inputs.seed)
+
+        svc = ProvingService(
+            cs, dpk, vk, witness_fn, lambda w: list(w[1 : cs.num_public + 1]), batch_size=args.batch
+        )
+    os.makedirs(args.spool, exist_ok=True)
+    _log(f"service sweeping {args.spool} (batch={args.batch})")
+    svc.run(args.spool, poll_s=args.poll, max_sweeps=args.max_sweeps)
+
+
 def cmd_serve(args):
     """Serve the client order-book UI (client/web.py) with the in-process
     escrow; --with-prover loads the build dir's zkey so /api/onramp can
@@ -264,6 +301,8 @@ def cmd_serve(args):
     if args.with_prover:
         from ..prover.groth16_tpu import device_pk_from_zkey
 
+        if args.circuit != "venmo":
+            raise SystemExit("/api/onramp proves venmo receipts; pass --circuit venmo")
         cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
         zk = _load_zkey(args)
         _check_zkey_matches(zk, cs)
@@ -307,6 +346,14 @@ def main(argv=None):
     s.add_argument("--proof", default="proof.json")
     s.add_argument("--public", default="public.json")
     s.set_defaults(fn=cmd_verify)
+
+    s = sub.add_parser("service", help="run the batched proving service over a spool dir")
+    s.add_argument("--spool", required=True)
+    s.add_argument("--batch", type=int, default=4)
+    s.add_argument("--poll", type=float, default=1.0)
+    s.add_argument("--max-sweeps", type=int, default=None)
+    s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.set_defaults(fn=cmd_service)
 
     s = sub.add_parser("serve", help="serve the client order-book UI")
     s.add_argument("--port", type=int, default=8080)

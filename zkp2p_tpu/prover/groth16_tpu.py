@@ -47,7 +47,7 @@ from ..curve.jcurve import (
 )
 from ..field.bn254 import R
 from ..field.jfield import FR, NUM_LIMBS, lazy_segment_sum_mod
-from ..ops.msm import digit_planes_from_limbs, msm_windowed
+from ..ops.msm import default_lanes, digit_planes_from_limbs, msm_windowed
 from ..ops.ntt import coset_shift, intt, ntt
 
 # Window width for the prover MSMs: 4-bit digits -> ~78 point-adds per
@@ -236,11 +236,14 @@ def _h_and_planes(dpk: DeviceProvingKey, w_mont: jnp.ndarray):
 
 
 def _msm_g1(bases, planes):
-    return msm_windowed(G1J, bases, planes, window=MSM_WINDOW)
+    # lanes from the static base count: wide steps keep the VPU batch
+    # large (TPU ops are latency-bound at small batches — see
+    # ops.msm.default_lanes).
+    return msm_windowed(G1J, bases, planes, lanes=default_lanes(bases[0].shape[0]), window=MSM_WINDOW)
 
 
 def _msm_g2(bases, planes):
-    return msm_windowed(G2J, bases, planes, window=MSM_WINDOW)
+    return msm_windowed(G2J, bases, planes, lanes=default_lanes(bases[0].shape[0], cap=2048), window=MSM_WINDOW)
 
 
 # Stage-wise jits, NOT one fused program: XLA compile time scales with
