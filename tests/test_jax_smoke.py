@@ -49,3 +49,48 @@ def test_ntt_roundtrip_smoke():
     assert [FR.from_mont_host(r) for r in np.asarray(got)] == want
     back = intt(got, log_m)
     assert [FR.from_mont_host(r) for r in np.asarray(back)] == vals
+
+
+def test_limb_major_conv_matches_matmul_path():
+    """Both _mul_wide layouts are bit-exact vs the host oracle and each
+    other (CONV_LAYOUT is a pure perf knob)."""
+    from zkp2p_tpu.field import jfield
+
+    rng = np.random.default_rng(9)
+    vals = [(int.from_bytes(rng.bytes(31), "big") % R, int.from_bytes(rng.bytes(31), "big") % R) for _ in range(8)]
+    a = np.stack([FR.to_mont_host(x) for x, _ in vals])
+    b = np.stack([FR.to_mont_host(y) for _, y in vals])
+    saved = jfield.CONV_LAYOUT
+    try:
+        jfield.CONV_LAYOUT = "matmul"
+        got_m = np.asarray(FR.mul(a, b))
+        jfield.CONV_LAYOUT = "limb_major"
+        got_l = np.asarray(FR.mul(a, b))
+    finally:
+        jfield.CONV_LAYOUT = saved
+    np.testing.assert_array_equal(got_m, got_l)
+    for i, (x, y) in enumerate(vals):
+        assert FR.from_mont_host(got_l[i]) == x * y % R
+
+
+def test_limb_major_reduce_wide_and_addsub():
+    """The non-mul users of _mul_wide (Montgomery reduction, sub borrow
+    chains) also agree across layouts."""
+    from zkp2p_tpu.field import jfield
+    from zkp2p_tpu.field.jfield import reduce_wide
+
+    rng = np.random.default_rng(11)
+    wide_vals = [int.from_bytes(rng.bytes(60), "big") for _ in range(4)]
+    arr = np.stack(
+        [np.array([(v >> (16 * i)) & 0xFFFF for i in range(30)], dtype=np.uint32) for v in wide_vals]
+    )
+    from zkp2p_tpu.field.jfield import limbs_to_int
+
+    saved = jfield.CONV_LAYOUT
+    try:
+        jfield.CONV_LAYOUT = "limb_major"
+        got = np.asarray(reduce_wide(FR, arr))
+    finally:
+        jfield.CONV_LAYOUT = saved
+    for i, v in enumerate(wide_vals):
+        assert limbs_to_int(got[i]) == v % R

@@ -56,7 +56,7 @@ def test_order_claim_decrypt_flow(server):
         assert b"ZKP2P" in r.read()
 
     # on-ramper posts an order
-    out = _post(base, "/api/orders", {"address": "alice", "amount": 30_000_000, "max_amount_to_pay": 31_000_000})
+    out = _post(base, "/api/orders", {"address": "alice", "signature": "alice-sig", "amount": 30_000_000, "max_amount_to_pay": 31_000_000})
     oid = out["order_id"]
     orders = _get(base, "/api/orders")
     assert orders[-1]["id"] == oid and orders[-1]["status"] == "Open"
@@ -69,8 +69,9 @@ def test_order_claim_decrypt_flow(server):
     )
     cid = out["claim_id"]
 
-    # on-ramper decrypts and verifies the claim hash (Matches column)
-    views = _get(base, f"/api/claims-decrypted?address=alice&order_id={oid}")
+    # on-ramper decrypts and verifies the claim hash (Matches column) —
+    # POST so the wallet secret stays out of query strings
+    views = _post(base, "/api/claims-decrypted", {"address": "alice", "signature": "alice-sig", "order_id": oid})
     assert views == [
         {"claim_id": cid, "venmo_id": "1234567891234567891", "matches": True, "min_amount_to_pay": 30_500_000}
     ]
@@ -78,7 +79,7 @@ def test_order_claim_decrypt_flow(server):
     # prover-gated endpoint reports unavailable without a bundle
     req = urllib.request.Request(
         base + "/api/onramp",
-        data=json.dumps({"address": "alice", "order_id": oid, "claim_id": cid}).encode(),
+        data=json.dumps({"address": "alice", "signature": "alice-sig", "order_id": oid, "claim_id": cid}).encode(),
         headers={"content-type": "application/json"},
     )
     try:
@@ -106,8 +107,17 @@ def test_bad_request_is_reported(server):
 def test_wrong_wallet_signature_is_rejected(server):
     base, _ = server
     _post(base, "/api/orders", {"address": "carol", "signature": "s3cret", "amount": 9000000, "max_amount_to_pay": 9500000})
-    try:
-        _get(base, "/api/claims-decrypted?address=carol&order_id=1&signature=WRONG")
-        raise AssertionError("expected 403")
-    except urllib.error.HTTPError as e:
-        assert e.code == 403
+    for payload in (
+        {"address": "carol", "signature": "WRONG", "order_id": 1},
+        {"address": "carol", "order_id": 1},  # missing secret
+    ):
+        req = urllib.request.Request(
+            base + "/api/claims-decrypted",
+            data=json.dumps(payload).encode(),
+            headers={"content-type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 403")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403

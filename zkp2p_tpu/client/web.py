@@ -58,15 +58,22 @@ class OnrampApp:
     # for an address binds it, later calls must present the same bytes —
     # otherwise any third party could replay the address and decrypt the
     # off-ramper Venmo IDs the ECIES layer exists to hide.
+    # DEMO LIMITATION: with the in-process chain there are no real wallet
+    # keys, so the server cannot verify that a signature belongs to an
+    # address (the reference proves ownership via signMessage + the wagmi
+    # wallet, NewOrderForm.tsx:35-64).  First-use binds the secret; a
+    # production deployment must verify an actual wallet signature over a
+    # login message before binding.
     def onramper(self, address: str, signature: bytes = b"") -> OnRamper:
-        sig = signature or f"sig:{address}".encode()
+        if not signature:
+            raise PermissionError("wallet secret required (it seeds the ECIES identity)")
         with self.lock:
             existing = self.onrampers.get(address)
             if existing is None:
-                existing = OnRamper(address, self.ramp, sig)
-                existing._session_sig = sig
+                existing = OnRamper(address, self.ramp, signature)
+                existing._session_sig = signature
                 self.onrampers[address] = existing
-            elif existing._session_sig != sig:
+            elif existing._session_sig != signature:
                 raise PermissionError(f"wrong wallet signature for {address}")
             return existing
 
@@ -107,7 +114,7 @@ _PAGE = """<!doctype html>
 <h2>New order (on-ramper)</h2>
 <form onsubmit="return post('/api/orders', this)">
  <input name="address" placeholder="wallet" required>
- <input name="signature" placeholder="wallet secret" type="password">
+ <input name="signature" placeholder="wallet secret" type="password" required>
  <input name="amount" placeholder="USDC amount" required>
  <input name="max_amount_to_pay" placeholder="max to pay" required>
  <button>Post order</button></form>
@@ -119,15 +126,15 @@ _PAGE = """<!doctype html>
  <input name="min_amount_to_pay" placeholder="min pay" required>
  <button>Claim</button></form>
 <h2>Review claims (on-ramper)</h2>
-<form onsubmit="return get2('/api/claims-decrypted', this)">
+<form onsubmit="return post('/api/claims-decrypted', this)">
  <input name="address" placeholder="wallet" required>
- <input name="signature" placeholder="wallet secret" type="password">
+ <input name="signature" placeholder="wallet secret" type="password" required>
  <input name="order_id" placeholder="order id" required>
  <button>Decrypt</button></form>
 <h2>Prove receipt &amp; on-ramp</h2>
 <form onsubmit="return post('/api/onramp', this)">
  <input name="address" placeholder="wallet" required>
- <input name="signature" placeholder="wallet secret" type="password">
+ <input name="signature" placeholder="wallet secret" type="password" required>
  <input name="order_id" placeholder="order id" required>
  <input name="claim_id" placeholder="claim id" required>
  <input name="eml_path" placeholder=".eml path (server-side)">
@@ -144,10 +151,6 @@ async function post(url, f){
   const body = Object.fromEntries(new FormData(f));
   const r = await fetch(url, {method:'POST', headers:{'content-type':'application/json'}, body: JSON.stringify(body)});
   say(await r.json()); refresh(); return false;
-}
-async function get2(url, f){
-  const q = new URLSearchParams(new FormData(f));
-  const r = await fetch(url + '?' + q); say(await r.json()); return false;
 }
 refresh(); setInterval(refresh, 15000);  // MainPage.tsx 15s polling
 </script></body></html>"""
@@ -201,30 +204,30 @@ def make_handler(app: OnrampApp):
                     for oid, o in app.ramp.get_all_orders()
                 ]
                 self._json(rows)
-            elif u.path == "/api/claims-decrypted":
-                q = parse_qs(u.query)
-                address = q["address"][0]
-                order_id = int(q["order_id"][0])
-                sig = q.get("signature", [""])[0].encode()
-                views = app.onramper(address, sig).decrypt_claims(order_id)
-                self._json(
-                    [
-                        {
-                            "claim_id": v.claim_id,
-                            "venmo_id": v.venmo_id,
-                            "matches": v.hash_matches,
-                            "min_amount_to_pay": v.min_amount_to_pay,
-                        }
-                        for v in views
-                    ]
-                )
             else:
                 self._json({"error": "not found"}, 404)
 
         def do_POST(self):
             try:
                 payload = self._read()
-                if self.path == "/api/orders":
+                if self.path == "/api/claims-decrypted":
+                    # POST so the wallet secret travels in the body, not
+                    # in query strings / proxy logs / browser history.
+                    views = app.onramper(
+                        payload["address"], payload.get("signature", "").encode()
+                    ).decrypt_claims(int(payload["order_id"]))
+                    self._json(
+                        [
+                            {
+                                "claim_id": v.claim_id,
+                                "venmo_id": v.venmo_id,
+                                "matches": v.hash_matches,
+                                "min_amount_to_pay": v.min_amount_to_pay,
+                            }
+                            for v in views
+                        ]
+                    )
+                elif self.path == "/api/orders":
                     ramper = app.onramper(payload["address"], payload.get("signature", "").encode())
                     oid = ramper.post_order(
                         int(payload["amount"]), int(payload["max_amount_to_pay"])

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from functools import lru_cache
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -47,34 +49,37 @@ def _shift_up(x: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.pad(x, pad)[..., : x.shape[-1]]
 
 
-def _carry_canon(x: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
-    """Propagate carries: arbitrary uint32 limbs -> canonical 16-bit limbs.
+def _carry_ladder(x: jnp.ndarray, out_limbs: int, up) -> jnp.ndarray:
+    """The relax + Kogge-Stone carry core, shared by both conv layouts
+    (`up` is the limb-axis shift for whichever axis holds limbs).
 
-    Log-depth instead of a limb-count ripple: two local folds bring every
-    limb to <= 2^16, then a Kogge-Stone generate/propagate ladder resolves
-    the remaining 0/1 carries in ceil(log2(out_limbs)) vector steps.  Keeps
-    both the traced graph and the runtime dependency chain at O(log limbs).
-
-    Callers guarantee limbs beyond `out_limbs` are zero (no value is
-    silently truncated).
-    """
-    L = x.shape[-1]
-    if L < out_limbs:
-        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, out_limbs - L)])
-    else:
-        x = x[..., :out_limbs]
-    # Fold 1: limbs < 2^16 + 2^16 = 2^17.  Fold 2: limbs <= 2^16.
+    Two local folds bring every limb to <= 2^16, then a generate/propagate
+    doubling ladder resolves the remaining 0/1 carries in
+    ceil(log2(out_limbs)) vector steps — O(log limbs) graph and runtime
+    dependency chain."""
     for _ in range(2):
-        x = (x & MASK) + _shift_up(x >> LIMB_BITS, 1)
+        x = (x & MASK) + up(x >> LIMB_BITS, 1)
     g = x >> LIMB_BITS  # 0/1 generate
     r = x & MASK
     p = (r == MASK).astype(jnp.uint32)  # propagate
     k = 1
     while k < out_limbs:
-        g = g | (p & _shift_up(g, k))
-        p = p & _shift_up(p, k)
+        g = g | (p & up(g, k))
+        p = p & up(p, k)
         k *= 2
-    return (r + _shift_up(g, 1)) & MASK
+    return (r + up(g, 1)) & MASK
+
+
+def _carry_canon(x: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Propagate carries: arbitrary uint32 limbs -> canonical 16-bit limbs
+    (limbs on the LAST axis).  Callers guarantee limbs beyond `out_limbs`
+    are zero (no value is silently truncated)."""
+    L = x.shape[-1]
+    if L < out_limbs:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, out_limbs - L)])
+    else:
+        x = x[..., :out_limbs]
+    return _carry_ladder(x, out_limbs, _shift_up)
 
 
 @lru_cache(maxsize=None)
@@ -91,18 +96,55 @@ def _conv_onehot(n: int, m: int) -> jnp.ndarray:
         return jnp.asarray(w.reshape(2 * n * m, L))
 
 
+# Convolution layout selector.  "matmul": the f32 one-hot matmul below
+# (MXU path).  "limb_major": transpose so the BATCH is the minor axis and
+# run 16 shifted VPU multiply-accumulates — XLA:TPU tiles the last two
+# dims onto (8 sublanes, 128 lanes), so batch-major (B, 16) tensors use
+# only 16/128 lanes on every elementwise op while limb-major (16, B)
+# fills them.  Flip at runtime (e.g. ZKP2P_FIELD_CONV=limb_major) to A/B
+# on hardware; both are bit-exact and differentially tested.
+CONV_LAYOUT = os.environ.get("ZKP2P_FIELD_CONV", "matmul")
+
+
+def _mul_wide_limb_major(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook conv with limbs on axis 0 and the flattened batch on
+    the minor axis: 16 iterations of (Lb, B) u32 multiply + two padded
+    adds into a (La+Lb+1, B) accumulator, then a log-depth carry ladder
+    along axis 0.  Sums per output limb <= 2*16 values < 2^16 -> u32
+    accumulation exact."""
+    La, Lb = a.shape[-1], b.shape[-1]
+    bshape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    A = jnp.moveaxis(jnp.broadcast_to(a, bshape + (La,)), -1, 0).reshape(La, -1)
+    Bv = jnp.moveaxis(jnp.broadcast_to(b, bshape + (Lb,)), -1, 0).reshape(Lb, -1)
+    out_len = La + Lb + 1
+    acc = jnp.zeros((out_len, A.shape[1]), dtype=jnp.uint32)
+    for i in range(La):
+        p = A[i][None, :] * Bv  # (Lb, B), exact u32
+        acc = acc + jnp.pad(p & MASK, ((i, out_len - Lb - i), (0, 0)))
+        acc = acc + jnp.pad(p >> LIMB_BITS, ((i + 1, out_len - Lb - i - 1), (0, 0)))
+    out_limbs = La + Lb
+    acc = acc[:out_limbs]
+
+    def up(x, k):  # limb-axis shift, limbs on axis 0
+        return jnp.pad(x, ((k, 0), (0, 0)))[: x.shape[0]]
+
+    res = _carry_ladder(acc, out_limbs, up)
+    return jnp.moveaxis(res.reshape((out_limbs,) + bshape), 0, -1)
+
+
 def _mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Full product of two 16-limb values -> 32 canonical limbs.
 
-    Schoolbook convolution as ONE f32 matmul: every partial product
-    a_i*b_j < 2^32 is split into 16-bit halves (each exact in f32), and a
-    precomputed 0/1 matrix folds the (2,16,16) planes onto their limb
-    offsets.  Each output limb sums <= 32 values < 2^16, so the f32
-    accumulation stays integral (< 2^21 << 2^24) — bit-exact, and the
-    contraction runs on the TPU MXU (systolic array) instead of unrolling
-    into dozens of VPU pad/add ops per multiply (which also made traced
-    graphs ~10x bigger and XLA compiles ~10x slower).
+    Default path: schoolbook convolution as ONE f32 matmul — every
+    partial product a_i*b_j < 2^32 is split into 16-bit halves (each
+    exact in f32), and a precomputed 0/1 matrix folds the (2,16,16)
+    planes onto their limb offsets.  Each output limb sums <= 32 values
+    < 2^16, so the f32 accumulation stays integral (< 2^21 << 2^24) —
+    bit-exact, and the contraction runs on the TPU MXU.
+    See CONV_LAYOUT for the limb-major VPU alternative.
     """
+    if CONV_LAYOUT == "limb_major":
+        return _mul_wide_limb_major(a, b)
     n = a.shape[-1]
     m = b.shape[-1]
     prods = a[..., :, None] * b[..., None, :]  # (..., n, m) uint32

@@ -233,8 +233,11 @@ def split_zkey(path: str, n_chunks: int = 10) -> List[str]:
 
 
 def read_zkey(path_or_chunks) -> ZkeyData:
-    """Parse a zkey from one path or an ordered chunk-path list."""
-    if isinstance(path_or_chunks, (list, tuple)):
+    """Parse a zkey from one path, an ordered chunk-path list, or raw
+    bytes (e.g. reassembled from the artifact store)."""
+    if isinstance(path_or_chunks, (bytes, bytearray)):
+        data = bytes(path_or_chunks)
+    elif isinstance(path_or_chunks, (list, tuple)):
         data = b""
         for p in path_or_chunks:
             with open(p, "rb") as f:

@@ -89,6 +89,15 @@ def cmd_setup(args):
     if args.chunks:
         split_zkey(zkey_path, args.chunks)
         _log(f"wrote {args.chunks} zkey chunks (b..) beside {zkey_path}")
+    if args.publish:
+        # The S3 layer (upload_chunked_keys_to_s3.sh semantics): gzip
+        # chunks + manifest + integrity hash into the artifact store.
+        from ..formats.artifact_store import DirBackend, upload_chunked
+
+        with open(zkey_path, "rb") as f:
+            blob = f.read()
+        man = upload_chunked(DirBackend(args.publish), "circuit.zkey", blob)
+        _log(f"published {len(man.chunks)} gzip chunks -> {args.publish} (sha256 {man.sha256[:16]}…)")
     dump(vkey_to_json(vk), os.path.join(args.build_dir, "verification_key.json"))
     with open(os.path.join(args.build_dir, "verifier.sol"), "w") as f:
         f.write(export_verifier(vk))
@@ -98,9 +107,20 @@ def cmd_setup(args):
 def _load_zkey(args):
     """The key material always travels as a snarkjs-format .zkey (never
     pickle): --zkey overrides (monolithic path or glob of b..k chunks),
-    default is the build dir's circuit_final.zkey."""
+    --zkey-store pulls through the chunked artifact store (the browser's
+    S3-download + IndexedDB-cache path, `zkp.ts:24-68`), default is the
+    build dir's circuit_final.zkey."""
     from ..formats.zkey import read_zkey
 
+    if getattr(args, "zkey_store", None):
+        from ..formats.artifact_store import DirBackend, download_chunked
+
+        blob = download_chunked(
+            DirBackend(args.zkey_store),
+            "circuit.zkey",
+            cache_dir=os.path.join(args.build_dir, "zkey_cache"),
+        )
+        return read_zkey(blob)
     if getattr(args, "zkey", None):
         paths = sorted(glob.glob(args.zkey)) if any(c in args.zkey for c in "*?[") else args.zkey
         if isinstance(paths, list) and not paths:
@@ -184,6 +204,26 @@ def _witness_for(args, cs, meta, source=None):
 def cmd_prove(args):
     from ..formats.proof_json import dump, proof_to_json, public_to_json
     from ..prover.groth16_tpu import device_pk_from_zkey, prove_tpu
+
+    if getattr(args, "wtns", None):
+        # Drop-in rapidsnark/snarkjs parity (`6_gen_proof_rapidsnark.sh:24-31`):
+        # externally generated witness.wtns + zkey in, proof out — no
+        # circuit rebuild needed, everything comes from the files.
+        from ..formats.circom_bin import read_wtns
+
+        zk = _load_zkey(args)
+        w = read_wtns(args.wtns)
+        if len(w) != zk.n_vars:
+            raise SystemExit(f"witness has {len(w)} wires, zkey expects {zk.n_vars}")
+        dpk = device_pk_from_zkey(zk)
+        pub = w[1 : zk.n_public + 1]
+        t0 = time.time()
+        proof = prove_tpu(dpk, w)
+        _log(f"proved in {time.time()-t0:.1f}s (incl. first-call compile)")
+        dump(proof_to_json(proof), args.proof)
+        dump(public_to_json(pub), args.public)
+        _log(f"wrote {args.proof} {args.public}")
+        return
 
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
     zk = _load_zkey(args)
@@ -329,6 +369,7 @@ def main(argv=None):
     s = sub.add_parser("setup", help="build circuit + dev zkey + vkey + verifier.sol")
     s.add_argument("--seed", default="zkp2p-tpu-dev")
     s.add_argument("--chunks", type=int, default=0, help="also split the zkey into N chunks (b..)")
+    s.add_argument("--publish", help="artifact-store dir: upload gzip zkey chunks + manifest")
     s.set_defaults(fn=cmd_setup)
 
     s = sub.add_parser("prove", help="prove one input on TPU")
@@ -336,6 +377,8 @@ def main(argv=None):
     s.add_argument("--demo", action="store_true", help="use the synthetic signed email")
     s.add_argument("--message", help="message (sha256 circuit)")
     s.add_argument("--zkey", help="zkey path or chunk glob (default: BUILD_DIR/circuit_final.zkey)")
+    s.add_argument("--zkey-store", help="artifact-store dir to pull the chunked zkey from")
+    s.add_argument("--wtns", help="externally generated witness.wtns (drop-in prover parity)")
     s.add_argument("--order-id", type=int, default=1)
     s.add_argument("--claim-id", type=int, default=0)
     s.add_argument("--proof", default="proof.json")
