@@ -1,0 +1,54 @@
+"""The amount-extraction demo circuit: a small REAL member of the model
+family (the Venmo amount block of `circuit/circuit.circom:225-272`) —
+byte range checks, the VenmoAmountRegex DFA scan with exact match count,
+masked reveal, one-hot shift window, 7-byte packing — over a 32-byte
+subject slice (~3.4k constraints).
+
+Shared by the driver's `dryrun_multichip` (sharded prove path on virtual
+devices) and `bench.py`'s CPU-fallback path: small enough for a 1-core
+host, real enough to exercise the whole gadget stack.
+"""
+
+from __future__ import annotations
+
+AMOUNT_LEN = 21
+SUBJ_LEN = 32
+
+
+def amount_circuit():
+    """-> (ConstraintSystem, public signal values, witness seed)."""
+    from ..gadgets import core
+    from ..gadgets.regex import CharClassCache, dfa_scan, match_count, reveal_bytes
+    from ..inputs.email import pack_bytes_le
+    from ..models import common
+    from ..models.venmo import _amount_reveal_states
+    from ..regexc import compiler as regexc
+    from ..snark.r1cs import LC, ConstraintSystem
+
+    cs = ConstraintSystem("graft_amount")
+    amount_words = [cs.new_public(f"amount[{i}]") for i in range(3)]
+    subject = cs.new_wires(SUBJ_LEN, "subject")
+    amount_idx = cs.new_wire("amount_idx")
+    bits = core.assert_bytes(cs, subject, "subj")
+    cache = CharClassCache(cs)
+    for w, b in zip(subject, bits):
+        cache.register_bits(w, b)
+    dfa = regexc.search_dfa(regexc.VENMO_AMOUNT)
+    states = dfa_scan(cs, list(subject), dfa, cache, "amt")
+    cnt = match_count(cs, states, dfa.accept, "amt.cnt")
+    cs.enforce_eq(LC.of(cnt), LC.const(1), "amt/count")
+    reveal = reveal_bytes(cs, subject, states, _amount_reveal_states(dfa), "amt.rev")
+    onehot = core.one_hot(cs, amount_idx, SUBJ_LEN - AMOUNT_LEN, "amt.idx")
+    chars = common.shift_window(cs, reveal, onehot, AMOUNT_LEN, "amt.shift")
+    words = core.pack_bytes(cs, chars, 7, "amt.pack")
+    for w, pub in zip(words, amount_words):
+        cs.enforce_eq(LC.of(w), LC.of(pub), "amt/out")
+
+    # $ must sit inside the one-hot window (SUBJ_LEN - AMOUNT_LEN lanes)
+    subj = b"subject:$42.00\r\n"
+    subj = subj + b"\x00" * (SUBJ_LEN - len(subj))
+    amt = b"42." + b"\x00" * (AMOUNT_LEN - 3)
+    pubs = pack_bytes_le(amt, 7)
+    seed = {w: b for w, b in zip(subject, subj)}
+    seed[amount_idx] = subj.find(b"$") + 1
+    return cs, pubs, seed
