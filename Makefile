@@ -1,0 +1,39 @@
+# zkp2p_tpu build/verification entry points.
+#
+# `make driver-rehearsal` runs the EXACT commands the round driver runs,
+# under the driver's own timeout discipline, and fails loudly — the
+# guard against "green locally, red in the artifact" rounds (VERDICT r3
+# weakness #1/#2).  Run it before closing a round; quote its output in
+# the round notes.
+
+.PHONY: native test test-slow driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+
+native:
+	$(MAKE) -C csrc
+
+test:
+	python -m pytest tests/ -x -q
+
+test-slow:
+	ZKP2P_RUN_SLOW=1 python -m pytest tests/ -x -q
+
+# -- driver simulation ------------------------------------------------
+# The driver gives dryrun_multichip ~10 minutes on a cold 1-core host
+# and runs bench.py with a similar budget.  These targets time out a
+# little below that so a local pass implies a driver pass with margin.
+
+rehearsal-dryrun:
+	@echo "== dryrun_multichip(8) under timeout 600 =="
+	time timeout 600 python -c 'import __graft_entry__ as g; g.dryrun_multichip(8)'
+
+rehearsal-bench:
+	@echo "== bench.py under timeout 900 =="
+	time timeout 900 python bench.py
+
+driver-rehearsal: rehearsal-dryrun rehearsal-bench
+	@echo "driver-rehearsal: ALL GREEN"
+
+# Full-size flagship proof with the native C++ runtime (caches under
+# .bench_cache/; artifacts in docs/fullsize_proof/).
+fullsize-proof:
+	JAX_PLATFORMS=cpu python tools/prove_fullsize_native.py

@@ -202,6 +202,10 @@ static void jac_add_mixed(G1Jac &r, const G1Jac &p, const u64 x2[4], const u64 y
   memcpy(r.Z, z3, 32);
 }
 
+// Full Jacobian + Jacobian G1 add (defined with the Pippenger MSM below;
+// also the accumulate step of the fixed-base batches).
+static void g1_add_jac(G1Jac &acc, const G1Jac &e);
+
 // Fermat inverse via exponentiation (p - 2); only used once per output.
 static void mont_inv(u64 out[4], const u64 a[4]) {
   // exponent p-2, big-endian bit scan
@@ -237,7 +241,9 @@ void fp_from_mont(const u64 *in, u64 *out, int n) {
 // Window-8 table built per call (n is large in setup, so amortised).
 void g1_fixed_base_batch(const u64 *base_xy, const u64 *scalars, int n, u64 *out_xy) {
   // Build table[32][256] affine-in-Jacobian: keep Jacobian to skip inversions.
-  static G1Jac table[32][256];  // ~0.8 MB; single-threaded use
+  // Heap per call: ctypes releases the GIL, so a function-local static
+  // would be shared (and corrupted) by concurrent callers (r3 advisor).
+  G1Jac(*table)[256] = new G1Jac[32][256];
   u64 bx[4], by[4];
   fp_to_mont(base_xy, bx, 1);
   fp_to_mont(base_xy + 4, by, 1);
@@ -271,55 +277,7 @@ void g1_fixed_base_batch(const u64 *base_xy, const u64 *scalars, int n, u64 *out
     for (int w = 0; w < 32; ++w) {
       int d = (int)((s[w / 8] >> ((w % 8) * 8)) & 0xff);
       if (!d) continue;
-      const G1Jac &e = table[w][d];
-      if (is_zero4(acc.Z)) {
-        acc = e;
-      } else {
-        // general Jacobian add via mixed trick: normalise e lazily is
-        // costly; use add-via-double formulas on Jacobian pair:
-        // convert e to affine once would need inversion; instead use
-        // full jacobian addition:
-        u64 Z1Z1[4], Z2Z2[4], U1[4], U2[4], S1[4], S2[4], H[4], Rr[4];
-        mont_sqr(Z1Z1, acc.Z);
-        mont_sqr(Z2Z2, e.Z);
-        mont_mul(U1, acc.X, Z2Z2);
-        mont_mul(U2, e.X, Z1Z1);
-        u64 t[4];
-        mont_mul(t, acc.Y, e.Z);
-        mont_mul(S1, t, Z2Z2);
-        mont_mul(t, e.Y, acc.Z);
-        mont_mul(S2, t, Z1Z1);
-        sub_mod(H, U2, U1);
-        sub_mod(Rr, S2, S1);
-        if (is_zero4(H)) {
-          if (is_zero4(Rr)) {
-            jac_double(acc, acc);
-            continue;
-          }
-          memset(&acc, 0, sizeof(acc));
-          continue;
-        }
-        u64 HH[4], HHH[4], V[4];
-        mont_sqr(HH, H);
-        mont_mul(HHH, H, HH);
-        mont_mul(V, U1, HH);
-        u64 x3[4], y3[4], z3[4];
-        mont_sqr(t, Rr);
-        sub_mod(t, t, HHH);
-        u64 v2[4];
-        add_mod(v2, V, V);
-        sub_mod(x3, t, v2);
-        sub_mod(t, V, x3);
-        mont_mul(t, Rr, t);
-        u64 t2[4];
-        mont_mul(t2, S1, HHH);
-        sub_mod(y3, t, t2);
-        mont_mul(t, acc.Z, e.Z);
-        mont_mul(z3, t, H);
-        memcpy(acc.X, x3, 32);
-        memcpy(acc.Y, y3, 32);
-        memcpy(acc.Z, z3, 32);
-      }
+      g1_add_jac(acc, table[w][d]);
     }
     u64 *o = out_xy + 8 * i;
     if (is_zero4(acc.Z)) {
@@ -335,6 +293,7 @@ void g1_fixed_base_batch(const u64 *base_xy, const u64 *scalars, int n, u64 *out
     fp_from_mont(mx, o, 1);
     fp_from_mont(my, o + 4, 1);
   }
+  delete[] table;
 }
 
 // Self-test hook: c = a*b mod p (standard form in/out).
@@ -531,7 +490,7 @@ extern "C" {
 // per point — the Montgomery trick).  out: n * 8 u64 (x, y) Montgomery;
 // (0,0) = infinity.
 void g1_fixed_base_batch_mont(const u64 *base_xy, const u64 *scalars, int n, u64 *out_xy) {
-  static G1Jac table[32][256];
+  G1Jac(*table)[256] = new G1Jac[32][256];  // heap per call: GIL-free concurrent safety
   u64 bx[4], by[4];
   fp_to_mont(base_xy, bx, 1);
   fp_to_mont(base_xy + 4, by, 1);
@@ -560,48 +519,7 @@ void g1_fixed_base_batch_mont(const u64 *base_xy, const u64 *scalars, int n, u64
     for (int w = 0; w < 32; ++w) {
       int d = (int)((s[w / 8] >> ((w % 8) * 8)) & 0xff);
       if (!d) continue;
-      const G1Jac &e = table[w][d];
-      if (is_zero4(acc.Z)) {
-        acc = e;
-      } else {
-        // full Jacobian add (table entries are Jacobian)
-        u64 Z1Z1[4], Z2Z2[4], U1[4], U2[4], S1[4], S2[4], H[4], Rr[4], t[4];
-        mont_sqr(Z1Z1, acc.Z);
-        mont_sqr(Z2Z2, e.Z);
-        mont_mul(U1, acc.X, Z2Z2);
-        mont_mul(U2, e.X, Z1Z1);
-        mont_mul(t, acc.Y, e.Z);
-        mont_mul(S1, t, Z2Z2);
-        mont_mul(t, e.Y, acc.Z);
-        mont_mul(S2, t, Z1Z1);
-        sub_mod(H, U2, U1);
-        sub_mod(Rr, S2, S1);
-        if (is_zero4(H)) {
-          if (is_zero4(Rr)) {
-            jac_double(acc, acc);
-            continue;
-          }
-          memset(&acc, 0, sizeof(acc));
-          continue;
-        }
-        u64 HH[4], HHH[4], V[4], x3[4], y3[4], z3[4], t2[4], v2[4];
-        mont_sqr(HH, H);
-        mont_mul(HHH, H, HH);
-        mont_mul(V, U1, HH);
-        mont_sqr(t, Rr);
-        sub_mod(t, t, HHH);
-        add_mod(v2, V, V);
-        sub_mod(x3, t, v2);
-        sub_mod(t, V, x3);
-        mont_mul(t, Rr, t);
-        mont_mul(t2, S1, HHH);
-        sub_mod(y3, t, t2);
-        mont_mul(t, acc.Z, e.Z);
-        mont_mul(z3, t, H);
-        memcpy(acc.X, x3, 32);
-        memcpy(acc.Y, y3, 32);
-        memcpy(acc.Z, z3, 32);
-      }
+      g1_add_jac(acc, table[w][d]);
     }
     accs[i] = acc;
   }
@@ -635,12 +553,13 @@ void g1_fixed_base_batch_mont(const u64 *base_xy, const u64 *scalars, int n, u64
   }
   delete[] prefix;
   delete[] accs;
+  delete[] table;
 }
 
 // G2 fixed-base batch, Montgomery output.  base: (x.c0, x.c1, y.c0, y.c1)
 // standard form (16 u64); out: n * 16 u64 Montgomery; all-zero = infinity.
 void g2_fixed_base_batch_mont(const u64 *base, const u64 *scalars, int n, u64 *out) {
-  static G2Jac table[32][256];
+  G2Jac(*table)[256] = new G2Jac[32][256];  // heap per call: GIL-free concurrent safety
   Fp2 bx, by;
   fp_to_mont(base, bx.c0, 1);
   fp_to_mont(base + 4, bx.c1, 1);
@@ -715,6 +634,397 @@ void g2_fixed_base_batch_mont(const u64 *base, const u64 *scalars, int n, u64 *o
   }
   delete[] prefix;
   delete[] accs;
+  delete[] table;
+}
+
+}  // extern "C"
+
+// ===================================================================
+// Fr scalar field + NTT + Pippenger MSM: the native Groth16 prover
+// runtime.  This is the rapidsnark-analog of the framework (the
+// reference's fastest prover is native C++, dizkus-scripts/
+// 6_gen_proof_rapidsnark.sh); the TPU path (prover/groth16_tpu.py) is
+// the accelerator backend, this is the portable-CPU one.  Same
+// dataflow as prove_tpu: sparse matvec -> iNTT/coset/NTT ladder ->
+// variable-base MSMs -> (host) blind+assemble, differentially tested
+// against prove_host in tests/test_native_prover.py.
+// ===================================================================
+
+// BN254 scalar field r (little-endian limbs) and Montgomery constants.
+static const u64 R_MOD[4] = {0x43e1f593f0000001ULL, 0x2833e84879b97091ULL,
+                             0xb85045b68181585dULL, 0x30644e72e131a029ULL};
+static const u64 RINV = 0xc2e1f593efffffffULL;  // -r^-1 mod 2^64
+static const u64 R2R[4] = {0x1bb8e645ae216da7ULL, 0x53fe3ab1e35c59e3ULL,
+                           0x8c49833d53bb8085ULL, 0x0216d0b17f4e44a5ULL};
+static const u64 ONE_R[4] = {0xac96341c4ffffffbULL, 0x36fc76959f60cd29ULL,
+                             0x666ea36f7879462eULL, 0x0e0a77c19a07df2fULL};
+
+static inline void fr_add(u64 out[4], const u64 a[4], const u64 b[4]) {
+  u64 t[5];
+  u128 carry = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 s = (u128)a[i] + b[i] + carry;
+    t[i] = (u64)s;
+    carry = s >> 64;
+  }
+  t[4] = (u64)carry;
+  if (t[4] || geq(t, R_MOD)) {
+    sub_nored(out, t, R_MOD);
+  } else {
+    memcpy(out, t, 32);
+  }
+}
+
+static inline void fr_sub(u64 out[4], const u64 a[4], const u64 b[4]) {
+  if (geq(a, b)) {
+    sub_nored(out, a, b);
+  } else {
+    u64 t[4];
+    sub_nored(t, b, a);
+    sub_nored(out, R_MOD, t);
+  }
+}
+
+// CIOS Montgomery multiplication over r (mirror of mont_mul over p).
+static void fr_mul(u64 out[4], const u64 a[4], const u64 b[4]) {
+  u64 t[6] = {0, 0, 0, 0, 0, 0};
+  for (int i = 0; i < 4; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 s = (u128)t[j] + (u128)a[i] * b[j] + carry;
+      t[j] = (u64)s;
+      carry = s >> 64;
+    }
+    u128 s = (u128)t[4] + carry;
+    t[4] = (u64)s;
+    t[5] = (u64)(s >> 64);
+
+    u64 m = t[0] * RINV;
+    carry = ((u128)t[0] + (u128)m * R_MOD[0]) >> 64;
+    for (int j = 1; j < 4; ++j) {
+      u128 s2 = (u128)t[j] + (u128)m * R_MOD[j] + carry;
+      t[j - 1] = (u64)s2;
+      carry = s2 >> 64;
+    }
+    u128 s3 = (u128)t[4] + carry;
+    t[3] = (u64)s3;
+    t[4] = t[5] + (u64)(s3 >> 64);
+  }
+  if (t[4] || geq(t, R_MOD)) {
+    sub_nored(out, t, R_MOD);
+  } else {
+    memcpy(out, t, 32);
+  }
+}
+
+// Montgomery exponentiation a^e over r (big-endian bit scan of e).
+static void fr_pow(u64 out[4], const u64 a[4], const u64 e[4]) {
+  u64 acc[4];
+  memcpy(acc, ONE_R, 32);
+  for (int i = 255; i >= 0; --i) {
+    fr_mul(acc, acc, acc);
+    if ((e[i / 64] >> (i % 64)) & 1) fr_mul(acc, acc, a);
+  }
+  memcpy(out, acc, 32);
+}
+
+static void fr_inv_mont(u64 out[4], const u64 a[4]) {
+  u64 e[4];
+  u64 two[4] = {2, 0, 0, 0};
+  sub_nored(e, R_MOD, two);
+  fr_pow(out, a, e);
+}
+
+extern "C" {
+
+// Batch std <-> Montgomery over r.
+void fr_to_mont_batch(const u64 *in, u64 *out, long n) {
+  for (long i = 0; i < n; ++i) fr_mul(out + 4 * i, in + 4 * i, R2R);
+}
+void fr_from_mont_batch(const u64 *in, u64 *out, long n) {
+  static const u64 ONE_STD[4] = {1, 0, 0, 0};
+  for (long i = 0; i < n; ++i) fr_mul(out + 4 * i, in + 4 * i, ONE_STD);
+}
+// Pointwise Montgomery product (c_ev = a_ev . b_ev).
+void fr_mul_batch(const u64 *a, const u64 *b, u64 *out, long n) {
+  for (long i = 0; i < n; ++i) fr_mul(out + 4 * i, a + 4 * i, b + 4 * i);
+}
+// Self-test hook: c = a*b mod r, standard form in/out.
+void fr_mul_std(const u64 *a, const u64 *b, u64 *c) {
+  u64 am[4], bm[4], cm[4];
+  static const u64 ONE_STD[4] = {1, 0, 0, 0};
+  fr_mul(am, a, R2R);
+  fr_mul(bm, b, R2R);
+  fr_mul(cm, am, bm);
+  fr_mul(c, cm, ONE_STD);
+}
+
+// Sparse QAP matvec: out[row[i]] += coeff[i] * w[wire[i]] (all Montgomery).
+void fr_matvec(const u64 *coeff, const unsigned *wire, const unsigned *row,
+               long nnz, const u64 *w, long m, u64 *out) {
+  memset(out, 0, (size_t)m * 32);
+  u64 t[4];
+  for (long i = 0; i < nnz; ++i) {
+    fr_mul(t, coeff + 4 * i, w + 4 * (long)wire[i]);
+    u64 *o = out + 4 * (long)row[i];
+    fr_add(o, o, t);
+  }
+}
+
+// In-place radix-2 NTT over Fr, natural order in/out, data Montgomery.
+// root_std: standard-form primitive m-th root (forward: w, inverse:
+// w^-1); scale_std: standard-form factor applied to every output (1 for
+// forward, m^-1 for inverse).  Twiddles are a precomputed m/2 table so
+// each butterfly costs one fr_mul.
+void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
+  int log_m = 0;
+  while ((1L << log_m) < m) ++log_m;
+  // bit-reversal permutation (32-byte element swaps)
+  for (long i = 1, j = 0; i < m; ++i) {
+    long bit = m >> 1;
+    for (; j & bit; bit >>= 1) j ^= bit;
+    j ^= bit;
+    if (i < j) {
+      u64 tmp[4];
+      memcpy(tmp, data + 4 * i, 32);
+      memcpy(data + 4 * i, data + 4 * j, 32);
+      memcpy(data + 4 * j, tmp, 32);
+    }
+  }
+  u64 root_m[4];
+  fr_mul(root_m, root_std, R2R);
+  long half_m = m / 2;
+  u64 *tw = new u64[(size_t)(half_m > 0 ? half_m : 1) * 4];
+  memcpy(tw, ONE_R, 32);
+  for (long j = 1; j < half_m; ++j) fr_mul(tw + 4 * j, tw + 4 * (j - 1), root_m);
+  for (long len = 2; len <= m; len <<= 1) {
+    long half = len >> 1;
+    long stride = m / len;
+    for (long i0 = 0; i0 < m; i0 += len) {
+      for (long j = 0; j < half; ++j) {
+        u64 *u = data + 4 * (i0 + j);
+        u64 *v = data + 4 * (i0 + j + half);
+        u64 t[4];
+        fr_mul(t, v, tw + 4 * (j * stride));
+        u64 usave[4];
+        memcpy(usave, u, 32);
+        fr_add(u, usave, t);
+        fr_sub(v, usave, t);
+      }
+    }
+  }
+  delete[] tw;
+  static const u64 ONE_STD[4] = {1, 0, 0, 0};
+  if (memcmp(scale_std, ONE_STD, 32) != 0) {
+    u64 scale_m[4];
+    fr_mul(scale_m, scale_std, R2R);
+    for (long i = 0; i < m; ++i) fr_mul(data + 4 * i, data + 4 * i, scale_m);
+  }
+}
+
+// The H-polynomial coset ladder (prove_tpu's h_evals, native):
+// a/b/c are the domain evaluations (Montgomery, length m, clobbered);
+// out_d[j] = (A.B - C)(g . w^j) Montgomery.  w_std is the primitive
+// m-th root matching field.bn254.fr_domain_root(log_m); g_std the coset
+// generator (snarkjs convention: w_{2m}).  Inverses computed here.
+void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
+                 const u64 *g_std, u64 *out_d) {
+  // winv, minv (standard form): invert in Montgomery then strip.
+  u64 wm[4], wim[4], winv_std[4], minv_std[4];
+  static const u64 ONE_STD[4] = {1, 0, 0, 0};
+  fr_mul(wm, w_std, R2R);
+  fr_inv_mont(wim, wm);
+  fr_mul(winv_std, wim, ONE_STD);
+  u64 m_std[4] = {(u64)m, 0, 0, 0};
+  u64 mm[4], mim[4];
+  fr_mul(mm, m_std, R2R);
+  fr_inv_mont(mim, mm);
+  fr_mul(minv_std, mim, ONE_STD);
+  u64 gm[4];
+  fr_mul(gm, g_std, R2R);
+  u64 *vecs[3] = {a, b, c};
+  for (int k = 0; k < 3; ++k) {
+    u64 *v = vecs[k];
+    fr_ntt(v, m, winv_std, minv_std);  // iNTT: evals -> coefficients
+    // coset shift: coeff[j] *= g^j (running power)
+    u64 p[4];
+    memcpy(p, ONE_R, 32);
+    for (long j = 1; j < m; ++j) {
+      fr_mul(p, p, gm);
+      fr_mul(v + 4 * j, v + 4 * j, p);
+    }
+    fr_ntt(v, m, w_std, ONE_STD);  // forward: coefficients -> coset evals
+  }
+  for (long j = 0; j < m; ++j) {
+    u64 t[4];
+    fr_mul(t, a + 4 * j, b + 4 * j);
+    fr_sub(out_d + 4 * j, t, c + 4 * j);
+  }
+}
+
+}  // extern "C"
+
+// ------------------------------------------------- Pippenger MSM (G1/G2)
+
+// Full Jacobian + Jacobian add over G1 (mirror of g2_add).
+static void g1_add_jac(G1Jac &acc, const G1Jac &e) {
+  if (is_zero4(e.Z)) return;
+  if (is_zero4(acc.Z)) {
+    acc = e;
+    return;
+  }
+  u64 Z1Z1[4], Z2Z2[4], U1[4], U2[4], S1[4], S2[4], H[4], Rr[4], t[4];
+  mont_sqr(Z1Z1, acc.Z);
+  mont_sqr(Z2Z2, e.Z);
+  mont_mul(U1, acc.X, Z2Z2);
+  mont_mul(U2, e.X, Z1Z1);
+  mont_mul(t, acc.Y, e.Z);
+  mont_mul(S1, t, Z2Z2);
+  mont_mul(t, e.Y, acc.Z);
+  mont_mul(S2, t, Z1Z1);
+  sub_mod(H, U2, U1);
+  sub_mod(Rr, S2, S1);
+  if (is_zero4(H)) {
+    if (is_zero4(Rr)) {
+      G1Jac d;
+      jac_double(d, acc);
+      acc = d;
+      return;
+    }
+    memset(&acc, 0, sizeof(acc));
+    return;
+  }
+  u64 HH[4], HHH[4], V[4], x3[4], y3[4], z3[4], t2[4], v2[4];
+  mont_sqr(HH, H);
+  mont_mul(HHH, H, HH);
+  mont_mul(V, U1, HH);
+  mont_sqr(t, Rr);
+  sub_mod(t, t, HHH);
+  add_mod(v2, V, V);
+  sub_mod(x3, t, v2);
+  sub_mod(t, V, x3);
+  mont_mul(t, Rr, t);
+  mont_mul(t2, S1, HHH);
+  sub_mod(y3, t, t2);
+  mont_mul(t, acc.Z, e.Z);
+  mont_mul(z3, t, H);
+  memcpy(acc.X, x3, 32);
+  memcpy(acc.Y, y3, 32);
+  memcpy(acc.Z, z3, 32);
+}
+
+// c-bit digit of a 256-bit scalar starting at `bit`.
+static inline unsigned digit_at(const u64 s[4], int bit, int c) {
+  int limb = bit >> 6, off = bit & 63;
+  u64 v = s[limb] >> off;
+  if (off + c > 64 && limb < 3) v |= s[limb + 1] << (64 - off);
+  return (unsigned)(v & ((1ULL << c) - 1));
+}
+
+extern "C" {
+
+// Variable-base Pippenger MSM over G1.  bases: n x 8 u64 affine
+// Montgomery ((0,0) = infinity); scalars: n x 4 u64 STANDARD form
+// (< r); out_xy: 8 u64 affine STANDARD form, (0,0) = infinity.
+// Window width c is caller-chosen (glue picks ~log2(n)-7, clamped).
+void g1_msm_pippenger(const u64 *bases_xy, const u64 *scalars, long n,
+                      int c, u64 *out_xy) {
+  int nwin = (254 + c - 1) / c;
+  long nbuckets = 1L << c;
+  G1Jac *buckets = new G1Jac[nbuckets];
+  G1Jac acc;
+  memset(&acc, 0, sizeof(acc));
+  for (int wi = nwin - 1; wi >= 0; --wi) {
+    if (wi != nwin - 1)
+      for (int k = 0; k < c; ++k) jac_double(acc, acc);
+    memset(buckets, 0, (size_t)nbuckets * sizeof(G1Jac));
+    for (long i = 0; i < n; ++i) {
+      unsigned d = digit_at(scalars + 4 * i, wi * c, c);
+      if (!d) continue;
+      const u64 *x = bases_xy + 8 * i;
+      const u64 *y = x + 4;
+      if (is_zero4(x) && is_zero4(y)) continue;
+      jac_add_mixed(buckets[d], buckets[d], x, y);
+    }
+    // bucket reduction: sum_d d * bucket[d] via running suffix sums
+    G1Jac run, wsum;
+    memset(&run, 0, sizeof(run));
+    memset(&wsum, 0, sizeof(wsum));
+    for (long d = nbuckets - 1; d >= 1; --d) {
+      g1_add_jac(run, buckets[d]);
+      g1_add_jac(wsum, run);
+    }
+    g1_add_jac(acc, wsum);
+  }
+  delete[] buckets;
+  if (is_zero4(acc.Z)) {
+    memset(out_xy, 0, 64);
+    return;
+  }
+  u64 zi[4], zi2[4], zi3[4], mx[4], my[4];
+  mont_inv(zi, acc.Z);
+  mont_sqr(zi2, zi);
+  mont_mul(zi3, zi2, zi);
+  mont_mul(mx, acc.X, zi2);
+  mont_mul(my, acc.Y, zi3);
+  fp_from_mont(mx, out_xy, 1);
+  fp_from_mont(my, out_xy + 4, 1);
+}
+
+// Variable-base Pippenger MSM over G2.  bases: n x 16 u64 affine
+// Montgomery (x.c0, x.c1, y.c0, y.c1; all-zero = infinity); scalars
+// standard form; out: 16 u64 affine STANDARD form, all-zero = infinity.
+void g2_msm_pippenger(const u64 *bases, const u64 *scalars, long n,
+                      int c, u64 *out) {
+  int nwin = (254 + c - 1) / c;
+  long nbuckets = 1L << c;
+  G2Jac *buckets = new G2Jac[nbuckets];
+  G2Jac acc;
+  memset(&acc, 0, sizeof(acc));
+  for (int wi = nwin - 1; wi >= 0; --wi) {
+    if (wi != nwin - 1)
+      for (int k = 0; k < c; ++k) {
+        G2Jac d2;
+        g2_double(d2, acc);
+        acc = d2;
+      }
+    memset(buckets, 0, (size_t)nbuckets * sizeof(G2Jac));
+    for (long i = 0; i < n; ++i) {
+      unsigned d = digit_at(scalars + 4 * i, wi * c, c);
+      if (!d) continue;
+      const u64 *b = bases + 16 * i;
+      Fp2 x2, y2;
+      memcpy(x2.c0, b, 32);
+      memcpy(x2.c1, b + 4, 32);
+      memcpy(y2.c0, b + 8, 32);
+      memcpy(y2.c1, b + 12, 32);
+      if (fp2_is_zero(x2) && fp2_is_zero(y2)) continue;
+      g2_add_mixed(buckets[d], buckets[d], x2, y2);
+    }
+    G2Jac run, wsum;
+    memset(&run, 0, sizeof(run));
+    memset(&wsum, 0, sizeof(wsum));
+    for (long d = nbuckets - 1; d >= 1; --d) {
+      g2_add(run, buckets[d]);
+      g2_add(wsum, run);
+    }
+    g2_add(acc, wsum);
+  }
+  delete[] buckets;
+  if (fp2_is_zero(acc.Z)) {
+    memset(out, 0, 128);
+    return;
+  }
+  Fp2 zi, zi2, zi3, mx, my;
+  fp2_inv(zi, acc.Z);
+  fp2_sqr(zi2, zi);
+  fp2_mul(zi3, zi2, zi);
+  fp2_mul(mx, acc.X, zi2);
+  fp2_mul(my, acc.Y, zi3);
+  fp_from_mont(mx.c0, out, 1);
+  fp_from_mont(mx.c1, out + 4, 1);
+  fp_from_mont(my.c0, out + 8, 1);
+  fp_from_mont(my.c1, out + 12, 1);
 }
 
 }  // extern "C"

@@ -20,6 +20,9 @@ if "xla_force_host_platform_device_count" not in flags:
 # Persistent compilation cache: the limb-arithmetic graphs are wide (a point
 # add is ~10 packed field muls) and XLA:CPU takes seconds to compile them;
 # cache so each distinct graph compiles once per checkout, not once per run.
+# The directory is keyed by a host-CPU fingerprint (utils.jaxcfg) so entries
+# AOT-compiled on a different driver box are invisible instead of producing
+# machine-feature-mismatch load failures.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
@@ -45,7 +48,7 @@ def pytest_collection_modifyitems(config, items):
 # tunnel is down.  Re-assert CPU through the config API, which wins.
 import jax  # noqa: E402
 
+from zkp2p_tpu.utils.jaxcfg import enable_cache  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+enable_cache()

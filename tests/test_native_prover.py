@@ -1,0 +1,127 @@
+"""Differential tests for the native C++ Groth16 prover runtime
+(csrc/zkp2p_native.cpp Fr/NTT/Pippenger section) against the host
+oracles — the same pin-the-proof discipline the reference applies to its
+prover output (test/ramp.test.js pins a known-good proof vector).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from zkp2p_tpu.field.bn254 import R, fr_domain_root
+from zkp2p_tpu.native.lib import _scalars_to_u64, get_lib
+
+pytestmark = pytest.mark.skipif(get_lib() is None, reason="native library unavailable")
+
+rng = random.Random(4242)
+
+
+def _np_from_ints(vals):
+    return np.ascontiguousarray(_scalars_to_u64([v % R for v in vals]))
+
+
+def _ints_from_np(a):
+    return [int.from_bytes(a[i].tobytes(), "little") for i in range(a.shape[0])]
+
+
+def test_fr_mul_std_matches_python():
+    from zkp2p_tpu.prover.native_prove import _lib, _p
+
+    lib = _lib()
+    for _ in range(8):
+        a, b = rng.randrange(R), rng.randrange(R)
+        av, bv = _np_from_ints([a]).copy(), _np_from_ints([b]).copy()
+        cv = np.zeros((1, 4), dtype=np.uint64)
+        lib.fr_mul_std(_p(av), _p(bv), _p(cv))
+        assert _ints_from_np(cv)[0] == a * b % R
+
+
+def test_fr_ntt_matches_host_fft():
+    from zkp2p_tpu.prover.native_prove import _lib, _p
+    from zkp2p_tpu.snark.fft_host import intt as intt_host, ntt as ntt_host
+
+    lib = _lib()
+    log_m, m = 6, 64
+    vals = [rng.randrange(R) for _ in range(m)]
+    w = fr_domain_root(log_m)
+
+    data = np.zeros((m, 4), dtype=np.uint64)
+    lib.fr_to_mont_batch(_p(_np_from_ints(vals)), _p(data), m)
+    one = _np_from_ints([1]).copy()
+    root = _np_from_ints([w]).copy()
+    lib.fr_ntt(_p(data), m, _p(root), _p(one))
+    out = np.zeros_like(data)
+    lib.fr_from_mont_batch(_p(data), _p(out), m)
+    assert _ints_from_np(out) == ntt_host(vals)
+
+    # Round-trip through the inverse transform restores the input.
+    winv = pow(w, R - 2, R)
+    minv = pow(m, R - 2, R)
+    rootiv = _np_from_ints([winv]).copy()
+    scale = _np_from_ints([minv]).copy()
+    lib.fr_ntt(_p(data), m, _p(rootiv), _p(scale))
+    lib.fr_from_mont_batch(_p(data), _p(out), m)
+    assert _ints_from_np(out) == vals
+    assert intt_host(ntt_host(vals)) == vals
+
+
+def test_g1_msm_pippenger_matches_host():
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul, g1_msm
+    from zkp2p_tpu.curve.jcurve import g1_to_affine_arrays
+    from zkp2p_tpu.prover.native_prove import _g1_bases_u64, _lib, _p
+
+    lib = _lib()
+    n = 37
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(n - 2)]
+    pts.insert(3, None)  # infinity hole, as pruned queries contain
+    pts.append(None)
+    scalars = [rng.randrange(R) for _ in range(n - 1)] + [0]
+    b = _g1_bases_u64(g1_to_affine_arrays(pts))
+    sc = _np_from_ints(scalars)
+    for c in (4, 8, 13):
+        out = np.zeros(8, dtype=np.uint64)
+        lib.g1_msm_pippenger(_p(b), _p(sc), n, c, _p(out))
+        x, y = _ints_from_np(out.reshape(2, 4))
+        got = None if x == 0 and y == 0 else (x, y)
+        assert got == g1_msm(pts, scalars), f"window {c}"
+
+
+def test_g2_msm_pippenger_matches_host():
+    from zkp2p_tpu.curve.host import G2_GENERATOR, g2_msm, g2_mul
+    from zkp2p_tpu.curve.jcurve import g2_to_affine_arrays
+    from zkp2p_tpu.prover.native_prove import _g2_bases_u64, _lib, _p
+
+    lib = _lib()
+    n = 9
+    pts = [g2_mul(G2_GENERATOR, rng.randrange(1, R)) for _ in range(n - 1)] + [None]
+    scalars = [rng.randrange(R) for _ in range(n)]
+    b = _g2_bases_u64(g2_to_affine_arrays(pts))
+    sc = _np_from_ints(scalars)
+    out = np.zeros(16, dtype=np.uint64)
+    lib.g2_msm_pippenger(_p(b), _p(sc), n, 8, _p(out))
+    from zkp2p_tpu.field.tower import Fq2
+
+    xc0, xc1, yc0, yc1 = _ints_from_np(out.reshape(4, 4))
+    got = None if xc0 == xc1 == yc0 == yc1 == 0 else (Fq2(xc0, xc1), Fq2(yc0, yc1))
+    assert got == g2_msm(pts, scalars)
+
+
+def test_prove_native_matches_host_oracle():
+    """End-to-end: the native prover emits the exact proof prove_host
+    does for the same (witness, r, s), and it pairing-verifies."""
+    from zkp2p_tpu.models.amount_demo import dryrun_circuit
+    from zkp2p_tpu.prover.groth16_tpu import device_pk
+    from zkp2p_tpu.prover.native_prove import prove_native
+    from zkp2p_tpu.snark.groth16 import prove_host, setup, verify
+
+    cs, pubs, seed = dryrun_circuit()
+    w = cs.witness(pubs, seed)
+    cs.check_witness(w)
+    pk, vk = setup(cs, seed="native-prover-test")
+    dpk = device_pk(pk, cs)
+    r, s = 123456789, 987654321
+    got = prove_native(dpk, w, r=r, s=s)
+    want = prove_host(pk, cs, w, r=r, s=s)
+    assert got == want, "native prove != host oracle proof"
+    assert verify(vk, got, pubs)

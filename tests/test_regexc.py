@@ -23,6 +23,7 @@ CASES = [
     (r"\$[0-9]+\.", ["$30.", "$5", "$.", "$123456.", "x$1."]),
     ("[a-c]?x", ["x", "ax", "cx", "dx", "aax"]),
     (compiler.VENMO_OFFRAMPER_ID, ["user_id=3D12345", "user_id=3D", "user_id=3Dab_9"]),
+    (compiler.VENMO_MESSAGE, ["<p>123", "<p>", "<p>x1", "p>9", "<p>007"]),
 ]
 
 
@@ -74,6 +75,25 @@ def test_dfa_gadget_scan_and_reveal():
         else:
             assert onehot[hs] == 1 and sum(onehot) == 1
     assert w[cnt] == sum(1 for s in host_states if s in dfa.accept)
+
+
+def test_venmo_message_scan():
+    """Legacy `<p>[0-9]+` message regex (venmo_message_regex.circom:8) in
+    substring-search form over an HTML body snippet: the scan counts one
+    match per digit consumed and the reveal mask covers the digits."""
+    dfa = compiler.search_dfa(compiler.VENMO_MESSAGE)
+    data = b"<html><p>4207</p>x"
+    cs = ConstraintSystem("msg")
+    wires = cs.new_wires(len(data), "in")
+    core.assert_bytes(cs, wires)
+    cache = CharClassCache(cs)
+    states = dfa_scan(cs, wires, dfa, cache)
+    cnt = match_count(cs, states, dfa.accept)
+    rev = reveal_bytes(cs, wires, states, sorted(dfa.accept))
+    w = cs.witness([], {wi: b for wi, b in zip(wires, data)})
+    cs.check_witness(w)
+    assert w[cnt] == 4  # accept fires after each of 4, 2, 0, 7
+    assert bytes(w[r] for r in rev).replace(b"\x00", b"") == b"4207"
 
 
 def test_dfa_gadget_venmo_id_reveal():

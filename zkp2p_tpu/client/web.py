@@ -21,7 +21,9 @@ service.
 
 from __future__ import annotations
 
+import hmac
 import json
+import os
 import threading
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -44,13 +46,32 @@ class ProverBundle:
 class OnrampApp:
     """Application state: chain objects + wallet sessions."""
 
-    def __init__(self, ramp: Ramp, usdc: FakeUSDC, prover: Optional[ProverBundle] = None):
+    def __init__(
+        self,
+        ramp: Ramp,
+        usdc: FakeUSDC,
+        prover: Optional[ProverBundle] = None,
+        eml_spool: Optional[str] = None,
+    ):
         self.ramp = ramp
         self.usdc = usdc
         self.prover = prover
+        # Server-side .eml files may only be read from this directory:
+        # /api/onramp taking an arbitrary path would let any client probe
+        # file existence/contents on the host (r3 advisor).
+        self.eml_spool = os.path.realpath(eml_spool) if eml_spool else None
         self.onrampers: Dict[str, OnRamper] = {}
         self.offrampers: Dict[str, OffRamper] = {}
         self.lock = threading.Lock()
+
+    def read_spooled_eml(self, name: str) -> bytes:
+        if self.eml_spool is None:
+            raise PermissionError("no --eml-spool directory configured on this server")
+        path = os.path.realpath(os.path.join(self.eml_spool, name))
+        if os.path.dirname(path) != self.eml_spool:
+            raise PermissionError("eml path escapes the spool directory")
+        with open(path, "rb") as f:
+            return f.read()
 
     # Wallet sessions: the reference derives the ECIES identity from a
     # wallet signature the wallet owner produces (NewOrderForm.tsx:35-64).
@@ -73,7 +94,7 @@ class OnrampApp:
                 existing = OnRamper(address, self.ramp, signature)
                 existing._session_sig = signature
                 self.onrampers[address] = existing
-            elif existing._session_sig != signature:
+            elif not hmac.compare_digest(existing._session_sig, signature):
                 raise PermissionError(f"wrong wallet signature for {address}")
             return existing
 
@@ -251,8 +272,7 @@ def make_handler(app: OnrampApp):
                     from ..inputs.email import email_from_eml, make_test_key, make_venmo_email
 
                     if payload.get("eml_path"):
-                        with open(payload["eml_path"], "rb") as f:
-                            email = email_from_eml(f.read())
+                        email = email_from_eml(app.read_spooled_eml(payload["eml_path"]))
                         modulus = email.modulus
                     else:  # synthetic demo receipt
                         key = make_test_key(1)

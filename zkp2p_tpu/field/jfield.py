@@ -105,6 +105,10 @@ def _conv_onehot(n: int, m: int) -> jnp.ndarray:
 # on hardware; both are bit-exact and differentially tested.
 CONV_LAYOUT = os.environ.get("ZKP2P_FIELD_CONV", "matmul")
 
+# Field-mul implementation selector: "xla" (default, _mul_wide below) or
+# "pallas" (ops.pallas_mont fused kernel — see docs/ROOFLINE.md).
+FIELD_MUL_IMPL = os.environ.get("ZKP2P_FIELD_MUL", "xla")
+
 
 def _mul_wide_limb_major(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook conv with limbs on axis 0 and the flattened batch on
@@ -243,7 +247,17 @@ class JPrimeField:
         return jnp.where(is_zero[..., None], a, self._cond_sub_n(d))
 
     def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-        """Montgomery product: (a*b*R^-1) mod N, R = 2^256 (SOS method)."""
+        """Montgomery product: (a*b*R^-1) mod N, R = 2^256 (SOS method).
+
+        ZKP2P_FIELD_MUL=pallas routes through the fused VMEM kernel
+        (ops.pallas_mont, docs/ROOFLINE.md) — the hardware A/B switch;
+        the XLA path below stays the portable default and oracle."""
+        if FIELD_MUL_IMPL == "pallas":
+            import jax as _jax
+
+            from ..ops.pallas_mont import mont_mul
+
+            return mont_mul(self, a, b, _jax.default_backend() != "tpu")
         t = _mul_wide(a, b)  # (..., 32)
         m = _mul_wide(t[..., :NUM_LIMBS], self.nprime_limbs)[..., :NUM_LIMBS]
         u = _mul_wide(m, self.n_limbs)  # (..., 32)

@@ -203,7 +203,12 @@ def write_zkey(path: str, pk: ProvingKey, vk: VerifyingKey, qap_rows) -> None:
         (8, b"".join(_g1_bytes(p) for p in pk.c_query[pk.n_public + 1 :]))
     )
     sections.append((9, b"".join(_g1_bytes(p) for p in pk.h_query)))
-    sections.append((10, struct.pack("<I", 0)))  # no contributions (dev setup)
+    # Section 10 (MPC params): snarkjs readMPCParams expects a 64-byte
+    # circuit hash BEFORE the u32 contribution count — a bare count makes
+    # `zkey verify`/`contribute` misparse the export (groth16 prove and
+    # vkey export never read this section).  Dev setup: zero hash, zero
+    # contributions.
+    sections.append((10, b"\x00" * 64 + struct.pack("<I", 0)))
 
     with open(path, "wb") as f:
         f.write(ZKEY_MAGIC)

@@ -84,7 +84,6 @@ class VenmoLayout:
 # Shared with models.email_verify — hoisted to models.common so soundness
 # fixes land in one place (see the round-2 bh= divergence).
 _shift_window = common.shift_window
-_bh_value_states = common.bh_value_states
 
 
 def build_venmo_circuit(p: VenmoParams) -> tuple[ConstraintSystem, VenmoLayout]:
@@ -155,6 +154,14 @@ def build_venmo_circuit(p: VenmoParams) -> tuple[ConstraintSystem, VenmoLayout]:
 
     id_onehot = core.one_hot(cs, lay.id_idx, p.max_body_bytes - p.id_len, "vid.idx")
     id_chars = _shift_window(cs, id_reveal, id_onehot, p.id_len, "vid.shift")
+    # The window must anchor on a real revealed char: with an all-zero
+    # reveal mask (no DFA match anywhere in the body) every shift window
+    # is zero and a forged email could claim Poseidon(0..0).  x·x⁻¹ = 1
+    # forces id_chars[0] != 0 — strictly stronger than the reference,
+    # which only console-logs the match count (circuit.circom:168-173).
+    id_inv = cs.new_wire("venmo_id_first_inv")
+    cs.compute(id_inv, lambda v: pow(v, R - 2, R) if v else 0, [id_chars[0]])
+    cs.enforce(LC.of(id_chars[0]), LC.of(id_inv), LC.const(1), "vid/nonzero")
     id_words = core.pack_bytes(cs, id_chars, 7, "vid.pack")
     hashed = poseidon(cs, id_words, "vid.pos")
     cs.enforce_eq(LC.of(hashed), LC.of(lay.hashed_id), "vid/out")
@@ -182,22 +189,6 @@ def build_venmo_circuit(p: VenmoParams) -> tuple[ConstraintSystem, VenmoLayout]:
     cs.compute(lay.claim_sq, lambda v: v * v % R, [lay.claim_id])
 
     return cs, lay
-
-
-def _bh_value_states(dfa) -> List[int]:
-    """States inside the bh= base64 value of the BODY_HASH DFA: exactly
-    those from which ';' then ' ' completes the match.  Only the value
-    component of `...bh=[0-9A-Za-z+/=]+; ` can end the match this way (the
-    inner `[a-z]+=[^;]+; ` tag-value loop continues to more tags, never to
-    accept), so the reveal mask is 1 precisely on the matched b64 chars —
-    verified against a canonical relaxed-canonicalized header in tests."""
-    out = []
-    for s in range(dfa.n_states):
-        z = int(dfa.next[s, ord(";")])
-        if z != -1 and int(dfa.next[z, ord(" ")]) in dfa.accept:
-            out.append(s)
-    assert out, "BODY_HASH DFA has no value states"
-    return out
 
 
 def _amount_reveal_states(dfa) -> List[int]:

@@ -137,11 +137,27 @@ def msm_windowed(curve: JCurve, bases: AffPoint, digit_planes: jnp.ndarray, lane
     partials, _ = jax.lax.scan(accumulate, curve.infinity((n_digits, lanes)), (pts, planes))
 
     def fold_planes(acc, ps):
-        for _ in range(window):
-            acc = curve.double(acc)
+        # window doublings as a nested scan: ONE compiled double graph
+        # instead of `window` inlined copies — for G2 (Fq2 limb towers)
+        # the unrolled form alone pushed XLA:CPU past the driver's dryrun
+        # budget (MULTICHIP_r04 rehearsal: >300 s compiling jit_local).
+        def dbl(a, _):
+            return curve.double(a), None
+
+        acc, _ = jax.lax.scan(dbl, acc, None, length=window)
         return curve.add(acc, ps), None
 
     per_lane, _ = jax.lax.scan(fold_planes, curve.infinity((lanes,)), tuple(c for c in partials))
+
+    # Lane fold: G1 takes the pairwise tree — log2(lanes) halving adds
+    # instead of a `lanes`-step scan (cheaper dispatch on 1-core hosts,
+    # wider batches on TPU).  G2 keeps the single-adder scan: the tree
+    # inlines log2(lanes) copies of the Fq2 add graph and the XLA:CPU
+    # compile time — the driver's dryrun budget — blows up (r4 rehearsal:
+    # the G2 executable alone compiled >400 s with the tree fold, vs
+    # ~180 s total for compile+run with the scan).
+    if curve.F.zero_limbs.ndim == 1:
+        return tree_reduce(curve, per_lane, lanes)
 
     def fold_lanes(acc, p):
         return curve.add(acc, p), None

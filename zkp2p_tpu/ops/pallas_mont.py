@@ -1,0 +1,144 @@
+"""Fused Montgomery multiplication as a Pallas TPU kernel.
+
+docs/ROOFLINE.md: the XLA field-mul path materialises its (B, 512) f32
+partial-product planes in HBM between the outer product and the one-hot
+fold, capping FR.mul at ~14 M muls/s (~1-2% of VPU) — the measured
+ceiling of the whole MSM stack.  This kernel runs the complete SOS
+Montgomery product (3 limb convolutions + carry ladders + conditional
+subtract) inside ONE kernel with every intermediate resident in VMEM.
+
+Layout: limbs live on the SUBLANE axis and the batch on the 128-wide
+LANE axis — (16, T) tiles — so every elementwise op fills the vector
+unit (the batch-major (B, 16) layout uses 16/128 lanes).  The wrapper
+transposes at the boundary; inside, the dataflow is identical
+arithmetic to field.jfield (same 16x16-bit limbs, same Kogge-Stone
+carry ladder), differentially tested against it.
+
+The TPU tunnel is down this round, so correctness is pinned with
+`interpret=True` on CPU (tests/test_pallas_mont.py); the flag
+ZKP2P_FIELD_MUL=pallas arms the kernel inside JPrimeField.mul for A/B
+on hardware the moment a chip is reachable.
+
+Reference analog: rapidsnark's x86-assembly Montgomery mul
+(its fastest-path field layer); this is the TPU-native equivalent.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field.jfield import LIMB_BITS, MASK, NUM_LIMBS, int_to_limbs
+
+TILE = 256  # batch elements per grid step; VMEM high-water ~ (16,16,TILE) u32
+
+
+def _up(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Limb-axis (axis 0) shift up by k, zero-filled."""
+    return jnp.pad(x, ((k, 0), (0, 0)))[: x.shape[0]]
+
+
+def _carry_lm(x: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """Kogge-Stone carry resolution, limbs on axis 0 (mirror of
+    field.jfield._carry_ladder)."""
+    L = x.shape[0]
+    if L < out_limbs:
+        x = jnp.pad(x, ((0, out_limbs - L), (0, 0)))
+    else:
+        x = x[:out_limbs]
+    for _ in range(2):
+        x = (x & MASK) + _up(x >> LIMB_BITS, 1)
+    g = x >> LIMB_BITS
+    r = x & MASK
+    p = (r == MASK).astype(jnp.uint32)
+    k = 1
+    while k < out_limbs:
+        g = g | (p & _up(g, k))
+        p = p & _up(p, k)
+        k *= 2
+    return (r + _up(g, 1)) & MASK
+
+
+def _mul_wide_lm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(La, T) x (Lb, T or 1) -> (La+Lb, T) canonical limbs; schoolbook
+    accumulation is exact in u32 (sums of < 2*16 values < 2^16)."""
+    La = a.shape[0]
+    Lb = b.shape[0]
+    out_len = La + Lb + 1
+    width = max(a.shape[1], b.shape[1])
+    acc = jnp.zeros((out_len, width), dtype=jnp.uint32)
+    for i in range(La):
+        p = a[i][None, :] * b  # (Lb, T)
+        acc = acc + jnp.pad(p & MASK, ((i, out_len - Lb - i), (0, 0)))
+        acc = acc + jnp.pad(p >> LIMB_BITS, ((i + 1, out_len - Lb - i - 1), (0, 0)))
+    return _carry_lm(acc, La + Lb)
+
+
+def _sub_raw_lm(a: jnp.ndarray, b: jnp.ndarray):
+    """(a - b) mod 2^(16*L) + borrow flag, limb-major."""
+    L = a.shape[0]
+    x = a + (MASK - b)
+    x = x.at[0].add(1)
+    y = _carry_lm(x, L + 1)
+    borrow = 1 - y[L]
+    return y[:L], borrow
+
+
+def _mont_mul_math(a, b, n_lm, np_lm):
+    """The full Montgomery product, limb-major: shared by the Pallas
+    kernel body and the interpret-mode tests."""
+    t = _mul_wide_lm(a, b)  # (32, T)
+    m = _mul_wide_lm(t[:NUM_LIMBS], np_lm)[:NUM_LIMBS]
+    u = _mul_wide_lm(m, n_lm)  # (32, T)
+    s = _carry_lm(t + u, 2 * NUM_LIMBS + 1)
+    hi = s[NUM_LIMBS : 2 * NUM_LIMBS + 1]
+    red = _carry_lm(hi, NUM_LIMBS + 1)[:NUM_LIMBS]
+    d, borrow = _sub_raw_lm(red, n_lm)
+    return jnp.where(borrow[None, :] != 0, red, d)
+
+
+def _kernel(a_ref, b_ref, n_ref, np_ref, out_ref):
+    out_ref[:] = _mont_mul_math(a_ref[:], b_ref[:], n_ref[:], np_ref[:])
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def mont_mul(field, a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Montgomery product (a*b*R^-1 mod N) via the fused kernel.
+
+    a, b: (..., 16) uint32 Montgomery limbs (broadcastable batch dims).
+    field: a JPrimeField (supplies modulus / N' limb constants).
+    interpret=True runs the Pallas interpreter (CPU differential tests).
+    """
+    from jax.experimental import pallas as pl
+
+    n_lm = jnp.asarray(np.asarray(int_to_limbs(field.modulus))[:, None])
+    np_lm = jnp.asarray(np.asarray(int_to_limbs(field.nprime_int))[:, None])
+
+    bshape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, bshape + (NUM_LIMBS,))
+    b = jnp.broadcast_to(b, bshape + (NUM_LIMBS,))
+    B = int(np.prod(bshape)) if bshape else 1
+    pad = (-B) % TILE
+    a_lm = jnp.moveaxis(a.reshape(B, NUM_LIMBS), -1, 0)
+    b_lm = jnp.moveaxis(b.reshape(B, NUM_LIMBS), -1, 0)
+    if pad:
+        a_lm = jnp.pad(a_lm, ((0, 0), (0, pad)))
+        b_lm = jnp.pad(b_lm, ((0, 0), (0, pad)))
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=((B + pad) // TILE,),
+        in_specs=[
+            pl.BlockSpec((NUM_LIMBS, TILE), lambda i: (0, i)),
+            pl.BlockSpec((NUM_LIMBS, TILE), lambda i: (0, i)),
+            pl.BlockSpec((NUM_LIMBS, 1), lambda i: (0, 0)),
+            pl.BlockSpec((NUM_LIMBS, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((NUM_LIMBS, TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((NUM_LIMBS, B + pad), jnp.uint32),
+        interpret=interpret,
+    )(a_lm, b_lm, n_lm, np_lm)
+    return jnp.moveaxis(out[:, :B], 0, -1).reshape(bshape + (NUM_LIMBS,))

@@ -201,10 +201,25 @@ def _witness_for(args, cs, meta, source=None):
         return cs.witness([], {w: b for w, b in zip(lay, padded)}), []
 
 
+def _prover_fn(args):
+    """--prover tpu (default, XLA device path) | native (C++ Pippenger
+    runtime, prover.native_prove) — the snarkjs-vs-rapidsnark split of
+    the reference's scripts (5_gen_proof.sh / 6_gen_proof_rapidsnark.sh),
+    selected by flag over the same zkey + witness."""
+    if getattr(args, "prover", "tpu") == "native":
+        from ..prover.native_prove import prove_native
+
+        return prove_native
+    from ..prover.groth16_tpu import prove_tpu
+
+    return prove_tpu
+
+
 def cmd_prove(args):
     from ..formats.proof_json import dump, proof_to_json, public_to_json
-    from ..prover.groth16_tpu import device_pk_from_zkey, prove_tpu
+    from ..prover.groth16_tpu import device_pk_from_zkey
 
+    prove_fn = _prover_fn(args)
     if getattr(args, "wtns", None):
         # Drop-in rapidsnark/snarkjs parity (`6_gen_proof_rapidsnark.sh:24-31`):
         # externally generated witness.wtns + zkey in, proof out — no
@@ -218,7 +233,7 @@ def cmd_prove(args):
         dpk = device_pk_from_zkey(zk)
         pub = w[1 : zk.n_public + 1]
         t0 = time.time()
-        proof = prove_tpu(dpk, w)
+        proof = prove_fn(dpk, w)
         _log(f"proved in {time.time()-t0:.1f}s (incl. first-call compile)")
         dump(proof_to_json(proof), args.proof)
         dump(public_to_json(pub), args.public)
@@ -231,7 +246,7 @@ def cmd_prove(args):
     dpk = device_pk_from_zkey(zk)
     w, pub = _witness_for(args, cs, meta)
     t0 = time.time()
-    proof = prove_tpu(dpk, w)
+    proof = prove_fn(dpk, w)
     _log(f"proved in {time.time()-t0:.1f}s (incl. first-call compile)")
     dump(proof_to_json(proof), args.proof)
     dump(public_to_json(pub or w[1 : cs.num_public + 1]), args.public)
@@ -336,7 +351,19 @@ def cmd_serve(args):
 
     vk = vkey_from_json(load(os.path.join(args.build_dir, "verification_key.json")))
     usdc = FakeUSDC()
-    ramp = Ramp(VENMO_RSA_KEY_LIMBS, usdc, max_amount=args.max_amount, vk=vk)
+    # --demo deploys the escrow with the synthetic test key's modulus limbs:
+    # the UI's synthetic /api/onramp path proves against make_test_key(1), so
+    # a Ramp holding the production Venmo limbs would reject every demo proof
+    # with 'RSA modulus not matched' (r3 advisor).  Without --demo the served
+    # form only offers the server-side .eml path.
+    if args.demo:
+        from ..gadgets.bigint import int_to_limbs_host
+        from ..inputs.email import make_test_key
+
+        key_limbs = int_to_limbs_host(make_test_key(1).n, 121, 17)
+    else:
+        key_limbs = VENMO_RSA_KEY_LIMBS
+    ramp = Ramp(key_limbs, usdc, max_amount=args.max_amount, vk=vk)
     prover = None
     if args.with_prover:
         from ..prover.groth16_tpu import device_pk_from_zkey
@@ -348,7 +375,7 @@ def cmd_serve(args):
         _check_zkey_matches(zk, cs)
         prover = ProverBundle(cs=cs, dpk=device_pk_from_zkey(zk), params=meta[0], layout=meta[1])
         _log("prover bundle loaded")
-    app = OnrampApp(ramp, usdc, prover)
+    app = OnrampApp(ramp, usdc, prover, eml_spool=args.eml_spool)
     srv = serve(app, port=args.port)
     _log(f"serving on http://127.0.0.1:{srv.server_address[1]} (ctrl-c to stop)")
     try:
@@ -379,6 +406,8 @@ def main(argv=None):
     s.add_argument("--zkey", help="zkey path or chunk glob (default: BUILD_DIR/circuit_final.zkey)")
     s.add_argument("--zkey-store", help="artifact-store dir to pull the chunked zkey from")
     s.add_argument("--wtns", help="externally generated witness.wtns (drop-in prover parity)")
+    s.add_argument("--prover", choices=["tpu", "native"], default="tpu",
+                   help="tpu: XLA device path; native: C++ Pippenger runtime")
     s.add_argument("--order-id", type=int, default=1)
     s.add_argument("--claim-id", type=int, default=0)
     s.add_argument("--proof", default="proof.json")
@@ -403,6 +432,8 @@ def main(argv=None):
     s.add_argument("--max-amount", type=int, default=10_000_000)
     s.add_argument("--with-prover", action="store_true", help="load the zkey so /api/onramp proves")
     s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.add_argument("--demo", action="store_true", help="deploy the escrow with the synthetic test-key limbs")
+    s.add_argument("--eml-spool", help="directory server-side .eml paths are restricted to")
     s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")

@@ -208,6 +208,16 @@ def _matvec(coeff, wire, row, w_mont, m):
     return lazy_segment_sum_mod(FR, vals, row, m)
 
 
+def abc_evals(dpk: DeviceProvingKey, w_mont: jnp.ndarray):
+    """Az/Bz/Cz evaluations on the domain: the sparse-matvec stage shared
+    by the single-chip and sharded H ladders (and vmapped over the batch
+    axis by the dryrun's data-parallel step)."""
+    m = 1 << dpk.log_m
+    a_ev = _matvec(dpk.a_coeff, dpk.a_wire, dpk.a_row, w_mont, m)
+    b_ev = _matvec(dpk.b_coeff, dpk.b_wire, dpk.b_row, w_mont, m)
+    return a_ev, b_ev, FR.mul(a_ev, b_ev)
+
+
 def h_evals(dpk: DeviceProvingKey, w_mont: jnp.ndarray) -> jnp.ndarray:
     """Coset evaluations d_j = (A·B - C)(g·w^j) on device, (m, 16) mont
     limbs — the scalars MSM'd against the coset-Lagrange h_bases.
@@ -216,11 +226,8 @@ def h_evals(dpk: DeviceProvingKey, w_mont: jnp.ndarray) -> jnp.ndarray:
     (the snarkjs `groth16 prove` dataflow: 3 iNTT + 3 coset NTT, no
     division — Z is constant on the coset and folded into h_bases), every
     step batched on limb lanes."""
-    m = 1 << dpk.log_m
     g = coset_gen(dpk.log_m)
-    a_ev = _matvec(dpk.a_coeff, dpk.a_wire, dpk.a_row, w_mont, m)
-    b_ev = _matvec(dpk.b_coeff, dpk.b_wire, dpk.b_row, w_mont, m)
-    c_ev = FR.mul(a_ev, b_ev)
+    a_ev, b_ev, c_ev = abc_evals(dpk, w_mont)
     a_cos = ntt(coset_shift(intt(a_ev, dpk.log_m), g, dpk.log_m), dpk.log_m)
     b_cos = ntt(coset_shift(intt(b_ev, dpk.log_m), g, dpk.log_m), dpk.log_m)
     c_cos = ntt(coset_shift(intt(c_ev, dpk.log_m), g, dpk.log_m), dpk.log_m)
@@ -323,11 +330,8 @@ def h_evals_sharded(dpk: DeviceProvingKey, w_mont: jnp.ndarray, mesh, axis: str 
 
     from ..parallel.ntt import ntt_sharded
 
-    m = 1 << dpk.log_m
     g = coset_gen(dpk.log_m)
-    a_ev = _matvec(dpk.a_coeff, dpk.a_wire, dpk.a_row, w_mont, m)
-    b_ev = _matvec(dpk.b_coeff, dpk.b_wire, dpk.b_row, w_mont, m)
-    c_ev = FR.mul(a_ev, b_ev)
+    a_ev, b_ev, c_ev = abc_evals(dpk, w_mont)
     shard = NamedSharding(mesh, P(axis, None))
 
     def ladder(v):
@@ -348,37 +352,70 @@ def prove_tpu_sharded(
     s: Optional[int] = None,
     axis: str = "shard",
     lanes: int = 64,
+    unified: bool = False,
+    progress=None,
 ) -> Proof:
     """`prove_tpu` with the MSM base axis AND the NTT domain sharded over
     `mesh` — the same dataflow a v5e slice runs, exercised by the driver's
     `dryrun_multichip` on virtual CPU devices.  Emits the exact proof
-    `prove_host`/`prove_tpu` produce for the same (witness, r, s)."""
+    `prove_host`/`prove_tpu` produce for the same (witness, r, s).
+
+    unified=True pads every G1 MSM (a/b1/c/h) to one common base count so
+    all four share a single compiled executable — the dryrun/cold-start
+    configuration, where XLA compile time on the driver host dwarfs the
+    masked-lane runtime waste.  Production keeps per-shape sizing.
+    progress, when given, is called with a short string after each
+    device stage (the dryrun's per-stage timestamps)."""
     from ..parallel.mesh import msm_sharded, pad_to_multiple
 
     if r is None:
         r = 1 + secrets.randbelow(R - 1)
     if s is None:
         s = 1 + secrets.randbelow(R - 1)
+
+    def note(arr, msg: str) -> None:
+        # Sync + report only when a progress callback asked for stage
+        # boundaries (the dryrun); production dispatch stays fully async.
+        if progress is not None:
+            arr.block_until_ready()
+            progress(msg)
+
     n_dev = mesh.shape[axis]
     w_mont = witness_to_device(witness)
     h = h_evals_sharded(dpk, w_mont, mesh, axis)
+    note(h, "h_evals_sharded")
     w_planes = digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW)
     h_planes = digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW)
 
-    def msm(curve, bases, planes):
+    base_chunk = n_dev * lanes
+    g1_chunk = base_chunk
+    if unified:
+        n_max = max(
+            dpk.a_bases[0].shape[0], dpk.b1_bases[0].shape[0],
+            dpk.c_bases[0].shape[0], dpk.h_bases[0].shape[0],
+        )
+        g1_chunk = ((n_max + base_chunk - 1) // base_chunk) * base_chunk
+
+    def msm(curve, bases, planes, tag):
         # Per-MSM padding: the b/c queries are pruned to their
         # non-infinity lanes, so each MSM runs at its own (smaller) size
         # rather than a unified shape (runtime beats executable reuse on
-        # the production path).
-        b, p = pad_to_multiple(bases, planes, n_dev * lanes)
-        return msm_sharded(curve, b, p, mesh, axis=axis, lanes=lanes, window=MSM_WINDOW)
+        # the production path); unified=True pads the four G1 MSMs to one
+        # shared shape.  G2 compiles its own executable either way (other
+        # curve type), so it always keeps its minimal padded size — its
+        # per-point cost is ~3x G1's.
+        chunk = g1_chunk if curve is G1J else base_chunk
+        b, p = pad_to_multiple(bases, planes, chunk)
+        acc = msm_sharded(curve, b, p, mesh, axis=axis, lanes=lanes, window=MSM_WINDOW)
+        note(acc[0], f"msm {tag} ({b[0].shape[0]} bases)")
+        return acc
 
     b_planes = jnp.take(w_planes, dpk.b_sel, axis=-1)
-    a_acc = msm(G1J, dpk.a_bases, w_planes)
-    b1_acc = msm(G1J, dpk.b1_bases, b_planes)
-    b2_acc = msm(G2J, dpk.b2_bases, b_planes)
-    c_acc = msm(G1J, dpk.c_bases, jnp.take(w_planes, dpk.c_sel, axis=-1))
-    h_acc = msm(G1J, dpk.h_bases, h_planes)
+    a_acc = msm(G1J, dpk.a_bases, w_planes, "a")
+    b1_acc = msm(G1J, dpk.b1_bases, b_planes, "b1")
+    b2_acc = msm(G2J, dpk.b2_bases, b_planes, "b2")
+    c_acc = msm(G1J, dpk.c_bases, jnp.take(w_planes, dpk.c_sel, axis=-1), "c")
+    h_acc = msm(G1J, dpk.h_bases, h_planes, "h")
     a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (a_acc, b1_acc, c_acc, h_acc))
     b2 = g2_jac_to_host(b2_acc)[0]
     return _assemble(dpk, (a, b1, b2, c, hq), r, s)

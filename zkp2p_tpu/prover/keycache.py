@@ -18,6 +18,15 @@ from ..field.tower import Fq2
 from ..snark.groth16 import VerifyingKey
 from .groth16_tpu import _DPK_ARRAY_FIELDS, DeviceProvingKey
 
+# Bump whenever _DPK_ARRAY_FIELDS / the npz layout changes: a cache written
+# by an older schema must fail fast here (triggering re-setup upstream),
+# not materialize empty arrays that crash deep inside jit (r3 advisor).
+SCHEMA_VERSION = 2
+
+
+class KeyCacheSchemaError(RuntimeError):
+    """Cache file does not match the current DeviceProvingKey schema."""
+
 
 def _g1_arr(pt: G1Point) -> np.ndarray:
     if pt is None:
@@ -61,6 +70,7 @@ def save_dpk(path: str, dpk: DeviceProvingKey, vk: VerifyingKey) -> None:
         else:
             data[f] = np.asarray(v)
     data["meta"] = np.array([dpk.n_public, dpk.n_wires, dpk.log_m], dtype=np.int64)
+    data["schema_version"] = np.array([SCHEMA_VERSION], dtype=np.int64)
     for name in ("alpha_1", "beta_1", "delta_1"):
         data[name] = _g1_arr(getattr(dpk, name))
     for name in ("beta_2", "delta_2"):
@@ -72,6 +82,11 @@ def save_dpk(path: str, dpk: DeviceProvingKey, vk: VerifyingKey) -> None:
 
 def load_dpk(path: str) -> Tuple[DeviceProvingKey, VerifyingKey]:
     z = np.load(path)
+    found = int(z["schema_version"][0]) if "schema_version" in z else 0
+    if found != SCHEMA_VERSION:
+        raise KeyCacheSchemaError(
+            f"{path}: key cache schema {found} != current {SCHEMA_VERSION}; re-run setup"
+        )
     arrays = {}
     for f in _DPK_ARRAY_FIELDS:
         if f in z:
@@ -82,6 +97,8 @@ def load_dpk(path: str) -> Tuple[DeviceProvingKey, VerifyingKey]:
             while f"{f}.{i}" in z:
                 parts.append(jnp.asarray(z[f"{f}.{i}"]))
                 i += 1
+            if not parts:
+                raise KeyCacheSchemaError(f"{path}: missing field {f!r}; re-run setup")
             arrays[f] = tuple(parts)
     n_public, n_wires, log_m = (int(v) for v in z["meta"])
     dpk = DeviceProvingKey(

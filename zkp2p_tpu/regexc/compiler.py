@@ -328,6 +328,9 @@ VENMO_OFFRAMPER_ID = r"user_id=3D[0-9A-Za-z_\r\n=]+"
 VENMO_AMOUNT = r"\$[0-9A-Za-z_]+\."
 VENMO_ACTOR_ID = r"actor_id=3D[0-9]+"
 VENMO_MM_ID = r"user_id=3D[0-9A-Za-z_\r\n=]+"
+# Legacy custom-message extractor (`circuit/legacy/venmo_message_regex.circom:8`:
+# `<p>(0|1|2|3|4|5|6|7|8|9)+`) — the digits following the first HTML <p> tag.
+VENMO_MESSAGE = r"<p>[0-9]+"
 DKIM_HEADER = r"(\x80|\r\n)(to|from):[^\r\n]+\r\n"
 BODY_HASH = r"\r\ndkim-signature:([a-z]+=[^;]+; )+bh=[0-9A-Za-z+/=]+; "
 TWITTER_RESET = r"This email was meant for @[0-9A-Za-z_]+"

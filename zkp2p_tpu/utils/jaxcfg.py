@@ -3,20 +3,48 @@
 The limb-arithmetic graphs are wide and XLA compiles them slowly; the
 persistent compilation cache turns that into a once-per-checkout cost —
 on every entry path, not just pytest (tests/conftest.py does the same).
+
+The cache directory is keyed by a host-CPU fingerprint: XLA:CPU AOT
+entries embed the compile machine's feature set and fail to load (with
+"could lead to SIGILL" machine-feature-mismatch warnings) when the same
+checkout moves to a host with different CPU features — the round-3
+failure mode, where a cache written on one driver box poisoned the next
+round's bench/dryrun with a storm of failed AOT loads + recompiles.
+Keying by fingerprint makes every machine's entries self-contained:
+a foreign cache is simply invisible instead of half-loadable.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+
+def host_fingerprint() -> str:
+    """Stable short hash of this host's CPU feature flags."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha256(feats.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    return (platform.machine() or "unknown").replace("/", "_")
+
+
+def cache_dir(base: str | None = None) -> str:
+    base = base or os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"),
+    )
+    return os.path.join(base, host_fingerprint())
 
 
 def enable_cache(path: str | None = None) -> None:
     import jax
 
-    cache = path or os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"),
-    )
-    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_compilation_cache_dir", cache_dir(path))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
