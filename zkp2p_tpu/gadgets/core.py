@@ -130,7 +130,8 @@ def mux2(cs: ConstraintSystem, sel: int, a: int, b: int, tag: str = "mux") -> in
     """sel ? b : a  (sel boolean)."""
     out = cs.new_wire(f"{tag}.out")
     cs.enforce(LC.of(sel), LC.of(b) - LC.of(a), LC.of(out) - LC.of(a), tag)
-    cs.compute(out, lambda s, x, y: y if s else x, [sel, a, b])
+    # branch-free (x + s*(y-x)): columnar-safe for the batch witness tier
+    cs.compute(out, lambda s, x, y: x + s * (y - x), [sel, a, b])
     return out
 
 

@@ -70,9 +70,11 @@ class CharClassCache:
             a = LC.of(bits4[0]) if v & 1 else LC.const(1) - LC.of(bits4[0])
             b = LC.of(bits4[1]) if v & 2 else LC.const(1) - LC.of(bits4[1])
             cs.enforce(a, b, LC.of(w), f"{tag}/p")
+            # branch-free equality on bits ((1-(b^x))*(1-(b^y))) so the
+            # batch witness tier runs it columnar (r1cs.witness_batch)
             cs.compute(
                 w,
-                lambda b0, b1, vv=v: int(b0 == (vv & 1) and b1 == ((vv >> 1) & 1)),
+                lambda b0, b1, vv=v: (1 - (b0 ^ (vv & 1))) * (1 - (b1 ^ ((vv >> 1) & 1))),
                 [bits4[0], bits4[1]],
             )
             pair0.append(w)
@@ -84,7 +86,7 @@ class CharClassCache:
             cs.enforce(a, b, LC.of(w), f"{tag}/q")
             cs.compute(
                 w,
-                lambda b2, b3, vv=v: int(b2 == (vv & 1) and b3 == ((vv >> 1) & 1)),
+                lambda b2, b3, vv=v: (1 - (b2 ^ (vv & 1))) * (1 - (b3 ^ ((vv >> 1) & 1))),
                 [bits4[2], bits4[3]],
             )
             pair1.append(w)

@@ -107,7 +107,9 @@ def _ch(cs: ConstraintSystem, e: Word, f: Word, g: Word, tag: str) -> Word:
     for i in range(32):
         o = cs.new_wire(f"{tag}.{i}")
         cs.enforce(LC.of(e[i]), LC.of(f[i]) - LC.of(g[i]), LC.of(o) - LC.of(g[i]), f"{tag}/ch")
-        cs.compute(o, lambda ev, fv, gv: fv if ev else gv, [e[i], f[i], g[i]])
+        # branch-free (g + e*(f-g)) so the batch witness tier can run it
+        # columnar (snark.r1cs.witness_batch); bit-identical for e in {0,1}
+        cs.compute(o, lambda ev, fv, gv: gv + ev * (fv - gv), [e[i], f[i], g[i]])
         out.append(o)
     return out
 
