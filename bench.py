@@ -70,20 +70,27 @@ def _init_backend():
 
 def build_keys(cs):
     """Device key from the .npz cache, else array-path setup (native)."""
-    from zkp2p_tpu.prover.keycache import KeyCacheSchemaError, load_dpk, save_dpk
+    from zkp2p_tpu.prover.keycache import (
+        KeyCacheSchemaError,
+        circuit_digest,
+        load_dpk,
+        save_dpk,
+    )
     from zkp2p_tpu.utils.trace import trace
 
     from zkp2p_tpu.snark.groth16 import domain_size_for
 
     os.makedirs(CACHE, exist_ok=True)
     path = os.path.join(CACHE, f"venmo_{HEADER}_{BODY}.npz")
+    digest = circuit_digest(cs)
     if os.path.exists(path):
         log("loading cached device key")
         try:
             with trace("load_key"):
-                dpk, vk = load_dpk(path)
+                dpk, vk = load_dpk(path, digest=digest)
             # A gadget change alters wire count/domain -> a stale cache must
-            # re-setup, not crash deep inside jit with a shape mismatch.
+            # re-setup, not crash deep inside jit with a shape mismatch
+            # (the digest above also catches same-count REORDERS).
             if dpk.n_wires == cs.num_wires and (1 << dpk.log_m) == domain_size_for(cs):
                 return dpk, vk
             log("cached key does not match the rebuilt circuit; re-running setup")
@@ -96,7 +103,7 @@ def build_keys(cs):
 
         dpk, vk = setup_device(cs, seed="bench")
     log(f"setup took {time.time() - t0:.0f}s")
-    save_dpk(path, dpk, vk)
+    save_dpk(path, dpk, vk, digest=digest)
     return dpk, vk
 
 

@@ -44,7 +44,12 @@ def main():
     from zkp2p_tpu.formats.proof_json import proof_to_json, public_to_json
     from zkp2p_tpu.inputs.email import generate_inputs, make_test_key, make_venmo_email
     from zkp2p_tpu.models.venmo import VenmoParams, build_venmo_circuit
-    from zkp2p_tpu.prover.keycache import KeyCacheSchemaError, load_dpk, save_dpk
+    from zkp2p_tpu.prover.keycache import (
+        KeyCacheSchemaError,
+        circuit_digest,
+        load_dpk,
+        save_dpk,
+    )
     from zkp2p_tpu.prover.native_prove import prove_native
     from zkp2p_tpu.snark.groth16 import domain_size_for, verify
 
@@ -61,10 +66,14 @@ def main():
     timing["build_circuit_s"] = round(time.time() - t, 1)
     log(f"constraints={cs.num_constraints} wires={cs.num_wires} domain={domain_size_for(cs)}")
 
+    from zkp2p_tpu.prover.keycache import circuit_digest as _digest_fn
+
+    wit_digest = _digest_fn(cs)
     if os.path.exists(wit_path):
         log("loading cached witness")
         z = np.load(wit_path)
-        if int(z["n_wires"][0]) == cs.num_wires:
+        cached_digest = bytes(z["digest"]).decode() if "digest" in z else "<none>"
+        if int(z["n_wires"][0]) == cs.num_wires and cached_digest == wit_digest:
             w = [int.from_bytes(z["witness"][i].tobytes(), "little") for i in range(cs.num_wires)]
             pubs = [int.from_bytes(z["pubs"][i].tobytes(), "little") for i in range(z["pubs"].shape[0])]
         else:
@@ -91,14 +100,16 @@ def main():
             witness=_scalars_to_u64([x % R for x in w]),
             pubs=_scalars_to_u64([x % R for x in pubs]),
             n_wires=np.array([cs.num_wires], dtype=np.int64),
+            digest=np.frombuffer(wit_digest.encode(), dtype=np.uint8),
         )
         log("witness cached")
 
+    digest = circuit_digest(cs)
     dpk = vk = None
     if os.path.exists(key_path):
         try:
             t = time.time()
-            dpk, vk = load_dpk(key_path)
+            dpk, vk = load_dpk(key_path, digest=digest)
             timing["load_key_s"] = round(time.time() - t, 1)
             if dpk.n_wires != cs.num_wires or (1 << dpk.log_m) != domain_size_for(cs):
                 log("cached key does not match the rebuilt circuit; re-running setup")
@@ -113,7 +124,7 @@ def main():
         dpk, vk = setup_device(cs, seed="bench")
         timing["setup_s"] = round(time.time() - t, 1)
         log(f"setup took {timing['setup_s']}s; caching")
-        save_dpk(key_path, dpk, vk)
+        save_dpk(key_path, dpk, vk, digest=digest)
 
     t = time.time()
     log("native prove ...")

@@ -33,16 +33,37 @@ def lc_sum(wires: Sequence[int], coeffs: Sequence[int] | None = None) -> LC:
 # ------------------------------------------------------------------- bits
 
 
-def num2bits(cs: ConstraintSystem, x: int, n: int, tag: str = "num2bits") -> List[int]:
+def num2bits(cs: ConstraintSystem, x: int, n: int, tag: str = "num2bits", hook: bool = True) -> List[int]:
     """x -> n little-endian bit wires; enforces booleanity + recomposition.
     (circomlib Num2Bits; the decomposition must be unique, so n must be
-    small enough that 2^n - 1 < R.)"""
+    small enough that 2^n - 1 < R.)  hook=False: the caller witnesses the
+    bits inside its own BlockHook (constraints are emitted regardless)."""
     assert n < 254, "ambiguous decomposition"
     bits = cs.new_wires(n, f"{tag}.b")
     for b in bits:
         cs.enforce_bool(b, f"{tag}/bool")
     cs.enforce_eq(lc_sum(bits, [1 << i for i in range(n)]), LC.of(x), f"{tag}/recompose")
-    cs.compute(bits, lambda v: [(v >> i) & 1 for i in range(n)], [x])
+    if not hook:
+        return bits
+    import numpy as np
+
+    if n <= 62:  # int64-safe: one vectorized shift for all n bits
+        cs.compute_block(bits, lambda m, n=n: (m[0] >> np.arange(n)[:, None]) & 1, [x])
+    else:
+        # Wide decompositions (bigint limbs): bytes + unpackbits — one
+        # to_bytes per element then a C-speed bit explode (the object-int
+        # shift matrix was ~0.1 ms per call, the top residual cost of the
+        # batch witness tier).
+        nb = (n + 7) // 8
+
+        def vfn(m, n=n, nb=nb):
+            buf = b"".join(int(v).to_bytes(nb, "little") for v in m[0])
+            by = np.frombuffer(buf, dtype=np.uint8).reshape(m.shape[1], nb)
+            # object result: the consumers (bigint limb hooks) live on the
+            # object matrix — an int64 result would migrate back per hook
+            return np.unpackbits(by, axis=1, bitorder="little")[:, :n].T.astype(object)
+
+        cs.compute_block(bits, vfn, [x], int64=False)
     return bits
 
 
@@ -50,7 +71,16 @@ def bits2num(cs: ConstraintSystem, bits: Sequence[int], tag: str = "bits2num") -
     """Little-endian bit wires -> one wire (no booleanity re-check)."""
     out = cs.new_wire(f"{tag}.out")
     cs.enforce_eq(lc_sum(bits, [1 << i for i in range(len(bits))]), LC.of(out), tag)
-    cs.compute(out, lambda *bs: sum(b << i for i, b in enumerate(bs)) % R, list(bits))
+    import numpy as np
+
+    if len(bits) <= 62:
+        w = np.asarray([1 << i for i in range(len(bits))], dtype=np.int64)
+        cs.compute_block([out], lambda m, w=w: (w @ m)[None, :], list(bits))
+    else:
+        w = np.asarray([1 << i for i in range(len(bits))], dtype=object)[:, None]
+        cs.compute_block(
+            [out], lambda m, w=w: ((w * m).sum(axis=0) % R)[None, :], list(bits), int64=False
+        )
     return out
 
 
@@ -142,10 +172,46 @@ def one_hot(cs: ConstraintSystem, idx: int, n: int, tag: str = "onehot") -> List
     """Indicator wires ind[i] = (idx == i) with Σ ind = 1 and Σ i·ind = idx.
 
     The two closing sums make the decomposition sound without per-lane
-    IsEqual inverses being trusted blindly."""
-    inds = [is_equal_const(cs, idx, i, f"{tag}.{i}") for i in range(n)]
+    IsEqual inverses being trusted blindly.  All lane inverses come from
+    ONE BlockHook via Montgomery batch inversion — one exponentiation per
+    call instead of n per witness (the per-lane pow hooks were the
+    dominant fallback cost of the batch witness tier)."""
+    import numpy as np
+
+    invs: List[int] = []
+    inds: List[int] = []
+    for i in range(n):
+        inv = cs.new_wire(f"{tag}.{i}.inv")
+        out = cs.new_wire(f"{tag}.{i}.out")
+        cs.enforce(LC.of(idx) - i, LC.of(inv), LC.const(1) - LC.of(out), f"{tag}.{i}/inv")
+        cs.enforce(LC.of(idx) - i, LC.of(out), LC(), f"{tag}.{i}/zero")
+        invs.append(inv)
+        inds.append(out)
     cs.enforce_eq(lc_sum(inds), LC.const(1), f"{tag}/onehot")
     cs.enforce_eq(lc_sum(inds, list(range(n))), LC.of(idx), f"{tag}/index")
+
+    def vfn(m, n=n):
+        v = m[0]  # (K,) object
+        diffs = (v[None, :] - np.arange(n, dtype=object)[:, None]) % R  # (n, K)
+        flat = diffs.reshape(-1)
+        nz = np.flatnonzero(flat)
+        xs = [int(flat[j]) for j in nz]
+        # Montgomery trick: len(xs) inverses for 3 muls each + one pow.
+        prefix = [1] * (len(xs) + 1)
+        for j, x in enumerate(xs):
+            prefix[j + 1] = prefix[j] * x % R
+        inv_run = pow(prefix[-1], R - 2, R)
+        inv_flat = np.zeros_like(flat)
+        for j in range(len(xs) - 1, -1, -1):
+            inv_flat[nz[j]] = prefix[j] * inv_run % R
+            inv_run = inv_run * xs[j] % R
+        invs_m = inv_flat.reshape(n, -1)
+        outs_m = np.asarray(flat == 0, dtype=object).reshape(n, -1) * 1
+        # creation order: inv, out, inv, out, ...
+        return np.stack([invs_m, outs_m], axis=1).reshape(2 * n, -1)
+
+    wires = [w for pair in zip(invs, inds) for w in pair]
+    cs.compute_block(wires, vfn, [idx], int64=False)
     return inds
 
 

@@ -23,23 +23,28 @@ P = R  # Poseidon runs over the BN254 scalar field
 
 
 def _lc_pow5(cs: ConstraintSystem, lc: LC, tag: str) -> int:
-    """x^5 of an LC value: wires for x2, x4, x5 (3 constraints)."""
-    ins = [w for w in lc.terms if w != 0]
-    weights = [lc.terms[w] for w in ins]
-    const = lc.terms.get(0, 0)
+    """x^5 of an LC value: wires for x2, x4, x5 (3 constraints), all
+    witnessed by ONE object BlockHook (exact field arithmetic)."""
+    import numpy as np
 
-    def val(*vs):
-        return (sum(v * c for v, c in zip(vs, weights)) + const) % P
+    ins = [w for w in lc.terms if w != 0]
+    weights = np.asarray([lc.terms[w] for w in ins], dtype=object)[:, None]
+    const = lc.terms.get(0, 0)
 
     x2 = cs.new_wire(f"{tag}.x2")
     cs.enforce(lc, lc, LC.of(x2), f"{tag}/x2")
-    cs.compute(x2, lambda *vs: pow(val(*vs), 2, P), ins)
     x4 = cs.new_wire(f"{tag}.x4")
     cs.enforce(LC.of(x2), LC.of(x2), LC.of(x4), f"{tag}/x4")
-    cs.compute(x4, lambda v: v * v % P, [x2])
     x5 = cs.new_wire(f"{tag}.x5")
     cs.enforce(LC.of(x4), lc, LC.of(x5), f"{tag}/x5")
-    cs.compute(x5, lambda v4, *vs: v4 * val(*vs) % P, [x4] + ins)
+
+    def vfn(m, w=weights, c=const):
+        x = ((w * m).sum(axis=0) + c) % P
+        x2v = x * x % P
+        x4v = x2v * x2v % P
+        return np.stack([x2v, x4v, x4v * x % P])
+
+    cs.compute_block([x2, x4, x5], vfn, ins, int64=False)
     return x5
 
 

@@ -63,6 +63,10 @@ class CharClassCache:
         self._bits.setdefault(byte, bits)
 
     def _nibble_onehot(self, bits4: List[int], tag: str) -> List[int]:
+        """One-hot of a 4-bit value via two 2-bit one-hots; all 24 wires
+        witnessed by ONE BlockHook (equality against arange)."""
+        import numpy as np
+
         cs = self.cs
         pair0: List[int] = []  # one-hot of bits4[0:2]
         for v in range(4):
@@ -70,13 +74,6 @@ class CharClassCache:
             a = LC.of(bits4[0]) if v & 1 else LC.const(1) - LC.of(bits4[0])
             b = LC.of(bits4[1]) if v & 2 else LC.const(1) - LC.of(bits4[1])
             cs.enforce(a, b, LC.of(w), f"{tag}/p")
-            # branch-free equality on bits ((1-(b^x))*(1-(b^y))) so the
-            # batch witness tier runs it columnar (r1cs.witness_batch)
-            cs.compute(
-                w,
-                lambda b0, b1, vv=v: (1 - (b0 ^ (vv & 1))) * (1 - (b1 ^ ((vv >> 1) & 1))),
-                [bits4[0], bits4[1]],
-            )
             pair0.append(w)
         pair1: List[int] = []  # one-hot of bits4[2:4]
         for v in range(4):
@@ -84,18 +81,22 @@ class CharClassCache:
             a = LC.of(bits4[2]) if v & 1 else LC.const(1) - LC.of(bits4[2])
             b = LC.of(bits4[3]) if v & 2 else LC.const(1) - LC.of(bits4[3])
             cs.enforce(a, b, LC.of(w), f"{tag}/q")
-            cs.compute(
-                w,
-                lambda b2, b3, vv=v: (1 - (b2 ^ (vv & 1))) * (1 - (b3 ^ ((vv >> 1) & 1))),
-                [bits4[2], bits4[3]],
-            )
             pair1.append(w)
         out: List[int] = []
         for v in range(16):
             w = cs.new_wire(f"{tag}.n{v}")
             cs.enforce(LC.of(pair0[v & 3]), LC.of(pair1[v >> 2]), LC.of(w), f"{tag}/n")
-            cs.compute(w, lambda x, y: x * y, [pair0[v & 3], pair1[v >> 2]])
             out.append(w)
+
+        def vfn(m):
+            lo = m[0] + 2 * m[1]  # (K,)
+            hi = m[2] + 2 * m[3]
+            p0 = (lo[None, :] == np.arange(4)[:, None]).astype(np.int64)
+            p1 = (hi[None, :] == np.arange(4)[:, None]).astype(np.int64)
+            n = (p1[:, None, :] * p0[None, :, :]).reshape(16, -1)  # n[v] = p0[v&3]*p1[v>>2]
+            return np.concatenate([p0, p1, n], axis=0)
+
+        cs.compute_block(pair0 + pair1 + out, vfn, list(bits4))
         return out
 
     def _nibbles(self, byte: int) -> Tuple[List[int], List[int]]:
@@ -119,6 +120,8 @@ class CharClassCache:
         key = (byte, chars)
         if key in self._cls:
             return self._cls[key]
+        import numpy as np
+
         cs = self.cs
         lo16, hi16 = self._nibbles(byte)
         by_hi: Dict[int, List[int]] = {}
@@ -126,6 +129,8 @@ class CharClassCache:
             by_hi.setdefault(c >> 4, []).append(c & 0xF)
         parts: List[int] = []
         full_his: List[int] = []
+        ins: List[int] = []
+        group_rows: List[List[int]] = []  # per part: [hi idx, lo idxs...] into ins
         for h, los in sorted(by_hi.items()):
             if len(los) == 16:
                 full_his.append(hi16[h])  # whole row: no product needed
@@ -133,22 +138,127 @@ class CharClassCache:
             p = cs.new_wire("re.cls.p")
             mask = lc_sum([lo16[l] for l in los])
             cs.enforce(LC.of(hi16[h]), mask, LC.of(p), "re.cls/p")
-            cs.compute(
-                p,
-                lambda hv, *lvs: hv * (sum(lvs) % R),
-                [hi16[h]] + [lo16[l] for l in los],
-            )
+            row = [len(ins)]
+            ins.append(hi16[h])
+            for l in los:
+                row.append(len(ins))
+                ins.append(lo16[l])
+            group_rows.append(row)
             parts.append(p)
         if not parts and len(full_his) == 1:
             out = full_his[0]
         elif len(parts) == 1 and not full_his:
             out = parts[0]
+            self._register_indicator_block(parts, None, ins, group_rows, full_his)
+            self._cls[key] = out
+            return out
         else:
             out = cs.new_wire("re.cls")
             cs.enforce_eq(lc_sum(parts + full_his), LC.of(out), "re.cls/sum")
-            cs.compute(out, lambda *ps: sum(ps), parts + full_his)
+        if parts:
+            self._register_indicator_block(
+                parts, out if out not in parts and out not in full_his else None,
+                ins, group_rows, full_his,
+            )
+        elif out not in full_his:
+            # sum-of-full-rows only: one block for the closing sum
+            fh = list(full_his)
+            self.cs.compute_block(
+                [out], lambda m: m.sum(axis=0, keepdims=True), fh
+            )
         self._cls[key] = out
         return out
+
+    def indicator_bulk(self, byte_wires: Sequence[int], chars: FrozenSet[int]) -> List[int]:
+        """indicator(byte, chars) for MANY byte wires with ONE BlockHook
+        covering every cache miss — the per-(byte, class) block tier left
+        ~20k small blocks on the mini circuit; a scan calls this once per
+        distinct class instead (same wires, same constraints, same cache
+        entries — later scans still hit the per-byte cache)."""
+        import numpy as np
+
+        cs = self.cs
+        missing = [b for b in byte_wires if (b, chars) not in self._cls]
+        # Group structure is identical for every byte (it depends only on
+        # `chars`), so the miss block vectorizes over bytes.
+        by_hi: Dict[int, List[int]] = {}
+        for c in chars:
+            by_hi.setdefault(c >> 4, []).append(c & 0xF)
+        groups = sorted((h, los) for h, los in by_hi.items() if len(los) < 16)
+        fulls = sorted(h for h, los in by_hi.items() if len(los) == 16)
+        if missing and groups:
+            outs: List[int] = []
+            ins: List[int] = []
+            g_sizes = [1 + len(los) for _, los in groups]
+            stride = sum(g_sizes) + len(fulls)
+            n_parts = len(groups)
+            needs_sum = n_parts + len(fulls) > 1
+            for b in missing:
+                lo16, hi16 = self._nibbles(b)
+                parts = []
+                for h, los in groups:
+                    p = cs.new_wire("re.cls.p")
+                    cs.enforce(LC.of(hi16[h]), lc_sum([lo16[l] for l in los]), LC.of(p), "re.cls/p")
+                    ins.append(hi16[h])
+                    ins.extend(lo16[l] for l in los)
+                    parts.append(p)
+                ins.extend(hi16[h] for h in fulls)
+                if needs_sum:
+                    o = cs.new_wire("re.cls")
+                    cs.enforce_eq(lc_sum(parts + [hi16[h] for h in fulls]), LC.of(o), "re.cls/sum")
+                else:
+                    o = parts[0]
+                outs.extend(parts)
+                if needs_sum:
+                    outs.append(o)
+                self._cls[(b, chars)] = o
+
+            starts = np.cumsum([0] + g_sizes[:-1])
+
+            def vfn(m, starts=starts, g_sizes=g_sizes, stride=stride,
+                    n_parts=n_parts, n_full=len(fulls), needs_sum=needs_sum):
+                nb = m.shape[0] // stride
+                mm = m.reshape(nb, stride, -1)
+                parts = [
+                    mm[:, s] * mm[:, s + 1 : s + g].sum(axis=1)
+                    for s, g in zip(starts, g_sizes)
+                ]
+                pv = np.stack(parts, axis=1)  # (nb, n_parts, K)
+                if not needs_sum:
+                    return pv.reshape(-1, m.shape[1])
+                tot = pv.sum(axis=1) + mm[:, stride - n_full :].sum(axis=1)
+                return np.concatenate([pv, tot[:, None, :]], axis=1).reshape(-1, m.shape[1])
+
+            cs.compute_block(outs, vfn, ins)
+        elif missing:  # pure full-row classes: indicator is an existing wire or a sum
+            for b in missing:
+                self.indicator(b, chars)
+        return [self._cls[(b, chars)] for b in byte_wires]
+
+    def _register_indicator_block(self, parts, out, ins, group_rows, full_his):
+        """ONE BlockHook for an indicator's part products (+ closing sum):
+        parts[i] = hi * sum(los); out = sum(parts) + sum(full_his)."""
+        import numpy as np
+
+        cs = self.cs
+        n_ins = len(ins)
+        all_ins = ins + list(full_his)
+        outs = list(parts) + ([out] if out is not None else [])
+        rows = group_rows
+        n_fh = len(full_his)
+
+        def vfn(m, rows=rows, n_ins=n_ins, n_fh=n_fh, has_out=out is not None):
+            res = [m[r[0]] * m[r[1:]].sum(axis=0) for r in rows]
+            if has_out:
+                total = res[0] * 0
+                for p in res:
+                    total = total + p
+                if n_fh:
+                    total = total + m[n_ins:].sum(axis=0)
+                res.append(total)
+            return np.stack(res)
+
+        cs.compute_block(outs, vfn, all_ins)
 
 
 def dfa_scan(
@@ -165,28 +275,61 @@ def dfa_scan(
     S = dfa.n_states
     trans = dfa.transitions()
 
+    import numpy as np
+
     s0 = []
     for j in range(S):
         w = cs.new_wire(f"{tag}.s0.{j}")
         cs.enforce_eq(LC.of(w), LC.const(1 if j == 0 else 0), f"{tag}/init")
-        cs.compute(w, lambda v=1 if j == 0 else 0: v, [])
         s0.append(w)
+    init = np.asarray([1] + [0] * (S - 1), dtype=np.int64)
+    cs.compute_block(s0, lambda m, c=init: np.broadcast_to(c[:, None], (S, m.shape[1])), [])
     states = [s0]
+
+    # All class indicators for the whole scan up front: one BlockHook per
+    # distinct class covering every byte position (vs one per (byte,
+    # class) — ~20k tiny blocks on the mini circuit).
+    class_cols = {
+        chars: cache.indicator_bulk(byte_wires, chars)
+        for chars in {c for _, _, c in trans}
+    }
 
     for t, byte in enumerate(byte_wires):
         prev = states[-1]
-        terms_by_dst: Dict[int, List[int]] = {}
+        # Per-step BlockHook: every transition product AND every next-state
+        # sum from one numpy program (ins: S prev states + the step's
+        # indicator wires) — the per-wire hook tier here was ~20% of the
+        # whole witness (r1cs.witness_batch).
+        prods: List[int] = []
+        srcs: List[int] = []
+        ind_ins: List[int] = []
+        dst_mat_rows: List[Tuple[int, int]] = []  # (dst, prod_idx)
         for src, dst, chars in trans:
-            ind = cache.indicator(byte, chars)
-            p = and_gate(cs, prev[src], ind, f"{tag}.t{t}.{src}.{dst}")
-            terms_by_dst.setdefault(dst, []).append(p)
+            ind = class_cols[chars][t]
+            p = cs.new_wire(f"{tag}.t{t}.{src}.{dst}.out")
+            cs.enforce(LC.of(prev[src]), LC.of(ind), LC.of(p), f"{tag}.t{t}")
+            prods.append(p)
+            srcs.append(src)
+            ind_ins.append(ind)
+            dst_mat_rows.append((dst, len(prods) - 1))
         nxt = []
+        terms_by_dst: Dict[int, List[int]] = {}
+        for dst, pi in dst_mat_rows:
+            terms_by_dst.setdefault(dst, []).append(prods[pi])
         for j in range(S):
             w = cs.new_wire(f"{tag}.s{t + 1}.{j}")
-            ts = terms_by_dst.get(j, [])
-            cs.enforce_eq(lc_sum(ts), LC.of(w), f"{tag}/step")
-            cs.compute(w, lambda *ps: sum(ps), ts)
+            cs.enforce_eq(lc_sum(terms_by_dst.get(j, [])), LC.of(w), f"{tag}/step")
             nxt.append(w)
+        src_idx = np.asarray(srcs)
+        dst_onehot = np.zeros((S, len(prods)), dtype=np.int64)
+        for dst, pi in dst_mat_rows:
+            dst_onehot[dst, pi] = 1
+
+        def vfn(m, src_idx=src_idx, dst=dst_onehot, S=S):
+            pv = m[src_idx] * m[S:]  # (n_trans, K)
+            return np.concatenate([pv, dst @ pv], axis=0)
+
+        cs.compute_block(prods + nxt, vfn, list(prev) + ind_ins)
         states.append(nxt)
     return states
 
@@ -194,10 +337,12 @@ def dfa_scan(
 def match_count(cs: ConstraintSystem, states: List[List[int]], accept: FrozenSet[int], tag: str = "re.cnt") -> int:
     """Number of steps landing in an accept state (the template's `out`
     signal; main circuit asserts exact counts, `circuit.circom:106,119`)."""
+    import numpy as np
+
     out = cs.new_wire(tag)
     acc_wires = [states[t][a] for t in range(1, len(states)) for a in accept]
     cs.enforce_eq(lc_sum(acc_wires), LC.of(out), tag)
-    cs.compute(out, lambda *vs: sum(vs), acc_wires)
+    cs.compute_block([out], lambda m: m.sum(axis=0, keepdims=True), acc_wires)
     return out
 
 
@@ -209,8 +354,14 @@ def reveal_bytes(
     tag: str = "re.rev",
 ) -> List[int]:
     """reveal[i] = byte[i] * (state_{i+1} in reveal_states)
-    (`gen.py:214-217`: the extraction mask for payee ID / amount)."""
+    (`gen.py:214-217`: the extraction mask for payee ID / amount).
+    All mask sums + products witnessed by ONE BlockHook."""
+    import numpy as np
+
+    T = len(byte_wires)
+    nr = len(reveal_states)
     out = []
+    block_outs: List[int] = []
     for i, byte in enumerate(byte_wires):
         mask_wires = [states[i + 1][s] for s in reveal_states]
         if len(mask_wires) == 1:
@@ -218,6 +369,22 @@ def reveal_bytes(
         else:
             mask = cs.new_wire(f"{tag}.m{i}")
             cs.enforce_eq(lc_sum(mask_wires), LC.of(mask), f"{tag}/mask")
-            cs.compute(mask, lambda *vs: sum(vs), mask_wires)
-        out.append(and_gate(cs, byte, mask, f"{tag}.{i}"))
+            block_outs.append(mask)
+        p = cs.new_wire(f"{tag}.{i}.out")
+        cs.enforce(LC.of(byte), LC.of(mask), LC.of(p), f"{tag}.{i}")
+        block_outs.append(p)
+        out.append(p)
+
+    # ins: bytes (T) then the reveal-state wires per position (T, nr)
+    state_ins = [states[i + 1][s] for i in range(T) for s in reveal_states]
+
+    def vfn(m, T=T, nr=nr):
+        bytes_v = m[0:T]
+        masks = m[T:].reshape(T, nr, -1).sum(axis=1)  # (T, K)
+        pv = bytes_v * masks
+        if nr == 1:
+            return pv  # no mask wires were created
+        return np.stack([masks, pv], axis=1).reshape(2 * T, -1)
+
+    cs.compute_block(block_outs, vfn, list(byte_wires) + state_ins)
     return out

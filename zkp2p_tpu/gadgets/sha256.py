@@ -73,7 +73,53 @@ def _xor_bits(cs: ConstraintSystem, bits: Sequence[Optional[int]], tag: str) -> 
 
 
 def _xor_words(cs: ConstraintSystem, words: Sequence[Word], tag: str) -> Word:
-    return [_xor_bits(cs, [w[i] for w in words], f"{tag}.{i}") for i in range(32)]
+    """Bitwise XOR of up to 3 words: per position a chain of 2-input xor
+    constraints; ALL chain wires witnessed by ONE BlockHook (a padded
+    bitwise_xor.accumulate over (positions, chain) — the per-bit hook
+    tier was ~half the SHA witness cost, r1cs.witness_batch)."""
+    import numpy as np
+
+    out: Word = []
+    ins: List[int] = []
+    idx_rows: List[List[int]] = []  # per multi-live position: indices into ins (padded later)
+    chain_wires: List[int] = []
+    sel_rows: List[int] = []
+    sel_cols: List[int] = []
+    for i in range(32):
+        live = [w[i] for w in words if w[i] is not None]
+        if not live:
+            out.append(None)
+            continue
+        if len(live) == 1:
+            out.append(live[0])
+            continue
+        row = len(idx_rows)
+        base = len(ins)
+        ins.extend(live)
+        idx_rows.append(list(range(base, base + len(live))))
+        acc = live[0]
+        for j, b in enumerate(live[1:]):
+            o = cs.new_wire(f"{tag}.{i}.x{j}")
+            cs.enforce(LC.of(acc, 2), LC.of(b), LC.of(acc) + LC.of(b) - LC.of(o), f"{tag}.{i}")
+            chain_wires.append(o)
+            sel_rows.append(row)
+            sel_cols.append(j + 1)
+            acc = o
+        out.append(acc)
+    if chain_wires:
+        max_l = max(len(r) for r in idx_rows)
+        pad = len(ins)  # index of the zero row appended by the vfn
+        idx = np.asarray([r + [pad] * (max_l - len(r)) for r in idx_rows])
+        rows = np.asarray(sel_rows)
+        cols = np.asarray(sel_cols)
+
+        def vfn(m, idx=idx, rows=rows, cols=cols):
+            ext = np.vstack([m, np.zeros((1, m.shape[1]), dtype=m.dtype)])
+            acc = np.bitwise_xor.accumulate(ext[idx], axis=1)
+            return acc[rows, cols]
+
+        cs.compute_block(chain_wires, vfn, ins)
+    return out
 
 
 def _add_mod32(cs: ConstraintSystem, words: Sequence[Word], const_extra: int, n_terms: int, tag: str) -> Word:
@@ -92,39 +138,58 @@ def _add_mod32(cs: ConstraintSystem, words: Sequence[Word], const_extra: int, n_
             weights.append(1 << i)
     total = cs.new_wire(f"{tag}.sum")
     cs.enforce_eq(LC(terms) + const_extra, LC.of(total), f"{tag}/sum")
-    cs.compute(
-        total,
-        lambda *vs, ws=tuple(weights), ce=const_extra: (sum(v * wt for v, wt in zip(vs, ws)) + ce) % R,
-        ins,
-    )
-    bits = num2bits(cs, total, 32 + extra, f"{tag}.bits")
+    import numpy as np
+
+    bits = num2bits(cs, total, 32 + extra, f"{tag}.bits", hook=False)
+    w_arr = np.asarray(weights, dtype=np.int64)  # sum < n_terms * 2^32: int64-safe
+    nb = 32 + extra
+
+    def vfn(m, w=w_arr, ce=const_extra, nb=nb):
+        tot = (w @ m + ce)[None, :]
+        return np.concatenate([tot, (tot >> np.arange(nb)[:, None]) & 1], axis=0)
+
+    cs.compute_block([total] + bits, vfn, ins)
     return bits[:32]
 
 
 def _ch(cs: ConstraintSystem, e: Word, f: Word, g: Word, tag: str) -> Word:
-    """ch = g + e*(f - g), bitwise (1 constraint/bit)."""
+    """ch = g + e*(f - g), bitwise (1 constraint/bit); one BlockHook for
+    all 32 bits."""
     out: Word = []
     for i in range(32):
         o = cs.new_wire(f"{tag}.{i}")
         cs.enforce(LC.of(e[i]), LC.of(f[i]) - LC.of(g[i]), LC.of(o) - LC.of(g[i]), f"{tag}/ch")
-        # branch-free (g + e*(f-g)) so the batch witness tier can run it
-        # columnar (snark.r1cs.witness_batch); bit-identical for e in {0,1}
-        cs.compute(o, lambda ev, fv, gv: gv + ev * (fv - gv), [e[i], f[i], g[i]])
         out.append(o)
+
+    def vfn(m):
+        ev, fv, gv = m[0:32], m[32:64], m[64:96]
+        return gv + ev * (fv - gv)
+
+    cs.compute_block(out, vfn, list(e) + list(f) + list(g))
     return out
 
 
 def _maj(cs: ConstraintSystem, a: Word, b: Word, c: Word, tag: str) -> Word:
-    """maj = t + c*(a + b - 2t), t = a*b (2 constraints/bit)."""
+    """maj = t + c*(a + b - 2t), t = a*b (2 constraints/bit); one
+    BlockHook for all 64 wires."""
+    import numpy as np
+
+    ts: Word = []
     out: Word = []
     for i in range(32):
         t = cs.new_wire(f"{tag}.t{i}")
         cs.enforce(LC.of(a[i]), LC.of(b[i]), LC.of(t), f"{tag}/t")
-        cs.compute(t, lambda x, y: x & y, [a[i], b[i]])
         o = cs.new_wire(f"{tag}.{i}")
         cs.enforce(LC.of(c[i]), LC.of(a[i]) + LC.of(b[i]) - LC.of(t, 2), LC.of(o) - LC.of(t), f"{tag}/maj")
-        cs.compute(o, lambda cv, x, y, tv: (tv + cv * (x + y - 2 * tv)) % R, [c[i], a[i], b[i], t])
+        ts.append(t)
         out.append(o)
+
+    def vfn(m):
+        av, bv, cv = m[0:32], m[32:64], m[64:96]
+        tv = av * bv
+        return np.vstack([tv, tv + cv * (av + bv - 2 * tv)])
+
+    cs.compute_block(ts + out, vfn, list(a) + list(b) + list(c))
     return out
 
 
@@ -167,16 +232,23 @@ def bytes_to_words(cs: ConstraintSystem, byte_bits: List[List[int]]) -> List[Wor
 
 def state_words_from_const(cs: ConstraintSystem, values: Sequence[int], tag: str = "h0") -> List[Word]:
     """Allocate wires pinned to constant 32-bit values (initial SHA state)."""
+    import numpy as np
+
     words: List[Word] = []
+    flat: List[int] = []
+    bits: List[int] = []
     for wi, v in enumerate(values):
         word: Word = []
         for i in range(32):
             bit = (v >> i) & 1
             wire = cs.new_wire(f"{tag}.{wi}.{i}")
             cs.enforce_eq(LC.of(wire), LC.const(bit), f"{tag}/const")
-            cs.compute(wire, lambda b=bit: b, [])
             word.append(wire)
+            flat.append(wire)
+            bits.append(bit)
         words.append(word)
+    consts = np.asarray(bits, dtype=np.int64)
+    cs.compute_block(flat, lambda m, c=consts: np.broadcast_to(c[:, None], (c.shape[0], m.shape[1])), [])
     return words
 
 
@@ -211,9 +283,13 @@ def sha256_blocks(
     if n_blocks_wire is None:
         return [b for word in state for b in word]
 
-    # One-hot select the state after block (n_blocks - 1).
+    # One-hot select the state after block (n_blocks - 1).  All select
+    # products + sums witnessed by ONE BlockHook over (blocks, 256, K).
+    import numpy as np
+
     inds = one_hot(cs, n_blocks_wire, max_blocks + 1, f"{tag}.sel")  # ind[k] = (n==k)
     out_bits: List[int] = []
+    block_outs: List[int] = []
     for wi in range(8):
         for bi in range(32):
             o = cs.new_wire(f"{tag}.out.{wi}.{bi}")
@@ -221,9 +297,25 @@ def sha256_blocks(
             for blk in range(max_blocks):
                 p = cs.new_wire(f"{tag}.outp.{wi}.{bi}.{blk}")
                 cs.enforce(LC.of(inds[blk + 1]), LC.of(per_block_out[blk][wi][bi]), LC.of(p), f"{tag}/selmul")
-                cs.compute(p, lambda s, v: s * v % R, [inds[blk + 1], per_block_out[blk][wi][bi]])
                 prods.append(p)
             cs.enforce_eq(lc_sum(prods), LC.of(o), f"{tag}/selsum")
-            cs.compute(o, lambda *ps: sum(ps) % R, prods)
+            block_outs.extend(prods)
+            block_outs.append(o)
             out_bits.append(o)
+
+    def vfn(m, nb=max_blocks):
+        sel = m[0:nb]  # (blocks, K)
+        vals = m[nb:].reshape(256, nb, -1)  # (256, blocks, K)
+        p = sel[None, :, :] * vals
+        o = p.sum(axis=1, keepdims=True)
+        return np.concatenate([p, o], axis=1).reshape(-1, m.shape[1])
+
+    sel_ins = [inds[blk + 1] for blk in range(max_blocks)]
+    val_ins = [
+        per_block_out[blk][wi][bi]
+        for wi in range(8)
+        for bi in range(32)
+        for blk in range(max_blocks)
+    ]
+    cs.compute_block(block_outs, vfn, sel_ins + val_ins)
     return out_bits

@@ -30,20 +30,55 @@ def shift_window(
 ) -> List[int]:
     """out[j] = Σ_i onehot[i] · data[i+j] — the reveal-shift matrix
     (`circuit.circom:115-132,189-194`): O(len·width) products, which in the
-    JAX witness tracer becomes a windowed gather (SURVEY.md §3.5)."""
+    JAX witness tracer becomes a windowed gather (SURVEY.md §3.5).  All
+    products and sums witnessed by ONE BlockHook (r1cs.witness_batch)."""
+    import numpy as np
+
     out = []
     L = len(data)
+    n_one = len(idx_onehot)
+    block_outs: List[int] = []
+    rows: List[tuple] = []  # (j, i) per product, in creation order
     for j in range(width):
         prods = []
         for i, ind in enumerate(idx_onehot):
             if i + j >= L:
                 continue
-            p = core.and_gate(cs, ind, data[i + j], f"{tag}.p{j}.{i}")
+            p = cs.new_wire(f"{tag}.p{j}.{i}.out")
+            cs.enforce(LC.of(ind), LC.of(data[i + j]), LC.of(p), f"{tag}.p{j}.{i}")
             prods.append(p)
+            block_outs.append(p)
+            rows.append((j, i))
         w = cs.new_wire(f"{tag}.out{j}")
         cs.enforce_eq(core.lc_sum(prods), LC.of(w), f"{tag}/sum{j}")
-        cs.compute(w, lambda *ps: sum(ps) % R, prods)
+        block_outs.append(w)
         out.append(w)
+
+    j_arr = np.asarray([j for j, _ in rows])
+    i_arr = np.asarray([i for _, i in rows])
+    # output-row mapping: products in order, then the sum wire after each
+    # j's run — rebuild positions once here.
+    order: List[int] = []
+    prod_pos: List[int] = []
+    k = 0
+    for j in range(width):
+        n_p = int((i_arr[j_arr == j]).shape[0])
+        prod_pos.extend(range(k, k + n_p))
+        order.append(k + n_p)
+        k += n_p + 1
+
+    def vfn(m, j_arr=j_arr, i_arr=i_arr, n_one=n_one, prod_pos=prod_pos, sum_pos=order, width=width, k_total=k):
+        ind = m[0:n_one]
+        dat = m[n_one:]
+        pv = ind[i_arr] * dat[i_arr + j_arr]  # (n_prods, K)
+        res = np.empty((k_total, m.shape[1]), dtype=m.dtype)
+        res[prod_pos] = pv
+        sums = np.zeros((width, m.shape[1]), dtype=m.dtype)
+        np.add.at(sums, j_arr, pv)
+        res[sum_pos] = sums
+        return res
+
+    cs.compute_block(block_outs, vfn, list(idx_onehot) + list(data))
     return out
 
 

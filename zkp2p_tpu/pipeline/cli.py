@@ -273,6 +273,12 @@ def cmd_batch(args):
     from ..formats.proof_json import dump, proof_to_json, public_to_json
     from ..prover.groth16_tpu import device_pk_from_zkey, prove_tpu_batch
 
+    if getattr(args, "prover", "tpu") == "native":
+        from ..prover.native_prove import prove_native
+
+        def prove_tpu_batch(dpk, wits):  # noqa: F811 — CPU-box batch tier
+            return [prove_native(dpk, w) for w in wits]
+
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
     zk = _load_zkey(args)
     _check_zkey_matches(zk, cs)
@@ -439,6 +445,8 @@ def main(argv=None):
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")
     s.add_argument("--indir", required=True)
     s.add_argument("--outdir", required=True)
+    s.add_argument("--prover", choices=["tpu", "native"], default="tpu",
+                   help="tpu: vmapped XLA batch; native: C++ runtime, sequential")
     s.add_argument("--zkey", help="zkey path or chunk glob")
     s.add_argument("--message", help=argparse.SUPPRESS)
     s.add_argument("--order-id", type=int, default=1)

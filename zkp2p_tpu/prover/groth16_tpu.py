@@ -386,6 +386,19 @@ def prove_tpu_sharded(
     note(h, "h_evals_sharded")
     w_planes = digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW)
     h_planes = digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW)
+    if unified:
+        # One executable for ALL FOUR G1 MSMs needs identical input
+        # LAYOUTS, not just shapes: h_planes inherits the NTT's shard-axis
+        # sharding while w_planes is replicated, and jit keys compiled
+        # programs on input shardings — without this the h MSM recompiles
+        # the whole G1 program (~250 s of the dryrun's cold budget).
+        # Replicating h_planes is dryrun-sized traffic only; production
+        # (unified=False) keeps the sharded layout.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(mesh, P())
+        w_planes = jax.device_put(w_planes, rep)
+        h_planes = jax.device_put(h_planes, rep)
 
     base_chunk = n_dev * lanes
     g1_chunk = base_chunk

@@ -28,6 +28,24 @@ class KeyCacheSchemaError(RuntimeError):
     """Cache file does not match the current DeviceProvingKey schema."""
 
 
+def circuit_digest(cs) -> str:
+    """Sampled structural digest of a ConstraintSystem: wire/constraint
+    counts plus ~1k evenly-sampled constraint rows.  Catches the silent
+    killer the (n_wires, domain) guard cannot: a gadget change that
+    REORDERS wires or constraints without changing their counts — a key
+    cached for the old order would prove garbage (caught only at
+    verify).  Sampling keeps it O(1k) at the 4.9M-constraint flagship."""
+    import hashlib
+
+    n = len(cs.constraints)
+    h = hashlib.sha256(f"{cs.num_wires}|{cs.num_public}|{n}".encode())
+    step = max(1, n // 997)
+    for i in range(0, n, step):
+        c = cs.constraints[i]
+        h.update(repr((i, sorted(c.a.items()), sorted(c.b.items()), sorted(c.c.items()))).encode())
+    return h.hexdigest()[:16]
+
+
 def _g1_arr(pt: G1Point) -> np.ndarray:
     if pt is None:
         return np.zeros((2, 32), dtype=np.uint8)
@@ -60,8 +78,14 @@ def _g2_from(arr: np.ndarray) -> G2Point:
     return (Fq2(vals[0], vals[1]), Fq2(vals[2], vals[3]))
 
 
-def save_dpk(path: str, dpk: DeviceProvingKey, vk: VerifyingKey) -> None:
+def save_dpk(
+    path: str, dpk: DeviceProvingKey, vk: VerifyingKey, digest: str = ""
+) -> None:
+    """`digest`, when given, pins the cache to circuit_digest(cs) — load
+    callers passing a digest reject a key for a reordered circuit."""
     data = {}
+    if digest:
+        data["circuit_digest"] = np.frombuffer(digest.encode(), dtype=np.uint8)
     for f in _DPK_ARRAY_FIELDS:
         v = getattr(dpk, f)
         if isinstance(v, tuple):
@@ -80,13 +104,20 @@ def save_dpk(path: str, dpk: DeviceProvingKey, vk: VerifyingKey) -> None:
     np.savez_compressed(path, **data)
 
 
-def load_dpk(path: str) -> Tuple[DeviceProvingKey, VerifyingKey]:
+def load_dpk(path: str, digest: str = "") -> Tuple[DeviceProvingKey, VerifyingKey]:
     z = np.load(path)
     found = int(z["schema_version"][0]) if "schema_version" in z else 0
     if found != SCHEMA_VERSION:
         raise KeyCacheSchemaError(
             f"{path}: key cache schema {found} != current {SCHEMA_VERSION}; re-run setup"
         )
+    if digest:
+        had = bytes(z["circuit_digest"]).decode() if "circuit_digest" in z else "<none>"
+        if had != digest:
+            raise KeyCacheSchemaError(
+                f"{path}: circuit digest {had} != rebuilt circuit {digest} "
+                f"(wire/constraint order changed); re-run setup"
+            )
     arrays = {}
     for f in _DPK_ARRAY_FIELDS:
         if f in z:

@@ -117,6 +117,8 @@ def prove_native(
 ) -> Proof:
     """Prove with the native C++ runtime.  Emits the exact proof
     `prove_host` / `prove_tpu` produce for the same (witness, r, s)."""
+    from ..utils.trace import trace
+
     lib = _lib()
     if lib is None:
         raise RuntimeError("native library unavailable (csrc build failed?)")
@@ -127,60 +129,65 @@ def prove_native(
     m = 1 << dpk.log_m
 
     # Witness: standard-form u64x4 (MSM scalars) + Montgomery (matvec).
-    w_std = np.ascontiguousarray(_scalars_to_u64([w % R for w in witness]))
-    n_wires = w_std.shape[0]
-    w_mont = np.zeros_like(w_std)
-    lib.fr_to_mont_batch(_p(w_std), _p(w_mont), n_wires)
+    with trace("native/witness_convert"):
+        w_std = np.ascontiguousarray(_scalars_to_u64([w % R for w in witness]))
+        n_wires = w_std.shape[0]
+        w_mont = np.zeros_like(w_std)
+        lib.fr_to_mont_batch(_p(w_std), _p(w_mont), n_wires)
 
     # Az/Bz/Cz evaluations on the domain (Cz = Az . Bz pointwise, valid
     # for a satisfying witness — same shortcut as abc_evals).
     a_ev = np.zeros((m, 4), dtype=np.uint64)
     b_ev = np.zeros((m, 4), dtype=np.uint64)
     c_ev = np.zeros((m, 4), dtype=np.uint64)
-    for coeff, wire, row, out in (
-        (dpk.a_coeff, dpk.a_wire, dpk.a_row, a_ev),
-        (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
-    ):
-        cf = np.ascontiguousarray(_limbs16_to_u64(np.asarray(coeff)))
-        wi = np.ascontiguousarray(np.asarray(wire, dtype=np.uint32))
-        ro = np.ascontiguousarray(np.asarray(row, dtype=np.uint32))
-        lib.fr_matvec(_p(cf), _p32(wi), _p32(ro), cf.shape[0], _p(w_mont), m, _p(out))
-    lib.fr_mul_batch(_p(a_ev), _p(b_ev), _p(c_ev), m)
+    with trace("native/matvec"):
+        for coeff, wire, row, out in (
+            (dpk.a_coeff, dpk.a_wire, dpk.a_row, a_ev),
+            (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
+        ):
+            cf = np.ascontiguousarray(_limbs16_to_u64(np.asarray(coeff)))
+            wi = np.ascontiguousarray(np.asarray(wire, dtype=np.uint32))
+            ro = np.ascontiguousarray(np.asarray(row, dtype=np.uint32))
+            lib.fr_matvec(_p(cf), _p32(wi), _p32(ro), cf.shape[0], _p(w_mont), m, _p(out))
+        lib.fr_mul_batch(_p(a_ev), _p(b_ev), _p(c_ev), m)
 
     # H ladder: d_j = (A.B - C)(g . w^j), Montgomery -> standard scalars.
     d = np.zeros((m, 4), dtype=np.uint64)
-    w_root = _scalars_to_u64([fr_domain_root(dpk.log_m)]).copy()
-    g_cos = _scalars_to_u64([coset_gen(dpk.log_m)]).copy()
-    lib.fr_h_ladder(_p(a_ev), _p(b_ev), _p(c_ev), m, _p(w_root), _p(g_cos), _p(d))
-    d_std = np.zeros_like(d)
-    lib.fr_from_mont_batch(_p(d), _p(d_std), m)
+    with trace("native/h_ladder"):
+        w_root = _scalars_to_u64([fr_domain_root(dpk.log_m)]).copy()
+        g_cos = _scalars_to_u64([coset_gen(dpk.log_m)]).copy()
+        lib.fr_h_ladder(_p(a_ev), _p(b_ev), _p(c_ev), m, _p(w_root), _p(g_cos), _p(d))
+        d_std = np.zeros_like(d)
+        lib.fr_from_mont_batch(_p(d), _p(d_std), m)
 
     b_sel = np.asarray(dpk.b_sel)
     c_sel = np.asarray(dpk.c_sel)
 
-    def msm_g1(bases, scalars: np.ndarray):
-        b = _g1_bases_u64(bases)
-        n = min(b.shape[0], scalars.shape[0])
-        sc = np.ascontiguousarray(scalars[:n])
-        out = np.zeros(8, dtype=np.uint64)
-        lib.g1_msm_pippenger(_p(b), _p(sc), n, _pick_window(n), _p(out))
+    def msm_g1(bases, scalars: np.ndarray, tag: str):
+        with trace(f"native/msm_{tag}"):
+            b = _g1_bases_u64(bases)
+            n = min(b.shape[0], scalars.shape[0])
+            sc = np.ascontiguousarray(scalars[:n])
+            out = np.zeros(8, dtype=np.uint64)
+            lib.g1_msm_pippenger(_p(b), _p(sc), n, _pick_window(n), _p(out))
         x, y = _u64x4_to_int_arr(out.reshape(2, 4))
         return None if x == 0 and y == 0 else (x, y)
 
-    def msm_g2(bases, scalars: np.ndarray):
-        b = _g2_bases_u64(bases)
-        n = min(b.shape[0], scalars.shape[0])
-        sc = np.ascontiguousarray(scalars[:n])
-        out = np.zeros(16, dtype=np.uint64)
-        lib.g2_msm_pippenger(_p(b), _p(sc), n, _pick_window(n), _p(out))
+    def msm_g2(bases, scalars: np.ndarray, tag: str):
+        with trace(f"native/msm_{tag}"):
+            b = _g2_bases_u64(bases)
+            n = min(b.shape[0], scalars.shape[0])
+            sc = np.ascontiguousarray(scalars[:n])
+            out = np.zeros(16, dtype=np.uint64)
+            lib.g2_msm_pippenger(_p(b), _p(sc), n, _pick_window(n), _p(out))
         xc0, xc1, yc0, yc1 = _u64x4_to_int_arr(out.reshape(4, 4))
         if xc0 == xc1 == yc0 == yc1 == 0:
             return None
         return (Fq2(xc0, xc1), Fq2(yc0, yc1))
 
-    a_acc = msm_g1(dpk.a_bases, w_std)
-    b1_acc = msm_g1(dpk.b1_bases, np.ascontiguousarray(w_std[b_sel]))
-    b2_acc = msm_g2(dpk.b2_bases, np.ascontiguousarray(w_std[b_sel]))
-    c_acc = msm_g1(dpk.c_bases, np.ascontiguousarray(w_std[c_sel]))
-    h_acc = msm_g1(dpk.h_bases, d_std)
+    a_acc = msm_g1(dpk.a_bases, w_std, "a")
+    b1_acc = msm_g1(dpk.b1_bases, np.ascontiguousarray(w_std[b_sel]), "b1")
+    b2_acc = msm_g2(dpk.b2_bases, np.ascontiguousarray(w_std[b_sel]), "b2")
+    c_acc = msm_g1(dpk.c_bases, np.ascontiguousarray(w_std[c_sel]), "c")
+    h_acc = msm_g1(dpk.h_bases, d_std, "h")
     return _assemble(dpk, (a_acc, b1_acc, b2_acc, c_acc, h_acc), r, s)

@@ -109,6 +109,31 @@ class ComputeHook:
     ins: List[int]
 
 
+@dataclass
+class BlockHook:
+    """Coarse witness step: a whole gadget block's wires from one numpy
+    program.  vfn maps an (n_ins, K) int64 matrix to an (n_outs, K)
+    integer matrix — vectorized over the batch axis K AND whatever
+    internal structure the block has (time steps, rounds, lanes), which
+    is what `witness_batch` needs to amortize numpy dispatch (per-hook
+    object columns pay ~µs per op; a block pays it once per thousands of
+    wires).  The scalar `witness` path runs the same vfn with K=1, so
+    there is exactly ONE witness implementation per block — no
+    scalar/vector drift.
+
+    Contract (int64=True, the default): every input and output value fits
+    int64 (bits, bytes, u32 words, bounded sums — the SHA/DFA/packing
+    domains).  A violating value raises OverflowError at the numpy
+    boundary, loudly.  int64=False hands vfn the raw OBJECT matrix
+    (Python ints — exact field arithmetic; for blocks like one-hot lane
+    inverses that need full-width values)."""
+
+    outs: List[int]
+    vfn: Callable
+    ins: List[int]
+    int64: bool = True
+
+
 class ConstraintSystem:
     """Mutable R1CS under construction + witness program."""
 
@@ -172,6 +197,11 @@ class ConstraintSystem:
         ins = [ins] if isinstance(ins, int) else list(ins)
         self.hooks.append(ComputeHook(outs, fn, ins))
 
+    def compute_block(self, outs, vfn, ins, int64: bool = True) -> None:
+        """Register a BlockHook: all of `outs` from one numpy program
+        over `ins` (see BlockHook for the vfn contract)."""
+        self.hooks.append(BlockHook(list(outs), vfn, list(ins), int64))
+
     def witness(self, public_inputs: Sequence[int], private_inputs: Dict[int, int] | None = None) -> List[int]:
         """Run the witness program.  `public_inputs` fills wires 1..n_pub;
         `private_inputs` optionally pre-seeds private wires (for inputs that
@@ -188,6 +218,26 @@ class ConstraintSystem:
             for idx, v in private_inputs.items():
                 w[idx] = v % R
         for hook in self.hooks:
+            if isinstance(hook, BlockHook):
+                import numpy as np
+
+                mat = np.empty(
+                    (len(hook.ins), 1), dtype=np.int64 if hook.int64 else object
+                )
+                for j, i in enumerate(hook.ins):
+                    if w[i] is None:
+                        raise RuntimeError(
+                            f"witness block reads unassigned wire {i} ({self.labels.get(i)})"
+                        )
+                    mat[j, 0] = w[i]
+                res = np.asarray(hook.vfn(mat))
+                if res.shape[0] != len(hook.outs):
+                    raise RuntimeError(
+                        f"block produced {res.shape[0]} rows for {len(hook.outs)} outs"
+                    )
+                for o, v in zip(hook.outs, res[:, 0]):
+                    w[o] = int(v) % R
+                continue
             args = []
             for i in hook.ins:
                 if w[i] is None:
@@ -214,7 +264,7 @@ class ConstraintSystem:
 
     def witness_batch(
         self, inputs: Sequence[tuple], stats: Optional[Dict[str, int]] = None
-    ) -> List[List[int]]:
+    ) -> List[Sequence[int]]:
         """Vectorized witness generation: run the hook program ONCE over K
         independent inputs ([(public_inputs, private_inputs), ...]).
 
@@ -239,21 +289,44 @@ class ConstraintSystem:
         if K == 0:
             return []
 
-        def col(vals) -> np.ndarray:
-            a = np.empty(K, dtype=object)
-            for k, v in enumerate(vals):
-                a[k] = v
-            return a
+        # Two parallel (n_wires, K) matrices back the wires: W64 (int64)
+        # holds everything int64-typed blocks produce and consume — the
+        # common case, zero conversions between blocks — and W (object,
+        # exact Python ints) holds field-width values from object blocks
+        # and per-wire hooks.  Rows migrate lazily in either direction
+        # (has64/hasobj), the final extraction is one merged
+        # transpose+tolist.  (A single object matrix spent ~30% of the
+        # batch wall time converting at every int64-block boundary.)
+        W = np.empty((self.num_wires, K), dtype=object)
+        W64 = np.empty((self.num_wires, K), dtype=np.int64)
+        assigned = np.zeros(self.num_wires, dtype=bool)
+        hasobj = np.zeros(self.num_wires, dtype=bool)
+        has64 = np.zeros(self.num_wires, dtype=bool)
 
-        cols: List[Optional[np.ndarray]] = [None] * self.num_wires
-        cols[0] = col([1] * K)
+        def to64(idx: np.ndarray) -> None:
+            """Materialize int64 rows for `idx` (loud OverflowError if a
+            value exceeds the BlockHook int64 contract)."""
+            need = idx[~has64[idx]]
+            if need.shape[0]:
+                W64[need] = W[need].astype(np.int64)
+                has64[need] = True
+
+        def toobj(idx: np.ndarray) -> None:
+            need = idx[~hasobj[idx]]
+            if need.shape[0]:
+                W[need] = W64[need].astype(object)
+                hasobj[need] = True
+
+        W[0] = 1
+        assigned[0] = hasobj[0] = True
         for k, (pubs, _) in enumerate(inputs):
             if len(pubs) != self.num_public:
                 raise ValueError(
                     f"input {k}: expected {self.num_public} public inputs, got {len(pubs)}"
                 )
         for i in range(self.num_public):
-            cols[1 + i] = col([inputs[k][0][i] % R for k in range(K)])
+            W[1 + i] = [inputs[k][0][i] % R for k in range(K)]
+            assigned[1 + i] = hasobj[1 + i] = True
         seeded = set()
         for _, priv in inputs:
             seeded.update((priv or {}).keys())
@@ -266,36 +339,79 @@ class ConstraintSystem:
                         f"inputs but not input {k} — batch inputs must share a seed shape"
                     )
                 vals.append(priv[idx] % R)
-            cols[idx] = col(vals)
+            W[idx] = vals
+            assigned[idx] = hasobj[idx] = True
 
-        n_vec = n_fb = 0
+        def check_assigned(ins_idx, kind):
+            if not assigned[ins_idx].all():
+                bad = int(ins_idx[~assigned[ins_idx]][0])
+                raise RuntimeError(
+                    f"witness {kind} reads unassigned wire {bad} ({self.labels.get(bad)})"
+                )
+
+        # The hook program is static per circuit: index arrays are cached
+        # on the hooks, and the assigned-order checks run only until one
+        # full pass has validated the program (then every later batch
+        # skips them — they were ~10% of the loop's time).
+        validated = getattr(self, "_hooks_validated", False)
+        n_vec = n_fb = n_block = 0
         for hook in self.hooks:
-            args = []
-            for i in hook.ins:
-                if cols[i] is None:
+            if isinstance(hook, BlockHook):
+                ins_idx = getattr(hook, "_ins_idx", None)
+                if ins_idx is None:
+                    ins_idx = hook._ins_idx = np.asarray(hook.ins, dtype=np.intp)
+                    hook._outs_idx = np.asarray(hook.outs, dtype=np.intp)
+                if not validated:
+                    check_assigned(ins_idx, "block")
+                if hook.int64:
+                    to64(ins_idx)
+                    mat = W64[ins_idx]
+                else:
+                    toobj(ins_idx)
+                    mat = W[ins_idx]
+                res = hook.vfn(mat)
+                if not validated and res.shape != (len(hook.outs), K):
                     raise RuntimeError(
-                        f"witness hook reads unassigned wire {i} ({self.labels.get(i)})"
+                        f"block produced shape {res.shape}, expected {(len(hook.outs), K)}"
                     )
-                args.append(cols[i])
+                outs_idx = hook._outs_idx
+                if res.dtype == object:
+                    W[outs_idx] = res
+                    hasobj[outs_idx] = True
+                    has64[outs_idx] = False
+                else:
+                    W64[outs_idx] = res
+                    has64[outs_idx] = True
+                    hasobj[outs_idx] = False
+                assigned[outs_idx] = True
+                n_block += 1
+                continue
+            ins_idx = getattr(hook, "_ins_idx", None)
+            if ins_idx is None:
+                ins_idx = hook._ins_idx = np.asarray(hook.ins, dtype=np.intp)
+            if not validated:
+                check_assigned(ins_idx, "hook")
+            toobj(ins_idx)
+            args = [W[i] for i in hook.ins]
             try:
                 vals = hook.fn(*args)
                 if isinstance(vals, np.ndarray) or not isinstance(vals, (list, tuple)):
                     vals = [vals]
                 if len(vals) != len(hook.outs):
                     raise RuntimeError("arity")
-                normalized = []
-                for v in vals:
+                for o, v in zip(hook.outs, vals):
                     if isinstance(v, np.ndarray) and v.shape == (K,):
-                        normalized.append(v % R)
+                        W[o] = v % R
                     elif isinstance(v, int):  # batch-constant hook
-                        normalized.append(col([v % R] * K))
+                        W[o] = v % R
                     else:
                         raise TypeError("non-columnar hook result")
+                    assigned[o] = hasobj[o] = True
+                    has64[o] = False
                 n_vec += 1
             except Exception:
                 # Array-unsafe lambda: replay per element (exact scalar
                 # semantics; mirrors witness()'s inner loop).
-                out_vals: List[List[int]] = [[0] * K for _ in hook.outs]
                 for k in range(K):
                     a = [int(c[k]) for c in args]
                     vs = hook.fn(*a)
@@ -305,23 +421,28 @@ class ConstraintSystem:
                         raise RuntimeError(
                             f"hook produced {len(vs)} values for {len(hook.outs)} outs"
                         )
-                    for j, v in enumerate(vs):
-                        out_vals[j][k] = v % R
-                normalized = [col(vs) for vs in out_vals]
+                    for o, v in zip(hook.outs, vs):
+                        W[o, k] = v % R
+                for o in hook.outs:
+                    assigned[o] = hasobj[o] = True
+                    has64[o] = False
                 n_fb += 1
-            for o, v in zip(hook.outs, normalized):
-                cols[o] = v
 
-        missing = [i for i, v in enumerate(cols) if v is None]
-        if missing:
+        if not assigned.all():
+            missing = np.flatnonzero(~assigned)
             raise RuntimeError(
                 f"{len(missing)} unassigned wires, first: "
-                f"{[(i, self.labels.get(i)) for i in missing[:5]]}"
+                f"{[(int(i), self.labels.get(int(i))) for i in missing[:5]]}"
             )
         if stats is not None:
             stats["vectorized_hooks"] = n_vec
             stats["fallback_hooks"] = n_fb
-        return [[int(c[k]) for c in cols] for k in range(K)]
+            stats["block_hooks"] = n_block
+        toobj(np.flatnonzero(~hasobj))  # one merged materialization
+        self._hooks_validated = True
+        # Rows of W.T are (n_wires,) object arrays of exact Python ints —
+        # sequence-of-int witnesses without an 8M-element tolist pass.
+        return list(W.T)
 
     # ---------------------------------------------------------- checking
 
