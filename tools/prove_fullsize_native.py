@@ -66,9 +66,7 @@ def main():
     timing["build_circuit_s"] = round(time.time() - t, 1)
     log(f"constraints={cs.num_constraints} wires={cs.num_wires} domain={domain_size_for(cs)}")
 
-    from zkp2p_tpu.prover.keycache import circuit_digest as _digest_fn
-
-    wit_digest = _digest_fn(cs)
+    wit_digest = circuit_digest(cs)
     if os.path.exists(wit_path):
         log("loading cached witness")
         z = np.load(wit_path)
@@ -104,7 +102,7 @@ def main():
         )
         log("witness cached")
 
-    digest = circuit_digest(cs)
+    digest = wit_digest  # same circuit, one digest pass
     dpk = vk = None
     if os.path.exists(key_path):
         try:

@@ -440,9 +440,11 @@ class ConstraintSystem:
             stats["block_hooks"] = n_block
         toobj(np.flatnonzero(~hasobj))  # one merged materialization
         self._hooks_validated = True
-        # Rows of W.T are (n_wires,) object arrays of exact Python ints —
-        # sequence-of-int witnesses without an 8M-element tolist pass.
-        return list(W.T)
+        # Owned (n_wires,) object rows of exact Python ints — sequence-of-
+        # int witnesses without an 8M-element tolist pass.  Each row is
+        # COPIED out of W so retaining one witness doesn't pin the whole
+        # batch matrix.
+        return [np.array(r) for r in W.T]
 
     # ---------------------------------------------------------- checking
 
