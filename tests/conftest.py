@@ -35,6 +35,21 @@ import pytest  # noqa: E402
 
 
 def pytest_collection_modifyitems(config, items):
+    # Three tiers. default: fast semantics (<2 min). ZKP2P_RUN_SLOW=1
+    # adds the model/witness/crypto differential tests (~minutes; the
+    # committed per-round green-log tier). ZKP2P_RUN_XSLOW=1 adds the
+    # XLA-compile-heavy device-path differentials (prove_tpu / sharded
+    # prove): on this 1-core host XLA:CPU recompiles cost 2-15 min PER
+    # EXECUTABLE and cross-process cache reuse is unreliable (machine-
+    # feature-gated AOT entries), so these are exercised out-of-band —
+    # the driver's own bench.py and dryrun_multichip artifacts run the
+    # same code end-to-end (proof byte-equality + pairing verification)
+    # every round.
+    if not os.environ.get("ZKP2P_RUN_XSLOW"):
+        skipx = pytest.mark.skip(reason="xslow; set ZKP2P_RUN_XSLOW=1 (covered by driver bench/dryrun artifacts)")
+        for item in items:
+            if "xslow" in item.keywords:
+                item.add_marker(skipx)
     if os.environ.get("ZKP2P_RUN_SLOW"):
         return
     skip = pytest.mark.skip(reason="slow; set ZKP2P_RUN_SLOW=1 to run")

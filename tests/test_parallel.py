@@ -19,7 +19,7 @@ from zkp2p_tpu.parallel.mesh import make_mesh, msm_sharded, pad_to_multiple
 
 # XLA-compile-heavy: opt-in via ZKP2P_RUN_SLOW=1 (default suite must stay
 # minutes on a 1-core host; the dryrun/bench paths exercise this code too)
-pytestmark = pytest.mark.slow
+pytestmark = [pytest.mark.slow, pytest.mark.xslow]
 
 N = 11  # deliberately not a multiple of any mesh size (exercises padding)
 

@@ -15,7 +15,7 @@ from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
 
 # XLA-compile-heavy: opt-in via ZKP2P_RUN_SLOW=1 (default suite must stay
 # minutes on a 1-core host; the dryrun/bench paths exercise this code too)
-pytestmark = pytest.mark.slow
+pytestmark = [pytest.mark.slow, pytest.mark.xslow]
 
 rng = random.Random(42)
 

@@ -38,6 +38,7 @@ def world():
     return ProvingService(cs, dpk, vk, witness_fn, public_fn=lambda w: [w[1]], batch_size=2)
 
 
+@pytest.mark.xslow
 def test_spool_processing(world, tmp_path):
     spool = str(tmp_path)
     for i, (xv, yv) in enumerate([(3, 5), (2, 7), (4, 4)]):
@@ -67,3 +68,71 @@ def test_spool_processing(world, tmp_path):
     pub = [int(v) for v in load(os.path.join(spool, "r0.public.json"))]
     assert verify(world.vk, proof, pub)
     assert pub == [225]
+
+
+@pytest.fixture(scope="module")
+def batched_world(world):
+    """Same circuit, service wired through the vectorized witness tier
+    (inputs_fn + witness_batch) and a sequential native prover."""
+    from zkp2p_tpu.prover.native_prove import prove_native
+
+    cs = world.cs
+    # wire ids from the module fixture's circuit: x=2, y=3 (out=1, z=4)
+    def inputs_fn(payload):
+        x_v, y_v = int(payload["x"]), int(payload["y"])
+        return [pow(x_v * y_v, 2, R)], {2: x_v, 3: y_v}
+
+    return ProvingService(
+        cs,
+        world.dpk,
+        world.vk,
+        world.witness_fn,
+        public_fn=world.public_fn,
+        batch_size=2,
+        inputs_fn=inputs_fn,
+        prover_fn=lambda dpk, wits: [prove_native(dpk, w) for w in wits],
+        prefetch=2,
+    )
+
+
+def test_batched_service_with_native_prover(batched_world, tmp_path):
+    """witness_batch tier + per-request bad-input isolation + sequential
+    native proving, end to end through the spool."""
+    spool = str(tmp_path)
+    for i, (xv, yv) in enumerate([(3, 5), (2, 7), (6, 6), (9, 2), (5, 5)]):
+        with open(os.path.join(spool, f"r{i}.req.json"), "w") as f:
+            json.dump({"x": xv, "y": yv}, f)
+    with open(os.path.join(spool, "bad.req.json"), "w") as f:
+        json.dump({"x": "nope", "y": 1}, f)
+
+    stats = batched_world.process_dir(spool)
+    assert stats["done"] == 5
+    assert stats["error-bad-input"] == 1
+
+    from zkp2p_tpu.formats.proof_json import load, proof_from_json
+    from zkp2p_tpu.snark.groth16 import verify
+
+    for i, (xv, yv) in enumerate([(3, 5), (2, 7), (6, 6), (9, 2), (5, 5)]):
+        proof = proof_from_json(load(os.path.join(spool, f"r{i}.proof.json")))
+        pub = [int(v) for v in load(os.path.join(spool, f"r{i}.public.json"))]
+        assert verify(batched_world.vk, proof, pub)
+        assert pub == [pow(xv * yv, 2, R)]
+
+
+def test_service_restart_resumes_where_it_stopped(batched_world, tmp_path):
+    """Crash-recovery semantics (VERDICT r3 weakness 8): the spool IS the
+    durable state — a sweep after a 'crash' (simulated by deleting one
+    result, as if the process died before emitting it) reprocesses ONLY
+    the unfinished request."""
+    spool = str(tmp_path)
+    for i in range(3):
+        with open(os.path.join(spool, f"r{i}.req.json"), "w") as f:
+            json.dump({"x": 2 + i, "y": 3}, f)
+    assert batched_world.process_dir(spool)["done"] == 3
+
+    os.remove(os.path.join(spool, "r1.proof.json"))  # "crashed" mid-emit
+    stats = batched_world.process_dir(spool)
+    assert stats["done"] == 1  # only the lost one is redone
+    assert os.path.exists(os.path.join(spool, "r1.proof.json"))
+    stats2 = batched_world.process_dir(spool)
+    assert stats2 == {"done": 0, "error-bad-input": 0, "error-failed-to-prove": 0}

@@ -44,6 +44,7 @@ def test_setup_device_matches_reference():
 
 
 @pytest.mark.slow
+@pytest.mark.xslow
 def test_setup_device_proves():
     from zkp2p_tpu.prover.groth16_tpu import prove_tpu
     from zkp2p_tpu.prover.setup_device import setup_device

@@ -120,14 +120,23 @@ def test_witness_batch_16_emails_amortizes():
     single-witness wall time (block-level SHA/DFA/packing hooks; measured
     2.2x on the 1-core host, 5.5x per-witness amortization)."""
     cs, batch = _mini_venmo_batch(16)
-    t0 = time.time()
-    cs.witness(*batch[0])
-    t_single = time.time() - t0
+    # min-of-2 for both sides: first-call effects (allocator warm-up,
+    # lazy caches) otherwise dominate a sub-second measurement when the
+    # whole suite ran before this test.
+    t_single = None
+    for _ in range(2):
+        t0 = time.time()
+        cs.witness(*batch[0])
+        dt = time.time() - t0
+        t_single = dt if t_single is None else min(t_single, dt)
 
     stats = {}
-    t0 = time.time()
-    cs.witness_batch(batch, stats=stats)
-    t_batch = time.time() - t0
+    t_batch = None
+    for _ in range(2):
+        t0 = time.time()
+        cs.witness_batch(batch, stats=stats)
+        dt = time.time() - t0
+        t_batch = dt if t_batch is None else min(t_batch, dt)
     print(
         f"single={t_single:.2f}s batch16={t_batch:.2f}s "
         f"({t_batch / t_single:.1f}x single; hooks: {stats})"

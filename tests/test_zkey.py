@@ -80,6 +80,7 @@ def test_zkey_chunked(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.xslow
 def test_zkey_device_prove(tmp_path):
     """device_pk_from_zkey: the zkey-import path drives the TPU prover to
     the same proof as the ConstraintSystem path."""

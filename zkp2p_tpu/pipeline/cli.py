@@ -324,8 +324,18 @@ def cmd_service(args):
     dpk = device_pk_from_zkey(zk)
     vk = vkey_from_json(load(os.path.join(args.build_dir, "verification_key.json")))
     params, lay = meta
+    prover_fn = None
+    if getattr(args, "prover", "tpu") == "native":
+        from ..prover.native_prove import prove_native
+
+        def prover_fn(dpk_in, wits):  # sequential native batch on CPU hosts
+            return [prove_native(dpk_in, w) for w in wits]
+
     if args.circuit == "venmo":
-        svc = ProvingService.for_venmo(cs, lay, params, dpk, vk, batch_size=args.batch)
+        svc = ProvingService.for_venmo(
+            cs, lay, params, dpk, vk, batch_size=args.batch,
+            prover_fn=prover_fn, prefetch=args.prefetch,
+        )
     else:
 
         def witness_fn(payload):
@@ -337,7 +347,8 @@ def cmd_service(args):
             return cs.witness(inputs.public_signals, inputs.seed)
 
         svc = ProvingService(
-            cs, dpk, vk, witness_fn, lambda w: list(w[1 : cs.num_public + 1]), batch_size=args.batch
+            cs, dpk, vk, witness_fn, lambda w: list(w[1 : cs.num_public + 1]),
+            batch_size=args.batch, prover_fn=prover_fn, prefetch=args.prefetch,
         )
     os.makedirs(args.spool, exist_ok=True)
     _log(f"service sweeping {args.spool} (batch={args.batch})")
@@ -431,6 +442,9 @@ def main(argv=None):
     s.add_argument("--poll", type=float, default=1.0)
     s.add_argument("--max-sweeps", type=int, default=None)
     s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.add_argument("--prover", choices=["tpu", "native"], default="tpu",
+                   help="tpu: vmapped XLA batch; native: C++ runtime, sequential")
+    s.add_argument("--prefetch", type=int, default=1, help="ready-batch queue depth")
     s.set_defaults(fn=cmd_service)
 
     s = sub.add_parser("serve", help="serve the client order-book UI")

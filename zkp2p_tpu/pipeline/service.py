@@ -46,9 +46,22 @@ class ProvingService:
         public_fn: Callable[[list], list],
         batch_size: int = 4,
         max_wait_s: float = 2.0,
+        inputs_fn: Optional[Callable[[Dict], tuple]] = None,
+        prover_fn: Optional[Callable] = None,
+        prefetch: int = 1,
     ):
         """witness_fn: request payload -> witness vector (raises on bad
-        input); public_fn: witness -> public signals."""
+        input); public_fn: witness -> public signals.
+
+        inputs_fn (optional): payload -> (public_inputs, seed); when
+        given, the producer runs the whole batch through the vectorized
+        `witness_batch` tier (r1cs BlockHooks) and falls back to
+        per-request scalar witnessing if the batch evaluation fails.
+        prover_fn (optional): (dpk, [witness]) -> [Proof]; defaults to
+        the vmapped device `prove_tpu_batch` — pass a sequential
+        `prove_native` wrapper on chip-less hosts.
+        prefetch: ready-batch queue depth (witness ∥ prove overlap
+        window; 1 = classic double buffering)."""
         self.cs = cs
         self.dpk = dpk
         self.vk = vk
@@ -56,6 +69,9 @@ class ProvingService:
         self.public_fn = public_fn
         self.batch_size = batch_size
         self.max_wait_s = max_wait_s
+        self.inputs_fn = inputs_fn
+        self.prover_fn = prover_fn
+        self.prefetch = max(1, prefetch)
 
     # ------------------------------------------------------------ one pass
 
@@ -86,23 +102,57 @@ class ProvingService:
         # never races ahead of the device).  Mirrors the reference's
         # two-stage shell pipeline (2_gen_wtns.sh -> 5_gen_proof.sh),
         # overlapped instead of sequential.
-        ready_q: "queue.Queue[Optional[List[Request]]]" = queue.Queue(maxsize=1)
+        ready_q: "queue.Queue[Optional[List[Request]]]" = queue.Queue(maxsize=self.prefetch)
         producer_error: List[BaseException] = []
+
+        def scalar_witness(req: Request) -> bool:
+            try:
+                with trace("service/witness"):
+                    req.witness = self.witness_fn(req.payload)
+                    self.cs.check_witness(req.witness)
+                return True
+            except Exception as e:  # noqa: BLE001 — recorded, not silenced
+                req.error = f"error-bad-input: {e}"
+                self._emit_error(req, "error-bad-input", e)
+                stats["error-bad-input"] += 1
+                return False
+
+        def batched_witness(cand: List[Request]) -> List[Request]:
+            """Vectorized tier: per-request input derivation (errors stay
+            per request), ONE witness_batch evaluation, sample Az∘Bz=Cz
+            check (the prove step verifies a sample proof anyway); any
+            batch-level failure falls back to the scalar path."""
+            batch: List[Request] = []
+            inputs = []
+            for req in cand:
+                try:
+                    with trace("service/inputs"):
+                        inputs.append(self.inputs_fn(req.payload))
+                    batch.append(req)
+                except Exception as e:  # noqa: BLE001
+                    req.error = f"error-bad-input: {e}"
+                    self._emit_error(req, "error-bad-input", e)
+                    stats["error-bad-input"] += 1
+            if not batch:
+                return []
+            try:
+                with trace("service/witness_batch", n=len(batch)):
+                    ws = self.cs.witness_batch(inputs)
+                    self.cs.check_witness(ws[0])
+                for req, w in zip(batch, ws):
+                    req.witness = w
+                return batch
+            except Exception:  # noqa: BLE001 — batch tier is an optimization
+                return [r for r in batch if scalar_witness(r)]
 
         def produce():
             try:
                 for i in range(0, len(pending), self.batch_size):
-                    batch: List[Request] = []
-                    for req in pending[i : i + self.batch_size]:
-                        try:
-                            with trace("service/witness"):
-                                req.witness = self.witness_fn(req.payload)
-                                self.cs.check_witness(req.witness)
-                            batch.append(req)
-                        except Exception as e:  # noqa: BLE001 — recorded, not silenced
-                            req.error = f"error-bad-input: {e}"
-                            self._emit_error(req, "error-bad-input", e)
-                            stats["error-bad-input"] += 1
+                    cand = pending[i : i + self.batch_size]
+                    if self.inputs_fn is not None:
+                        batch = batched_witness(cand)
+                    else:
+                        batch = [r for r in cand if scalar_witness(r)]
                     if batch:
                         ready_q.put(batch)
             except BaseException as e:  # noqa: BLE001 — re-raised by the consumer
@@ -121,7 +171,8 @@ class ProvingService:
                 break
             try:
                 with trace("service/prove", n=len(batch)):
-                    proofs = prove_tpu_batch(self.dpk, [r.witness for r in batch])
+                    prove = self.prover_fn or prove_tpu_batch
+                    proofs = prove(self.dpk, [r.witness for r in batch])
                 # verify a sample from every batch before emitting
                 sample_pub = self.public_fn(batch[0].witness)
                 if not verify(self.vk, proofs[0], sample_pub):
@@ -163,7 +214,7 @@ class ProvingService:
 
         demo_key = make_test_key(1)
 
-        def witness_fn(payload: Dict) -> list:
+        def inputs_fn(payload: Dict) -> tuple:
             order_id = int(payload.get("order_id", 1))
             claim_id = int(payload.get("claim_id", 0))
             if "eml_path" in payload:
@@ -176,11 +227,16 @@ class ProvingService:
                 )
                 modulus = demo_key.n
             inputs = generate_inputs(email, modulus, order_id, claim_id, params, lay)
-            return cs.witness(inputs.public_signals, inputs.seed)
+            return inputs.public_signals, inputs.seed
+
+        def witness_fn(payload: Dict) -> list:
+            pubs, seed = inputs_fn(payload)
+            return cs.witness(pubs, seed)
 
         def public_fn(witness: list) -> list:
             return list(witness[1 : cs.num_public + 1])
 
+        kw.setdefault("inputs_fn", inputs_fn)
         return cls(cs, dpk, vk, witness_fn, public_fn, **kw)
 
     def run(self, spool: str, poll_s: float = 1.0, max_sweeps: Optional[int] = None) -> None:

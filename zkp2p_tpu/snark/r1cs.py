@@ -440,11 +440,12 @@ class ConstraintSystem:
             stats["block_hooks"] = n_block
         toobj(np.flatnonzero(~hasobj))  # one merged materialization
         self._hooks_validated = True
-        # Owned (n_wires,) object rows of exact Python ints — sequence-of-
-        # int witnesses without an 8M-element tolist pass.  Each row is
-        # COPIED out of W so retaining one witness doesn't pin the whole
-        # batch matrix.
-        return [np.array(r) for r in W.T]
+        # One contiguous transpose copy (per-row strided gathers cost ~4x
+        # more), then row views: W/W64 and the flag arrays are released;
+        # what stays referenced is exactly the K witness vectors.  (A
+        # caller keeping ONE witness long-term keeps its K-batch block —
+        # copy the row if that matters.)
+        return list(np.ascontiguousarray(W.T))
 
     # ---------------------------------------------------------- checking
 
