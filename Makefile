@@ -24,11 +24,11 @@ test-slow:
 
 rehearsal-dryrun:
 	@echo "== dryrun_multichip(8) under timeout 600 =="
-	time timeout 600 python -c 'import __graft_entry__ as g; g.dryrun_multichip(8)'
+	timeout 600 python -c 'import __graft_entry__ as g; g.dryrun_multichip(8)'
 
 rehearsal-bench:
 	@echo "== bench.py under timeout 900 =="
-	time timeout 900 python bench.py
+	timeout 900 python bench.py
 
 driver-rehearsal: rehearsal-dryrun rehearsal-bench
 	@echo "driver-rehearsal: ALL GREEN"
