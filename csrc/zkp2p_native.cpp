@@ -10,8 +10,11 @@
 // Field elements: 4 x 64-bit little-endian limbs, Montgomery form with
 // R = 2^256.  unsigned __int128 provides the 64x64->128 multiply.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 typedef unsigned __int128 u128;
 typedef uint64_t u64;
@@ -921,42 +924,97 @@ static inline unsigned digit_at(const u64 s[4], int bit, int c) {
   return (unsigned)(v & ((1ULL << c) - 1));
 }
 
+// One Pippenger window sum: bucket fill over all n points + suffix-sum
+// reduction.  Windows are independent, which is the parallel axis (the
+// same split rapidsnark's thread pool uses): each worker owns its bucket
+// array, the combiner pays only nwin Horner steps of c doublings.
+static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
+                          int c, int wi, G1Jac *out) {
+  long nbuckets = 1L << c;
+  G1Jac *buckets = new G1Jac[nbuckets];
+  memset(buckets, 0, (size_t)nbuckets * sizeof(G1Jac));
+  for (long i = 0; i < n; ++i) {
+    unsigned d = digit_at(scalars + 4 * i, wi * c, c);
+    if (!d) continue;
+    const u64 *x = bases_xy + 8 * i;
+    const u64 *y = x + 4;
+    if (is_zero4(x) && is_zero4(y)) continue;
+    jac_add_mixed(buckets[d], buckets[d], x, y);
+  }
+  G1Jac run, wsum;
+  memset(&run, 0, sizeof(run));
+  memset(&wsum, 0, sizeof(wsum));
+  for (long d = nbuckets - 1; d >= 1; --d) {
+    g1_add_jac(run, buckets[d]);
+    g1_add_jac(wsum, run);
+  }
+  delete[] buckets;
+  *out = wsum;
+}
+
+static void g2_window_sum(const u64 *bases, const u64 *scalars, long n,
+                          int c, int wi, G2Jac *out) {
+  long nbuckets = 1L << c;
+  G2Jac *buckets = new G2Jac[nbuckets];
+  memset(buckets, 0, (size_t)nbuckets * sizeof(G2Jac));
+  for (long i = 0; i < n; ++i) {
+    unsigned d = digit_at(scalars + 4 * i, wi * c, c);
+    if (!d) continue;
+    const u64 *b = bases + 16 * i;
+    Fp2 x2, y2;
+    memcpy(x2.c0, b, 32);
+    memcpy(x2.c1, b + 4, 32);
+    memcpy(y2.c0, b + 8, 32);
+    memcpy(y2.c1, b + 12, 32);
+    if (fp2_is_zero(x2) && fp2_is_zero(y2)) continue;
+    g2_add_mixed(buckets[d], buckets[d], x2, y2);
+  }
+  G2Jac run, wsum;
+  memset(&run, 0, sizeof(run));
+  memset(&wsum, 0, sizeof(wsum));
+  for (long d = nbuckets - 1; d >= 1; --d) {
+    g2_add(run, buckets[d]);
+    g2_add(wsum, run);
+  }
+  delete[] buckets;
+  *out = wsum;
+}
+
 extern "C" {
 
 // Variable-base Pippenger MSM over G1.  bases: n x 8 u64 affine
 // Montgomery ((0,0) = infinity); scalars: n x 4 u64 STANDARD form
 // (< r); out_xy: 8 u64 affine STANDARD form, (0,0) = infinity.
 // Window width c is caller-chosen (glue picks ~log2(n)-7, clamped).
-void g1_msm_pippenger(const u64 *bases_xy, const u64 *scalars, long n,
-                      int c, u64 *out_xy) {
+// n_threads > 1 computes window sums on worker threads (per-thread
+// bucket memory: 96 B * 2^c each).
+void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
+                         int c, int n_threads, u64 *out_xy) {
   int nwin = (254 + c - 1) / c;
-  long nbuckets = 1L << c;
-  G1Jac *buckets = new G1Jac[nbuckets];
+  G1Jac *wins = new G1Jac[nwin];
+  if (n_threads > 1) {
+    std::vector<std::thread> pool;
+    std::atomic<int> next(0);
+    for (int t = 0; t < n_threads && t < nwin; ++t) {
+      pool.emplace_back([&]() {
+        int wi;
+        while ((wi = next.fetch_add(1)) < nwin)
+          g1_window_sum(bases_xy, scalars, n, c, wi, &wins[wi]);
+      });
+    }
+    for (auto &th : pool) th.join();
+  } else {
+    for (int wi = 0; wi < nwin; ++wi)
+      g1_window_sum(bases_xy, scalars, n, c, wi, &wins[wi]);
+  }
   G1Jac acc;
   memset(&acc, 0, sizeof(acc));
   for (int wi = nwin - 1; wi >= 0; --wi) {
     if (wi != nwin - 1)
       for (int k = 0; k < c; ++k) jac_double(acc, acc);
-    memset(buckets, 0, (size_t)nbuckets * sizeof(G1Jac));
-    for (long i = 0; i < n; ++i) {
-      unsigned d = digit_at(scalars + 4 * i, wi * c, c);
-      if (!d) continue;
-      const u64 *x = bases_xy + 8 * i;
-      const u64 *y = x + 4;
-      if (is_zero4(x) && is_zero4(y)) continue;
-      jac_add_mixed(buckets[d], buckets[d], x, y);
-    }
-    // bucket reduction: sum_d d * bucket[d] via running suffix sums
-    G1Jac run, wsum;
-    memset(&run, 0, sizeof(run));
-    memset(&wsum, 0, sizeof(wsum));
-    for (long d = nbuckets - 1; d >= 1; --d) {
-      g1_add_jac(run, buckets[d]);
-      g1_add_jac(wsum, run);
-    }
-    g1_add_jac(acc, wsum);
+    g1_add_jac(acc, wins[wi]);
   }
-  delete[] buckets;
+  delete[] wins;
   if (is_zero4(acc.Z)) {
     memset(out_xy, 0, 64);
     return;
@@ -971,14 +1029,33 @@ void g1_msm_pippenger(const u64 *bases_xy, const u64 *scalars, long n,
   fp_from_mont(my, out_xy + 4, 1);
 }
 
+void g1_msm_pippenger(const u64 *bases_xy, const u64 *scalars, long n,
+                      int c, u64 *out_xy) {
+  g1_msm_pippenger_mt(bases_xy, scalars, n, c, 1, out_xy);
+}
+
 // Variable-base Pippenger MSM over G2.  bases: n x 16 u64 affine
 // Montgomery (x.c0, x.c1, y.c0, y.c1; all-zero = infinity); scalars
 // standard form; out: 16 u64 affine STANDARD form, all-zero = infinity.
-void g2_msm_pippenger(const u64 *bases, const u64 *scalars, long n,
-                      int c, u64 *out) {
+void g2_msm_pippenger_mt(const u64 *bases, const u64 *scalars, long n,
+                         int c, int n_threads, u64 *out) {
   int nwin = (254 + c - 1) / c;
-  long nbuckets = 1L << c;
-  G2Jac *buckets = new G2Jac[nbuckets];
+  G2Jac *wins = new G2Jac[nwin];
+  if (n_threads > 1) {
+    std::vector<std::thread> pool;
+    std::atomic<int> next(0);
+    for (int t = 0; t < n_threads && t < nwin; ++t) {
+      pool.emplace_back([&]() {
+        int wi;
+        while ((wi = next.fetch_add(1)) < nwin)
+          g2_window_sum(bases, scalars, n, c, wi, &wins[wi]);
+      });
+    }
+    for (auto &th : pool) th.join();
+  } else {
+    for (int wi = 0; wi < nwin; ++wi)
+      g2_window_sum(bases, scalars, n, c, wi, &wins[wi]);
+  }
   G2Jac acc;
   memset(&acc, 0, sizeof(acc));
   for (int wi = nwin - 1; wi >= 0; --wi) {
@@ -988,29 +1065,9 @@ void g2_msm_pippenger(const u64 *bases, const u64 *scalars, long n,
         g2_double(d2, acc);
         acc = d2;
       }
-    memset(buckets, 0, (size_t)nbuckets * sizeof(G2Jac));
-    for (long i = 0; i < n; ++i) {
-      unsigned d = digit_at(scalars + 4 * i, wi * c, c);
-      if (!d) continue;
-      const u64 *b = bases + 16 * i;
-      Fp2 x2, y2;
-      memcpy(x2.c0, b, 32);
-      memcpy(x2.c1, b + 4, 32);
-      memcpy(y2.c0, b + 8, 32);
-      memcpy(y2.c1, b + 12, 32);
-      if (fp2_is_zero(x2) && fp2_is_zero(y2)) continue;
-      g2_add_mixed(buckets[d], buckets[d], x2, y2);
-    }
-    G2Jac run, wsum;
-    memset(&run, 0, sizeof(run));
-    memset(&wsum, 0, sizeof(wsum));
-    for (long d = nbuckets - 1; d >= 1; --d) {
-      g2_add(run, buckets[d]);
-      g2_add(wsum, run);
-    }
-    g2_add(acc, wsum);
+    g2_add(acc, wins[wi]);
   }
-  delete[] buckets;
+  delete[] wins;
   if (fp2_is_zero(acc.Z)) {
     memset(out, 0, 128);
     return;
@@ -1025,6 +1082,11 @@ void g2_msm_pippenger(const u64 *bases, const u64 *scalars, long n,
   fp_from_mont(mx.c1, out + 4, 1);
   fp_from_mont(my.c0, out + 8, 1);
   fp_from_mont(my.c1, out + 12, 1);
+}
+
+void g2_msm_pippenger(const u64 *bases, const u64 *scalars, long n,
+                      int c, u64 *out) {
+  g2_msm_pippenger_mt(bases, scalars, n, c, 1, out);
 }
 
 }  // extern "C"

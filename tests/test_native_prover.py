@@ -79,12 +79,24 @@ def test_g1_msm_pippenger_matches_host():
     scalars = [rng.randrange(R) for _ in range(n - 1)] + [0]
     b = _g1_bases_u64(g1_to_affine_arrays(pts))
     sc = _np_from_ints(scalars)
+    want = g1_msm(pts, scalars)
     for c in (4, 8, 13):
         out = np.zeros(8, dtype=np.uint64)
         lib.g1_msm_pippenger(_p(b), _p(sc), n, c, _p(out))
         x, y = _ints_from_np(out.reshape(2, 4))
         got = None if x == 0 and y == 0 else (x, y)
-        assert got == g1_msm(pts, scalars), f"window {c}"
+        assert got == want, f"window {c}"
+    # threaded variant: same result with worker threads over windows
+    import ctypes
+
+    out = np.zeros(8, dtype=np.uint64)
+    lib.g1_msm_pippenger_mt.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.g1_msm_pippenger_mt(_p(b), _p(sc), n, 8, 3, _p(out))
+    x, y = _ints_from_np(out.reshape(2, 4))
+    assert (None if x == 0 and y == 0 else (x, y)) == want, "threaded msm"
 
 
 def test_g2_msm_pippenger_matches_host():

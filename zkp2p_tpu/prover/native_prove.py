@@ -51,6 +51,8 @@ def _lib():
         lib.fr_h_ladder.argtypes = [_u64p, _u64p, _u64p, ctypes.c_long, _u64p, _u64p, _u64p]
         lib.g1_msm_pippenger.argtypes = [_u64p, _u64p, ctypes.c_long, ctypes.c_int, _u64p]
         lib.g2_msm_pippenger.argtypes = [_u64p, _u64p, ctypes.c_long, ctypes.c_int, _u64p]
+        lib.g1_msm_pippenger_mt.argtypes = [_u64p, _u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, _u64p]
+        lib.g2_msm_pippenger_mt.argtypes = [_u64p, _u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, _u64p]
         # Self-test the Fr multiplier before trusting proofs to it (the
         # same covenant native/lib.py applies to the Fq side).
         a, b = R - 987654321, 0xFEDCBA9876543210 << 128 | 0x42
@@ -109,6 +111,18 @@ def _pick_window(n: int) -> int:
     return max(4, min(16, n.bit_length() - 7))
 
 
+def _n_threads() -> int:
+    """MSM worker threads: ZKP2P_NATIVE_THREADS, else the core count —
+    the parallel axis is per-window (rapidsnark's split); on the 1-core
+    build host this resolves to 1 and the code path stays sequential."""
+    import os
+
+    v = os.environ.get("ZKP2P_NATIVE_THREADS")
+    if v:
+        return max(1, int(v))
+    return max(1, os.cpu_count() or 1)
+
+
 def prove_native(
     dpk: DeviceProvingKey,
     witness: Sequence[int],
@@ -163,13 +177,15 @@ def prove_native(
     b_sel = np.asarray(dpk.b_sel)
     c_sel = np.asarray(dpk.c_sel)
 
+    threads = _n_threads()
+
     def msm_g1(bases, scalars: np.ndarray, tag: str):
         with trace(f"native/msm_{tag}"):
             b = _g1_bases_u64(bases)
             n = min(b.shape[0], scalars.shape[0])
             sc = np.ascontiguousarray(scalars[:n])
             out = np.zeros(8, dtype=np.uint64)
-            lib.g1_msm_pippenger(_p(b), _p(sc), n, _pick_window(n), _p(out))
+            lib.g1_msm_pippenger_mt(_p(b), _p(sc), n, _pick_window(n), threads, _p(out))
         x, y = _u64x4_to_int_arr(out.reshape(2, 4))
         return None if x == 0 and y == 0 else (x, y)
 
@@ -179,7 +195,7 @@ def prove_native(
             n = min(b.shape[0], scalars.shape[0])
             sc = np.ascontiguousarray(scalars[:n])
             out = np.zeros(16, dtype=np.uint64)
-            lib.g2_msm_pippenger(_p(b), _p(sc), n, _pick_window(n), _p(out))
+            lib.g2_msm_pippenger_mt(_p(b), _p(sc), n, _pick_window(n), threads, _p(out))
         xc0, xc1, yc0, yc1 = _u64x4_to_int_arr(out.reshape(4, 4))
         if xc0 == xc1 == yc0 == yc1 == 0:
             return None
