@@ -12,6 +12,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -846,8 +847,7 @@ void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
   u64 gm[4];
   fr_mul(gm, g_std, R2R);
   u64 *vecs[3] = {a, b, c};
-  for (int k = 0; k < 3; ++k) {
-    u64 *v = vecs[k];
+  auto ladder_one = [&](u64 *v) {
     fr_ntt(v, m, winv_std, minv_std);  // iNTT: evals -> coefficients
     // coset shift: coeff[j] *= g^j (running power)
     u64 p[4];
@@ -857,6 +857,17 @@ void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
       fr_mul(v + 4 * j, v + 4 * j, p);
     }
     fr_ntt(v, m, w_std, ONE_STD);  // forward: coefficients -> coset evals
+  };
+  // The three polynomial ladders are independent: thread them when the
+  // host has cores to spare (same env-driven knob as the MSM pool).
+  const char *tenv = getenv("ZKP2P_NATIVE_THREADS");
+  int nt = tenv ? atoi(tenv) : (int)std::thread::hardware_concurrency();
+  if (nt > 1) {
+    std::vector<std::thread> pool;
+    for (int k = 0; k < 3; ++k) pool.emplace_back(ladder_one, vecs[k]);
+    for (auto &th : pool) th.join();
+  } else {
+    for (int k = 0; k < 3; ++k) ladder_one(vecs[k]);
   }
   for (long j = 0; j < m; ++j) {
     u64 t[4];
