@@ -81,23 +81,53 @@ def _limbs16_to_u64(a: np.ndarray) -> np.ndarray:
     return a16.view("<u8").reshape(*a.shape[:-1], 4)
 
 
+# Base conversions are pure functions of the (immutable) key arrays:
+# memoized per AffPoint identity so a service proving many requests
+# against one DeviceProvingKey converts each MSM's bases ONCE (at full
+# size the five conversions cost seconds per proof otherwise).  Each
+# entry pins the source arrays, so an id() key cannot be reused while
+# its entry is alive; a small cap bounds test-suite churn.
+_bases_cache: dict = {}
+_BASES_CACHE_CAP = 16
+
+
+def _bases_memo(bases, convert):
+    key = (id(bases[0]), id(bases[1]))
+    hit = _bases_cache.get(key)
+    if hit is not None and hit[0] is bases[0] and hit[1] is bases[1]:
+        return hit[2]
+    out = convert(bases)
+    if len(_bases_cache) >= _BASES_CACHE_CAP:
+        _bases_cache.pop(next(iter(_bases_cache)))
+    _bases_cache[key] = (bases[0], bases[1], out)
+    return out
+
+
 def _g1_bases_u64(bases) -> np.ndarray:
     """AffPoint ((n,16),(n,16)) Montgomery limbs -> (n, 8) u64."""
-    x, y = (np.asarray(b) for b in bases)
-    return np.ascontiguousarray(
-        np.concatenate([_limbs16_to_u64(x), _limbs16_to_u64(y)], axis=-1)
-    )
+
+    def convert(b):
+        x, y = (np.asarray(c) for c in b)
+        return np.ascontiguousarray(
+            np.concatenate([_limbs16_to_u64(x), _limbs16_to_u64(y)], axis=-1)
+        )
+
+    return _bases_memo(bases, convert)
 
 
 def _g2_bases_u64(bases) -> np.ndarray:
     """AffPoint ((n,2,16),(n,2,16)) -> (n, 16) u64 (x.c0 x.c1 y.c0 y.c1)."""
-    x, y = (np.asarray(b) for b in bases)
-    n = x.shape[0]
-    return np.ascontiguousarray(
-        np.concatenate(
-            [_limbs16_to_u64(x).reshape(n, 8), _limbs16_to_u64(y).reshape(n, 8)], axis=-1
+
+    def convert(b):
+        x, y = (np.asarray(c) for c in b)
+        n = x.shape[0]
+        return np.ascontiguousarray(
+            np.concatenate(
+                [_limbs16_to_u64(x).reshape(n, 8), _limbs16_to_u64(y).reshape(n, 8)], axis=-1
+            )
         )
-    )
+
+    return _bases_memo(bases, convert)
 
 
 def _u64x4_to_int_arr(a: np.ndarray) -> list:
@@ -150,19 +180,37 @@ def prove_native(
         lib.fr_to_mont_batch(_p(w_std), _p(w_mont), n_wires)
 
     # Az/Bz/Cz evaluations on the domain (Cz = Az . Bz pointwise, valid
-    # for a satisfying witness — same shortcut as abc_evals).
+    # for a satisfying witness — same shortcut as abc_evals).  The A and
+    # B matvecs are independent and ctypes releases the GIL, so they run
+    # on two Python threads when the host has cores.
     a_ev = np.zeros((m, 4), dtype=np.uint64)
     b_ev = np.zeros((m, 4), dtype=np.uint64)
     c_ev = np.zeros((m, 4), dtype=np.uint64)
     with trace("native/matvec"):
-        for coeff, wire, row, out in (
-            (dpk.a_coeff, dpk.a_wire, dpk.a_row, a_ev),
-            (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
-        ):
-            cf = np.ascontiguousarray(_limbs16_to_u64(np.asarray(coeff)))
+        def matvec(coeff, wire, row, out):
+            cf = _bases_memo(
+                (coeff, coeff),
+                lambda b: np.ascontiguousarray(_limbs16_to_u64(np.asarray(b[0]))),
+            )
             wi = np.ascontiguousarray(np.asarray(wire, dtype=np.uint32))
             ro = np.ascontiguousarray(np.asarray(row, dtype=np.uint32))
             lib.fr_matvec(_p(cf), _p32(wi), _p32(ro), cf.shape[0], _p(w_mont), m, _p(out))
+
+        jobs = [
+            (dpk.a_coeff, dpk.a_wire, dpk.a_row, a_ev),
+            (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
+        ]
+        if _n_threads() > 1:
+            import threading
+
+            ts = [threading.Thread(target=matvec, args=j) for j in jobs]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        else:
+            for j in jobs:
+                matvec(*j)
         lib.fr_mul_batch(_p(a_ev), _p(b_ev), _p(c_ev), m)
 
     # H ladder: d_j = (A.B - C)(g . w^j), Montgomery -> standard scalars.
