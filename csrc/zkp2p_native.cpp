@@ -991,6 +991,26 @@ static void g2_window_sum(const u64 *bases, const u64 *scalars, long n,
   *out = wsum;
 }
 
+// Run window sums 0..nwin-1 through `sum_one(wi, &out[wi])`, on worker
+// threads pulling from an atomic queue when n_threads > 1.  Shared by
+// the G1 and G2 MSMs (one driver to tune, not two copies).
+template <typename P, typename F>
+static void run_window_sums(int nwin, int n_threads, P *wins, F sum_one) {
+  if (n_threads > 1) {
+    std::vector<std::thread> pool;
+    std::atomic<int> next(0);
+    for (int t = 0; t < n_threads && t < nwin; ++t) {
+      pool.emplace_back([&]() {
+        int wi;
+        while ((wi = next.fetch_add(1)) < nwin) sum_one(wi, &wins[wi]);
+      });
+    }
+    for (auto &th : pool) th.join();
+  } else {
+    for (int wi = 0; wi < nwin; ++wi) sum_one(wi, &wins[wi]);
+  }
+}
+
 extern "C" {
 
 // Variable-base Pippenger MSM over G1.  bases: n x 8 u64 affine
@@ -1003,21 +1023,9 @@ void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out_xy) {
   int nwin = (254 + c - 1) / c;
   G1Jac *wins = new G1Jac[nwin];
-  if (n_threads > 1) {
-    std::vector<std::thread> pool;
-    std::atomic<int> next(0);
-    for (int t = 0; t < n_threads && t < nwin; ++t) {
-      pool.emplace_back([&]() {
-        int wi;
-        while ((wi = next.fetch_add(1)) < nwin)
-          g1_window_sum(bases_xy, scalars, n, c, wi, &wins[wi]);
-      });
-    }
-    for (auto &th : pool) th.join();
-  } else {
-    for (int wi = 0; wi < nwin; ++wi)
-      g1_window_sum(bases_xy, scalars, n, c, wi, &wins[wi]);
-  }
+  run_window_sums(nwin, n_threads, wins, [&](int wi, G1Jac *o) {
+    g1_window_sum(bases_xy, scalars, n, c, wi, o);
+  });
   G1Jac acc;
   memset(&acc, 0, sizeof(acc));
   for (int wi = nwin - 1; wi >= 0; --wi) {
@@ -1052,21 +1060,9 @@ void g2_msm_pippenger_mt(const u64 *bases, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out) {
   int nwin = (254 + c - 1) / c;
   G2Jac *wins = new G2Jac[nwin];
-  if (n_threads > 1) {
-    std::vector<std::thread> pool;
-    std::atomic<int> next(0);
-    for (int t = 0; t < n_threads && t < nwin; ++t) {
-      pool.emplace_back([&]() {
-        int wi;
-        while ((wi = next.fetch_add(1)) < nwin)
-          g2_window_sum(bases, scalars, n, c, wi, &wins[wi]);
-      });
-    }
-    for (auto &th : pool) th.join();
-  } else {
-    for (int wi = 0; wi < nwin; ++wi)
-      g2_window_sum(bases, scalars, n, c, wi, &wins[wi]);
-  }
+  run_window_sums(nwin, n_threads, wins, [&](int wi, G2Jac *o) {
+    g2_window_sum(bases, scalars, n, c, wi, o);
+  });
   G2Jac acc;
   memset(&acc, 0, sizeof(acc));
   for (int wi = nwin - 1; wi >= 0; --wi) {

@@ -97,9 +97,10 @@ class ProvingService:
 
         # Pipeline overlap (SURVEY.md §2.7 "witness ∥ prove"): witness
         # generation is host CPU, proving is device compute — a producer
-        # thread builds batch i+1's witnesses while the device proves
-        # batch i (the queue holds at most one ready batch, so the spool
-        # never races ahead of the device).  Mirrors the reference's
+        # thread builds upcoming batches while the device proves the
+        # current one.  The queue holds at most `prefetch` ready batches
+        # (so up to prefetch+1 batches of witnesses may be live; size the
+        # knob with host memory in mind).  Mirrors the reference's
         # two-stage shell pipeline (2_gen_wtns.sh -> 5_gen_proof.sh),
         # overlapped instead of sequential.
         ready_q: "queue.Queue[Optional[List[Request]]]" = queue.Queue(maxsize=self.prefetch)
@@ -138,8 +139,12 @@ class ProvingService:
             try:
                 with trace("service/witness_batch", n=len(batch)):
                     ws = self.cs.witness_batch(inputs)
-                    self.cs.check_witness(ws[0])
+                # EVERY witness gets the Az∘Bz=Cz self-check, exactly like
+                # the scalar tier — only checking a sample would let an
+                # unsatisfying witness at index > 0 ship an invalid proof
+                # as done (the consumer pairing-verifies one sample too).
                 for req, w in zip(batch, ws):
+                    self.cs.check_witness(w)
                     req.witness = w
                 return batch
             except Exception:  # noqa: BLE001 — batch tier is an optimization

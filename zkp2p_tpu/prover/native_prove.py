@@ -149,7 +149,10 @@ def _n_threads() -> int:
 
     v = os.environ.get("ZKP2P_NATIVE_THREADS")
     if v:
-        return max(1, int(v))
+        try:
+            return max(1, int(v))
+        except ValueError:  # malformed value degrades to sequential,
+            return 1  # matching the C++ side's atoi behavior
     return max(1, os.cpu_count() or 1)
 
 
@@ -201,13 +204,13 @@ def prove_native(
             (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
         ]
         if _n_threads() > 1:
-            import threading
+            # futures, not bare Threads: a worker exception must abort the
+            # prove, not leave a zeroed evaluation vector behind.
+            from concurrent.futures import ThreadPoolExecutor
 
-            ts = [threading.Thread(target=matvec, args=j) for j in jobs]
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                for f in [ex.submit(matvec, *j) for j in jobs]:
+                    f.result()
         else:
             for j in jobs:
                 matvec(*j)
