@@ -939,8 +939,29 @@ static inline unsigned digit_at(const u64 s[4], int bit, int c) {
 // reduction.  Windows are independent, which is the parallel axis (the
 // same split rapidsnark's thread pool uses): each worker owns its bucket
 // array, the combiner pays only nwin Horner steps of c doublings.
-static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
-                          int c, int wi, G1Jac *out) {
+//
+// The G1 fill uses BATCH-AFFINE bucket accumulation (the gnark/arkworks
+// trick): buckets live as affine points, each bucket add is an
+// affine+affine add whose one field inversion is amortized across a
+// whole chunk by the Montgomery batch-inverse — ~7 muls per add instead
+// of the ~12 of a mixed-Jacobian add, on the op that is ~85% of the MSM.
+// Same-chunk bucket collisions are deferred to the next pass (rare:
+// chunk << 2^c).
+
+struct AffPt {
+  u64 x[4], y[4];  // Montgomery; (0,0) = empty bucket
+};
+
+static inline bool aff_is_empty(const AffPt &p) {
+  return is_zero4(p.x) && is_zero4(p.y);
+}
+
+// Plain mixed-Jacobian fill: the fallback for windows whose effective
+// digit range is tiny (the TOP window often has only a few bits: its
+// points pile into a handful of buckets and the batch-affine conflict
+// queue degenerates into near-serial passes).
+static void g1_window_sum_jac(const u64 *bases_xy, const u64 *scalars, long n,
+                              int c, int wi, G1Jac *out) {
   long nbuckets = 1L << c;
   G1Jac *buckets = new G1Jac[nbuckets];
   memset(buckets, 0, (size_t)nbuckets * sizeof(G1Jac));
@@ -960,6 +981,173 @@ static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
     g1_add_jac(wsum, run);
   }
   delete[] buckets;
+  *out = wsum;
+}
+
+static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
+                          int c, int wi, G1Jac *out) {
+  const long nbuckets = 1L << c;
+  const long B = 2048;  // chunk size for the shared inversion
+  int bits_here = 254 - wi * c;
+  if (bits_here > c) bits_here = c;
+  if (bits_here < 1 || (1L << bits_here) < 4 * B) {
+    g1_window_sum_jac(bases_xy, scalars, n, c, wi, out);
+    return;
+  }
+  AffPt *bk = new AffPt[nbuckets]();
+  int *stamp = new int[nbuckets];
+  memset(stamp, 0xff, nbuckets * sizeof(int));
+
+  std::vector<long> cur, next;
+  cur.reserve(n);
+  for (long i = 0; i < n; ++i) {
+    unsigned d = digit_at(scalars + 4 * i, wi * c, c);
+    if (!d) continue;
+    const u64 *x = bases_xy + 8 * i;
+    if (is_zero4(x) && is_zero4(x + 4)) continue;
+    cur.push_back(i);
+  }
+
+  // scheduled-add scratch (per chunk)
+  long *add_bkt = new long[B];
+  long *add_pt = new long[B];
+  u64 (*den)[4] = new u64[B][4];
+  u64 (*num)[4] = new u64[B][4];   // lambda numerator
+  u64 (*prod)[4] = new u64[B][4];  // batch-inverse prefix products
+
+  int chunk_id = 0;
+  while (!cur.empty()) {
+    next.clear();
+    size_t processed = 0;
+    bool bail = false;
+    for (size_t lo = 0; lo < cur.size() && !bail; lo += B, ++chunk_id) {
+      size_t hi = lo + B < cur.size() ? lo + B : cur.size();
+      long m = 0;
+      for (size_t k = lo; k < hi; ++k) {
+        long i = cur[k];
+        long b = digit_at(scalars + 4 * i, wi * c, c);
+        if (stamp[b] == chunk_id) {  // bucket already touched this chunk
+          next.push_back(i);
+          continue;
+        }
+        stamp[b] = chunk_id;
+        const u64 *px = bases_xy + 8 * i;
+        const u64 *py = px + 4;
+        if (aff_is_empty(bk[b])) {  // install: no field ops at all
+          memcpy(bk[b].x, px, 32);
+          memcpy(bk[b].y, py, 32);
+          continue;
+        }
+        if (memcmp(bk[b].x, px, 32) == 0) {
+          if (memcmp(bk[b].y, py, 32) == 0) {
+            // doubling: lambda = 3x^2 / 2y
+            u64 x2[4], t[4];
+            mont_sqr(x2, px);
+            add_mod(t, x2, x2);
+            add_mod(num[m], t, x2);
+            add_mod(den[m], py, py);
+          } else {
+            // p + (-p): bucket becomes empty
+            memset(&bk[b], 0, sizeof(AffPt));
+            continue;
+          }
+        } else {
+          // chord: lambda = (y2 - y1) / (x2 - x1), 1 = bucket, 2 = point
+          sub_mod(num[m], py, bk[b].y);
+          sub_mod(den[m], px, bk[b].x);
+        }
+        add_bkt[m] = b;
+        add_pt[m] = i;
+        ++m;
+      }
+      processed = hi;  // BEFORE the m==0 continue: install-only chunks
+                       // are processed too (the bail tail starts here)
+      if (!m) {
+        if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
+        continue;
+      }
+      // batch inversion of den[0..m): prefix products + one inversion
+      u64 run[4];
+      memcpy(run, ONE_MONT, 32);
+      for (long j = 0; j < m; ++j) {
+        memcpy(prod[j], run, 32);  // product of dens before j
+        mont_mul(run, run, den[j]);
+      }
+      u64 inv_all[4];
+      mont_inv(inv_all, run);
+      for (long j = m - 1; j >= 0; --j) {
+        u64 dinv[4];
+        mont_mul(dinv, inv_all, prod[j]);      // 1/den[j]
+        mont_mul(inv_all, inv_all, den[j]);    // strip den[j]
+        long b = add_bkt[j];
+        const u64 *px = bases_xy + 8 * add_pt[j];
+        u64 lam[4], lam2[4], x3[4], y3[4], t[4];
+        mont_mul(lam, num[j], dinv);
+        mont_sqr(lam2, lam);
+        // x3 = lam^2 - x1 - x2 ; y3 = lam (x1 - x3) - y1
+        sub_mod(x3, lam2, bk[b].x);
+        sub_mod(x3, x3, px);
+        sub_mod(t, bk[b].x, x3);
+        mont_mul(t, lam, t);
+        sub_mod(y3, t, bk[b].y);
+        memcpy(bk[b].x, x3, 32);
+        memcpy(bk[b].y, y3, 32);
+      }
+      // Concentrated digits (witness scalars are mostly bits: window 0
+      // sees thousands of digit-1 points) defer most of every chunk —
+      // batch-affine degenerates into a pass per point.  Bail to
+      // mixed-Jacobian for whatever remains.
+      if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
+    }
+    if (bail || next.size() * 4 > cur.size()) {
+      // Finish all unfinished points (deferred + the unprocessed tail of
+      // this pass) with plain mixed-Jacobian adds into a parallel bucket
+      // array, then reduce both arrays together.
+      G1Jac *jb = new G1Jac[nbuckets];
+      memset(jb, 0, (size_t)nbuckets * sizeof(G1Jac));
+      next.insert(next.end(), cur.begin() + processed, cur.end());
+      for (long i : next) {
+        long b = digit_at(scalars + 4 * i, wi * c, c);
+        const u64 *x = bases_xy + 8 * i;
+        jac_add_mixed(jb[b], jb[b], x, x + 4);
+      }
+      G1Jac run, wsum;
+      memset(&run, 0, sizeof(run));
+      memset(&wsum, 0, sizeof(wsum));
+      for (long d = nbuckets - 1; d >= 1; --d) {
+        g1_add_jac(run, jb[d]);
+        if (!aff_is_empty(bk[d])) jac_add_mixed(run, run, bk[d].x, bk[d].y);
+        g1_add_jac(wsum, run);
+      }
+      delete[] jb;
+      delete[] bk;
+      delete[] stamp;
+      delete[] add_bkt;
+      delete[] add_pt;
+      delete[] den;
+      delete[] num;
+      delete[] prod;
+      *out = wsum;
+      return;
+    }
+    cur.swap(next);
+  }
+
+  // suffix-sum reduction over affine buckets (mixed adds into Jacobian)
+  G1Jac run, wsum;
+  memset(&run, 0, sizeof(run));
+  memset(&wsum, 0, sizeof(wsum));
+  for (long d = nbuckets - 1; d >= 1; --d) {
+    if (!aff_is_empty(bk[d])) jac_add_mixed(run, run, bk[d].x, bk[d].y);
+    g1_add_jac(wsum, run);
+  }
+  delete[] bk;
+  delete[] stamp;
+  delete[] add_bkt;
+  delete[] add_pt;
+  delete[] den;
+  delete[] num;
+  delete[] prod;
   *out = wsum;
 }
 

@@ -99,6 +99,36 @@ def test_g1_msm_pippenger_matches_host():
     assert (None if x == 0 and y == 0 else (x, y)) == want, "threaded msm"
 
 
+def test_g1_msm_witness_like_scalars():
+    """Witness-shaped scalar distributions (mostly bits/bytes, a few
+    field elements) concentrate digits into a handful of buckets — the
+    batch-affine fill's conflict/bail path.  Regression for the
+    install-only-chunk `processed` bug that double-counted points."""
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_msm, g1_mul
+    from zkp2p_tpu.curve.jcurve import g1_to_affine_arrays
+    from zkp2p_tpu.prover.native_prove import _g1_bases_u64, _lib, _p
+
+    lib = _lib()
+    n = 600
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(n)]
+    cases = [
+        [65533, 3, 255, 255, 255],  # the minimal shrunk failure
+        [rng.choice([0, 1, 1, 1, 255, 2**16 - 3]) for _ in range(n)],
+        [rng.choice([3, 255, 65533]) for _ in range(n)],
+        [1] * n,
+    ]
+    for scalars in cases:
+        p = pts[: len(scalars)]
+        b = _g1_bases_u64(g1_to_affine_arrays(p))
+        sc = _np_from_ints(scalars)
+        for c in (8, 13, 15):
+            out = np.zeros(8, dtype=np.uint64)
+            lib.g1_msm_pippenger(_p(b), _p(sc), len(p), c, _p(out))
+            x, y = _ints_from_np(out.reshape(2, 4))
+            got = None if x == 0 and y == 0 else (x, y)
+            assert got == g1_msm(p, scalars), (len(p), c, scalars[:5])
+
+
 def test_g2_msm_pippenger_matches_host():
     from zkp2p_tpu.curve.host import G2_GENERATOR, g2_msm, g2_mul
     from zkp2p_tpu.curve.jcurve import g2_to_affine_arrays

@@ -136,10 +136,10 @@ def _u64x4_to_int_arr(a: np.ndarray) -> list:
 
 
 def _pick_window(n: int) -> int:
-    """Pippenger window: ~log2(n) - 6 balances the n-add bucket fill
-    against the 2^(c+1) reduction adds per window (empirical sweep at
-    n=2^19 on this host: c=13 4.42s, c=14 4.30s, flat through 16)."""
-    return max(4, min(16, n.bit_length() - 6))
+    """Pippenger window: ~log2(n) - 5 balances the n-add batch-affine
+    bucket fill against the 2^(c+1) reduction adds per window (empirical
+    sweep at n=2^19 on this host: c=13 3.49s, c=15 3.34s, c=16 3.52s)."""
+    return max(4, min(16, n.bit_length() - 5))
 
 
 def _n_threads() -> int:
