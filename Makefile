@@ -11,11 +11,15 @@
 native:
 	$(MAKE) -C csrc
 
+# env -u PALLAS_AXON_POOL_IPS: the axon sitecustomize dials the TPU relay
+# at interpreter start when the var is set, and that dial BLOCKS while any
+# other process (a running bench) holds the single chip — tests must never
+# touch the tunnel (tests/conftest.py documents the same for subprocesses).
 test:
-	python -m pytest tests/ -x -q
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -x -q
 
 test-slow:
-	ZKP2P_RUN_SLOW=1 python -m pytest tests/ -x -q
+	env -u PALLAS_AXON_POOL_IPS ZKP2P_RUN_SLOW=1 python -m pytest tests/ -x -q
 
 # -- driver simulation ------------------------------------------------
 # The driver gives dryrun_multichip ~10 minutes on a cold 1-core host

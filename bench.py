@@ -49,12 +49,16 @@ def _init_backend():
     if not os.environ.get("BENCH_FORCE_CPU"):
         try:
             probe = subprocess.run(
-                [sys.executable, "-c", "import jax; print(jax.devices())"],
+                [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
                 capture_output=True,
                 timeout=int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120")),
                 text=True,
             )
-            tpu_ok = probe.returncode == 0 and "Tpu" in probe.stdout
+            # Match on the platform attribute, not the repr: the device repr
+            # has changed across plugin versions ("TpuDevice" -> "TPU v5
+            # lite0"), and a repr-substring check silently diverted a
+            # healthy-TPU run to the CPU fallback tier.
+            tpu_ok = probe.returncode == 0 and "tpu" in probe.stdout.lower()
         except subprocess.TimeoutExpired:
             log("TPU probe timed out (tunnel down?)")
     import jax

@@ -10,6 +10,15 @@ import os
 
 # Force CPU even when the shell exports JAX_PLATFORMS=axon (the TPU tunnel):
 # unit tests must be hermetic and fast; the real chip is for bench.py only.
+# PALLAS_AXON_POOL_IPS must go too: the axon sitecustomize dials the chip
+# relay whenever it is set, and with the single chip held by another
+# process (e.g. a running bench) that dial BLOCKS — `JAX_PLATFORMS=cpu
+# python -c "import jax"` never returned while bench.py held the tunnel
+# (measured round 4).  NOTE the sitecustomize runs at interpreter start,
+# BEFORE this conftest — popping here protects test SUBPROCESSES, but the
+# pytest process itself must be launched with the var stripped (the
+# Makefile test targets use `env -u PALLAS_AXON_POOL_IPS`).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,80 @@
+"""Differential test: the fused Pallas G1 point-op kernels vs
+curve.jcurve (interpret mode — no TPU needed).
+
+Every special-case lane the jcurve selects handle is pinned:
+P+Q generic, P+P (dbl fallthrough), P+(-P) (infinity), inf+Q, P+inf,
+and the (0, 0) affine sentinel for add_mixed.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
+from zkp2p_tpu.curve.jcurve import G1J, g1_to_affine_arrays
+from zkp2p_tpu.field.jfield import FQ
+from zkp2p_tpu.ops.pallas_curve import g1_add, g1_add_mixed, g1_double
+
+# Interpret-mode execution of the fused whole-point-op kernels is ~100x
+# slower than compiled; ~5 min for the four tests on the 1-core host.
+pytestmark = pytest.mark.slow
+
+rng = np.random.default_rng(4242)
+
+
+def _points(n):
+    return [g1_mul(G1_GENERATOR, int(k)) for k in rng.integers(1, 2**60, n)]
+
+
+@pytest.fixture(scope="module")
+def cases():
+    # Lanes (P finite on 1..7 so the special cases bind to FINITE points):
+    # [0]=inf+Q, [1]=P+P (the same_x & same_y -> double fallthrough),
+    # [2]=P+(-P) (-> infinity), [3]=P+inf, [4]=inf+inf, [5:]=generic.
+    aff_p = g1_to_affine_arrays([None] + _points(7))
+    aff_q = g1_to_affine_arrays(_points(8))
+    P_ = G1J.from_affine(aff_p)
+    Q = G1J.from_affine(aff_q)
+    lane = jnp.arange(8)
+
+    def force(dst, src, i):
+        return tuple(jnp.where((lane == i)[:, None], s, d) for s, d in zip(src, dst))
+
+    Q = force(Q, P_, 1)  # equal (both finite)
+    Q = force(Q, G1J.neg(P_), 2)  # negated (both finite)
+    # affine-infinity sentinel lanes in q: [3] finite+inf, [4] inf+inf.
+    aff_q_inf = tuple(
+        jnp.where(((lane == 3) | (lane == 4))[:, None], jnp.zeros_like(c), c) for c in aff_q
+    )
+    Q = force(Q, G1J.infinity((8,)), 3)
+    Q = force(Q, G1J.infinity((8,)), 4)
+    return P_, Q, aff_p, aff_q_inf
+
+
+def _eq(a, b):
+    return all(bool(jnp.array_equal(x, y)) for x, y in zip(a, b))
+
+
+def test_pallas_add_matches_jcurve(cases):
+    P_, Q, _, _ = cases
+    assert _eq(g1_add(FQ, P_, Q, True), G1J.add(P_, Q))
+
+
+def test_pallas_add_mixed_matches_jcurve(cases):
+    P_, _, _, aff_q = cases
+    assert _eq(g1_add_mixed(FQ, P_, aff_q, True), G1J.add_mixed(P_, aff_q))
+
+
+def test_pallas_double_matches_jcurve(cases):
+    P_, _, _, _ = cases
+    assert _eq(g1_double(FQ, P_, True), G1J.double(P_))
+
+
+def test_pallas_add_padding_and_batch_dims():
+    # Non-TILE-multiple batch + 2D batch dims exercise pad/reshape.
+    aff = g1_to_affine_arrays(_points(6))
+    P_ = G1J.from_affine(tuple(c.reshape(2, 3, 16) for c in aff))
+    got = g1_double(FQ, P_, True)
+    want = G1J.double(P_)
+    assert got[0].shape == (2, 3, 16)
+    assert all(bool(jnp.array_equal(x, y)) for x, y in zip(got, want))

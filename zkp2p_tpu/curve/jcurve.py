@@ -37,11 +37,26 @@ JacPoint = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
 AffPoint = Tuple[jnp.ndarray, jnp.ndarray]
 
 
+import os
+
+# Curve-op implementation selector: "xla" (default — the packed-mul
+# formulas below) or "pallas" (ops.pallas_curve fused whole-point-op
+# kernels, G1 only; G2's Fq2 tower keeps the XLA path).  The pallas
+# kernels collapse the ~8 kernel launches + HBM round-trips per point
+# add into one VMEM-resident kernel — see docs/ROOFLINE.md.
+CURVE_IMPL = os.environ.get("ZKP2P_CURVE_KERNEL", "xla")
+
+
 class JCurve:
     """Short-Weierstrass a=0 curve ops over a vectorised field."""
 
     def __init__(self, field):
         self.F = field
+
+    def _pallas(self) -> bool:
+        """Route through ops.pallas_curve?  G1 (prime field) only; decided
+        at trace time (static under jit)."""
+        return CURVE_IMPL == "pallas" and self.F.zero_limbs.ndim == 1
 
     # ------------------------------------------------------------ helpers
 
@@ -85,6 +100,10 @@ class JCurve:
         """dbl-2009-l in 3 packed mul layers; infinity -> infinity for free
         (Z3 = 2YZ = 0)."""
         F = self.F
+        if self._pallas():
+            from ..ops.pallas_curve import g1_double
+
+            return g1_double(F, p, jax.default_backend() != "tpu")
         X1, Y1, Z1 = p
         sq = F.square(self._pack(X1, Y1))  # L1
         A, B = sq[0], sq[1]
@@ -105,6 +124,10 @@ class JCurve:
     def add(self, p: JacPoint, q: JacPoint) -> JacPoint:
         """Complete Jacobian add: handles inf / equal / negated lanes."""
         F = self.F
+        if self._pallas():
+            from ..ops.pallas_curve import g1_add
+
+            return g1_add(F, p, q, jax.default_backend() != "tpu")
         X1, Y1, Z1 = p
         X2, Y2, Z2 = q
         sq = F.square(self._pack(Z1, Z2))  # L1
@@ -121,6 +144,10 @@ class JCurve:
         The workhorse of MSM bucket accumulation, where all bases are the
         affine zkey points (SURVEY.md §7 step 3)."""
         F = self.F
+        if self._pallas():
+            from ..ops.pallas_curve import g1_add_mixed
+
+            return g1_add_mixed(F, p, a, jax.default_backend() != "tpu")
         X1, Y1, Z1 = p
         X2, Y2 = a
         Z1Z1 = F.square(Z1)  # L1

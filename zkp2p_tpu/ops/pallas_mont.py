@@ -81,7 +81,11 @@ def _sub_raw_lm(a: jnp.ndarray, b: jnp.ndarray):
     """(a - b) mod 2^(16*L) + borrow flag, limb-major."""
     L = a.shape[0]
     x = a + (MASK - b)
-    x = x.at[0].add(1)
+    # +1 on limb 0 via a one-hot constant add: `.at[0].add` lowers to
+    # scatter-add, which Mosaic TPU cannot lower (found on real hardware;
+    # interpret mode accepted it).
+    lim = jax.lax.broadcasted_iota(jnp.uint32, x.shape, 0)
+    x = x + (lim == 0).astype(jnp.uint32)
     y = _carry_lm(x, L + 1)
     borrow = 1 - y[L]
     return y[:L], borrow
