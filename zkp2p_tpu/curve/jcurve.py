@@ -41,9 +41,9 @@ import os
 
 # Curve-op implementation selector: "xla" (default — the packed-mul
 # formulas below) or "pallas" (ops.pallas_curve fused whole-point-op
-# kernels, G1 only; G2's Fq2 tower keeps the XLA path).  The pallas
-# kernels collapse the ~8 kernel launches + HBM round-trips per point
-# add into one VMEM-resident kernel — see docs/ROOFLINE.md.
+# kernels for both G1 and G2).  The pallas kernels collapse the ~8
+# kernel launches + HBM round-trips per point add into one
+# VMEM-resident kernel — see docs/ROOFLINE.md.
 CURVE_IMPL = os.environ.get("ZKP2P_CURVE_KERNEL", "xla")
 
 
@@ -54,9 +54,12 @@ class JCurve:
         self.F = field
 
     def _pallas(self) -> bool:
-        """Route through ops.pallas_curve?  G1 (prime field) only; decided
-        at trace time (static under jit)."""
-        return CURVE_IMPL == "pallas" and self.F.zero_limbs.ndim == 1
+        """Route through ops.pallas_curve?  Decided at trace time (static
+        under jit).  TPU only: on other backends the kernels would run in
+        interpret mode, which is orders of magnitude slower than the XLA
+        path (the differential tests call the kernels directly with
+        interpret=True instead)."""
+        return CURVE_IMPL == "pallas" and jax.default_backend() == "tpu"
 
     # ------------------------------------------------------------ helpers
 
@@ -101,9 +104,12 @@ class JCurve:
         (Z3 = 2YZ = 0)."""
         F = self.F
         if self._pallas():
-            from ..ops.pallas_curve import g1_double
+            from ..ops.pallas_curve import g1_double, g2_double
 
-            return g1_double(F, p, jax.default_backend() != "tpu")
+            interp = jax.default_backend() != "tpu"
+            if F.zero_limbs.ndim == 1:
+                return g1_double(F, p, interp)
+            return g2_double(F, p, interp)
         X1, Y1, Z1 = p
         sq = F.square(self._pack(X1, Y1))  # L1
         A, B = sq[0], sq[1]
@@ -125,9 +131,12 @@ class JCurve:
         """Complete Jacobian add: handles inf / equal / negated lanes."""
         F = self.F
         if self._pallas():
-            from ..ops.pallas_curve import g1_add
+            from ..ops.pallas_curve import g1_add, g2_add
 
-            return g1_add(F, p, q, jax.default_backend() != "tpu")
+            interp = jax.default_backend() != "tpu"
+            if F.zero_limbs.ndim == 1:
+                return g1_add(F, p, q, interp)
+            return g2_add(F, p, q, interp)
         X1, Y1, Z1 = p
         X2, Y2, Z2 = q
         sq = F.square(self._pack(Z1, Z2))  # L1
@@ -145,9 +154,12 @@ class JCurve:
         affine zkey points (SURVEY.md §7 step 3)."""
         F = self.F
         if self._pallas():
-            from ..ops.pallas_curve import g1_add_mixed
+            from ..ops.pallas_curve import g1_add_mixed, g2_add_mixed
 
-            return g1_add_mixed(F, p, a, jax.default_backend() != "tpu")
+            interp = jax.default_backend() != "tpu"
+            if F.zero_limbs.ndim == 1:
+                return g1_add_mixed(F, p, a, interp)
+            return g2_add_mixed(F, p, a, interp)
         X1, Y1, Z1 = p
         X2, Y2 = a
         Z1Z1 = F.square(Z1)  # L1

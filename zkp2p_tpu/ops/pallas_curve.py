@@ -1,4 +1,4 @@
-"""Fused BN254 G1 point ops as single Pallas TPU kernels.
+"""Fused BN254 G1/G2 point ops as single Pallas TPU kernels.
 
 docs/ROOFLINE.md round-4 addendum: with the Pallas Montgomery mul
 (`ops.pallas_mont`) the field layer reaches ~136 M muls/s on a v5e chip
@@ -14,7 +14,10 @@ operands and one write of the result.
 Semantics mirror `curve.jcurve.JCurve` exactly (same dbl-2009-l and
 add-2007-bl formulas, same (0, 0) affine / Z == 0 Jacobian infinity
 encodings, same select ordering), and the differential tests pin every
-case lane-for-lane against it (tests/test_pallas_curve.py).
+case lane-for-lane against it (tests/test_pallas_curve.py).  The point
+math is written once over a tiny field-ops object; the G1 instance works
+on single (16, T) limb tiles, the G2 instance on (c0, c1) pairs with
+Karatsuba Fq2 products (u^2 = -1, mirroring field.jfield.JFq2Ops.mul).
 
 Layout: limb-major (16, T) tiles like `pallas_mont` — limbs on the
 sublane axis, batch on the 128-wide lane axis.  Field helpers are the
@@ -25,8 +28,8 @@ unsupported scatter — one-hot adds are built from `broadcasted_iota`
 comparisons; kernels cannot capture traced constants — the modulus /
 N' / R limbs are passed as (16, 1) operands.
 
-Reference analog: rapidsnark's Jacobian point kernels (its G1 hot
-loop); this is the TPU-native equivalent.
+Reference analog: rapidsnark's Jacobian point kernels (its G1/G2 hot
+loops); this is the TPU-native equivalent.
 """
 
 from __future__ import annotations
@@ -40,9 +43,10 @@ import numpy as np
 from ..field.jfield import NUM_LIMBS, int_to_limbs
 from .pallas_mont import TILE, _carry_lm, _mont_mul_math, _sub_raw_lm
 
-# ----------------------------------------------------- field layer (VMEM)
+G2_TILE = 128  # Fq2 kernels hold ~3x the live tiles; halve the batch tile
 
-_f_mul = _mont_mul_math
+
+# ----------------------------------------------------- field layer (VMEM)
 
 
 def _f_cond_sub(a, n_lm):
@@ -66,102 +70,211 @@ def _f_is_zero(a):
     return jnp.sum(a, axis=0, keepdims=True) == 0
 
 
-def _sel(cond, p, q):
-    """cond: (1, T) bool; p, q: triples of (16, T)."""
-    return tuple(jnp.where(cond, x, y) for x, y in zip(p, q))
+class _FqOps:
+    """Limb-major Fq ops closed over the (16, 1) modulus constants.
+    Elements are single (16, T) tiles."""
+
+    def __init__(self, n_lm, np_lm, one_lm):
+        self.n_lm, self.np_lm, self.one = n_lm, np_lm, one_lm
+
+    def mul(self, a, b):
+        return _mont_mul_math(a, b, self.n_lm, self.np_lm)
+
+    def add(self, a, b):
+        return _f_add(a, b, self.n_lm)
+
+    def sub(self, a, b):
+        return _f_sub(a, b, self.n_lm)
+
+    def is_zero(self, a):
+        return _f_is_zero(a)
+
+    def sel(self, cond, a, b):
+        return jnp.where(cond, a, b)
+
+    def zero_like(self, a):
+        return jnp.zeros_like(a)
+
+    def one_bcast(self, a):
+        return jnp.broadcast_to(self.one, a.shape)
+
+
+class _Fq2Ops:
+    """Fq2 = Fq[u]/(u^2 + 1) on (c0, c1) tile pairs; Karatsuba product —
+    the exact dataflow of field.jfield.JFq2Ops.mul."""
+
+    def __init__(self, fq: _FqOps):
+        self.fq = fq
+
+    def mul(self, a, b):
+        f = self.fq
+        v0 = f.mul(a[0], b[0])
+        v1 = f.mul(a[1], b[1])
+        c0 = f.sub(v0, v1)
+        c1 = f.sub(f.mul(f.add(a[0], a[1]), f.add(b[0], b[1])), f.add(v0, v1))
+        return (c0, c1)
+
+    def add(self, a, b):
+        return (self.fq.add(a[0], b[0]), self.fq.add(a[1], b[1]))
+
+    def sub(self, a, b):
+        return (self.fq.sub(a[0], b[0]), self.fq.sub(a[1], b[1]))
+
+    def is_zero(self, a):
+        return _f_is_zero(a[0]) & _f_is_zero(a[1])
+
+    def sel(self, cond, a, b):
+        return (jnp.where(cond, a[0], b[0]), jnp.where(cond, a[1], b[1]))
+
+    def zero_like(self, a):
+        return (jnp.zeros_like(a[0]), jnp.zeros_like(a[1]))
+
+    def one_bcast(self, a):
+        # Montgomery 1 in Fq2 = (R, 0)
+        return (jnp.broadcast_to(self.fq.one, a[0].shape), jnp.zeros_like(a[1]))
 
 
 # ------------------------------------------------------------ point math
 
 
-def _double_math(X1, Y1, Z1, n_lm, np_lm):
+def _psel(f, cond, p, q):
+    return tuple(f.sel(cond, x, y) for x, y in zip(p, q))
+
+
+def _double_math(f, X1, Y1, Z1):
     """dbl-2009-l, mirror of JCurve.double (infinity -> infinity free)."""
-    A = _f_mul(X1, X1, n_lm, np_lm)
-    B = _f_mul(Y1, Y1, n_lm, np_lm)
-    C = _f_mul(B, B, n_lm, np_lm)
-    XB = _f_add(X1, B, n_lm)
-    XB2 = _f_mul(XB, XB, n_lm, np_lm)
-    YZ = _f_mul(Y1, Z1, n_lm, np_lm)
-    t = _f_sub(_f_sub(XB2, A, n_lm), C, n_lm)
-    D = _f_add(t, t, n_lm)
-    E = _f_add(_f_add(A, A, n_lm), A, n_lm)
-    Fv = _f_mul(E, E, n_lm, np_lm)
-    X3 = _f_sub(Fv, _f_add(D, D, n_lm), n_lm)
-    C8 = _f_add(C, C, n_lm)
-    C8 = _f_add(C8, C8, n_lm)
-    C8 = _f_add(C8, C8, n_lm)
-    Y3 = _f_sub(_f_mul(E, _f_sub(D, X3, n_lm), n_lm, np_lm), C8, n_lm)
-    Z3 = _f_add(YZ, YZ, n_lm)
+    A = f.mul(X1, X1)
+    B = f.mul(Y1, Y1)
+    C = f.mul(B, B)
+    XB = f.add(X1, B)
+    XB2 = f.mul(XB, XB)
+    YZ = f.mul(Y1, Z1)
+    t = f.sub(f.sub(XB2, A), C)
+    D = f.add(t, t)
+    E = f.add(f.add(A, A), A)
+    Fv = f.mul(E, E)
+    X3 = f.sub(Fv, f.add(D, D))
+    C8 = f.add(C, C)
+    C8 = f.add(C8, C8)
+    C8 = f.add(C8, C8)
+    Y3 = f.sub(f.mul(E, f.sub(D, X3)), C8)
+    Z3 = f.add(YZ, YZ)
     return X3, Y3, Z3
 
 
-def _add_core_math(p, q, U1, U2, S1, S2, Z1Z2, n_lm, np_lm):
+def _add_core_math(f, p, q, U1, U2, S1, S2, Z1Z2):
     """Mirror of JCurve._add_core: the shared tail of add / add_mixed,
     including the same-x / same-y / infinity case selects in the same
     order."""
-    H = _f_sub(U2, U1, n_lm)
-    Rr = _f_sub(S2, S1, n_lm)
-    HH = _f_mul(H, H, n_lm, np_lm)
-    R2 = _f_mul(Rr, Rr, n_lm, np_lm)
-    HHH = _f_mul(H, HH, n_lm, np_lm)
-    V = _f_mul(U1, HH, n_lm, np_lm)
-    X3 = _f_sub(_f_sub(R2, HHH, n_lm), _f_add(V, V, n_lm), n_lm)
-    Y3 = _f_sub(
-        _f_mul(Rr, _f_sub(V, X3, n_lm), n_lm, np_lm),
-        _f_mul(S1, HHH, n_lm, np_lm),
-        n_lm,
-    )
-    Z3 = _f_mul(Z1Z2, H, n_lm, np_lm)
+    H = f.sub(U2, U1)
+    Rr = f.sub(S2, S1)
+    HH = f.mul(H, H)
+    R2 = f.mul(Rr, Rr)
+    HHH = f.mul(H, HH)
+    V = f.mul(U1, HH)
+    X3 = f.sub(f.sub(R2, HHH), f.add(V, V))
+    Y3 = f.sub(f.mul(Rr, f.sub(V, X3)), f.mul(S1, HHH))
+    Z3 = f.mul(Z1Z2, H)
     res = (X3, Y3, Z3)
 
-    same_x = _f_is_zero(H)
-    same_y = _f_is_zero(Rr)
-    res = _sel(same_x & same_y, _double_math(*p, n_lm, np_lm), res)
-    zero = jnp.zeros_like(res[0])
-    res = _sel(same_x & ~same_y, (zero, zero, zero), res)
-    res = _sel(_f_is_zero(p[2]), q, res)
-    res = _sel(_f_is_zero(q[2]), p, res)
+    same_x = f.is_zero(H)
+    same_y = f.is_zero(Rr)
+    res = _psel(f, same_x & same_y, _double_math(f, *p), res)
+    zero = f.zero_like(res[0])
+    res = _psel(f, same_x & ~same_y, (zero, zero, zero), res)
+    res = _psel(f, f.is_zero(p[2]), q, res)
+    res = _psel(f, f.is_zero(q[2]), p, res)
     return res
 
 
-def _add_kernel(x1, y1, z1, x2, y2, z2, n_ref, np_ref, o0, o1, o2):
-    n_lm, np_lm = n_ref[:], np_ref[:]
-    X1, Y1, Z1 = x1[:], y1[:], z1[:]
-    X2, Y2, Z2 = x2[:], y2[:], z2[:]
-    Z1Z1 = _f_mul(Z1, Z1, n_lm, np_lm)
-    Z2Z2 = _f_mul(Z2, Z2, n_lm, np_lm)
-    U1 = _f_mul(X1, Z2Z2, n_lm, np_lm)
-    U2 = _f_mul(X2, Z1Z1, n_lm, np_lm)
-    S1 = _f_mul(_f_mul(Y1, Z2, n_lm, np_lm), Z2Z2, n_lm, np_lm)
-    S2 = _f_mul(_f_mul(Y2, Z1, n_lm, np_lm), Z1Z1, n_lm, np_lm)
-    Z1Z2 = _f_mul(Z1, Z2, n_lm, np_lm)
-    r = _add_core_math((X1, Y1, Z1), (X2, Y2, Z2), U1, U2, S1, S2, Z1Z2, n_lm, np_lm)
-    o0[:], o1[:], o2[:] = r
+def _add_math(f, p, q):
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = f.mul(Z1, Z1)
+    Z2Z2 = f.mul(Z2, Z2)
+    U1 = f.mul(X1, Z2Z2)
+    U2 = f.mul(X2, Z1Z1)
+    S1 = f.mul(f.mul(Y1, Z2), Z2Z2)
+    S2 = f.mul(f.mul(Y2, Z1), Z1Z1)
+    Z1Z2 = f.mul(Z1, Z2)
+    return _add_core_math(f, p, q, U1, U2, S1, S2, Z1Z2)
 
 
-def _add_mixed_kernel(x1, y1, z1, x2, y2, n_ref, np_ref, one_ref, o0, o1, o2):
-    n_lm, np_lm = n_ref[:], np_ref[:]
-    X1, Y1, Z1 = x1[:], y1[:], z1[:]
-    X2, Y2 = x2[:], y2[:]
-    Z1Z1 = _f_mul(Z1, Z1, n_lm, np_lm)
-    U2 = _f_mul(X2, Z1Z1, n_lm, np_lm)
-    S2 = _f_mul(Y2, _f_mul(Z1, Z1Z1, n_lm, np_lm), n_lm, np_lm)
+def _add_mixed_math(f, p, a):
+    X1, Y1, Z1 = p
+    X2, Y2 = a
+    Z1Z1 = f.mul(Z1, Z1)
+    U2 = f.mul(X2, Z1Z1)
+    S2 = f.mul(Y2, f.mul(Z1, Z1Z1))
     # q = from_affine(a): (0, 0) sentinel -> Z = 0, else Z = R (Mont 1)
-    a_inf = _f_is_zero(X2) & _f_is_zero(Y2)
-    zq = jnp.where(a_inf, jnp.zeros_like(X2), jnp.broadcast_to(one_ref[:], X2.shape))
-    r = _add_core_math((X1, Y1, Z1), (X2, Y2, zq), X1, U2, Y1, S2, Z1, n_lm, np_lm)
-    o0[:], o1[:], o2[:] = r
+    a_inf = f.is_zero(X2) & f.is_zero(Y2)
+    zq = f.sel(a_inf, f.zero_like(X2), f.one_bcast(X2))
+    return _add_core_math(f, p, (X2, Y2, zq), X1, U2, Y1, S2, Z1)
 
 
-def _double_kernel(x1, y1, z1, n_ref, np_ref, o0, o1, o2):
-    r = _double_math(x1[:], y1[:], z1[:], n_ref[:], np_ref[:])
-    o0[:], o1[:], o2[:] = r
+# ------------------------------------------------------- kernel factories
+
+_OPS = {"add": _add_math, "add_mixed": _add_mixed_math, "double": _double_math}
+
+
+def _g1_kernel(op):
+    math_fn = _OPS[op]
+
+    def kernel(*refs):
+        ins, outs = refs[:-3], refs[-3:]
+        n_lm, np_lm, one_lm = (r[:] for r in ins[-3:])
+        f = _FqOps(n_lm, np_lm, one_lm)
+        coords = [r[:] for r in ins[:-3]]
+        if op == "add":
+            r = math_fn(f, tuple(coords[:3]), tuple(coords[3:6]))
+        elif op == "add_mixed":
+            r = math_fn(f, tuple(coords[:3]), tuple(coords[3:5]))
+        else:
+            r = math_fn(f, *coords[:3])
+        for o, v in zip(outs, r):
+            o[:] = v
+
+    return kernel
+
+
+def _g2_kernel(op):
+    math_fn = _OPS[op]
+
+    def kernel(*refs):
+        ins, outs = refs[:-6], refs[-6:]
+        n_lm, np_lm, one_lm = (r[:] for r in ins[-3:])
+        f = _Fq2Ops(_FqOps(n_lm, np_lm, one_lm))
+        raw = [r[:] for r in ins[:-3]]
+        pairs = [(raw[i], raw[i + 1]) for i in range(0, len(raw), 2)]
+        if op == "add":
+            r = math_fn(f, tuple(pairs[:3]), tuple(pairs[3:6]))
+        elif op == "add_mixed":
+            r = math_fn(f, tuple(pairs[:3]), tuple(pairs[3:5]))
+        else:
+            r = math_fn(f, *pairs[:3])
+        for i, (c0, c1) in enumerate(r):
+            outs[2 * i][:] = c0
+            outs[2 * i + 1][:] = c1
+
+    return kernel
+
+
+_G1_KERNELS = {op: _g1_kernel(op) for op in _OPS}
+_G2_KERNELS = {op: _g2_kernel(op) for op in _OPS}
 
 
 # -------------------------------------------------------------- wrappers
 
 
-def _run(kernel, field, coords, interpret: bool, tile: int = TILE):
+def _consts(field):
+    return (
+        jnp.asarray(np.asarray(int_to_limbs(field.modulus))[:, None]),
+        jnp.asarray(np.asarray(int_to_limbs(field.nprime_int))[:, None]),
+        jnp.asarray(np.asarray(int_to_limbs(field.mont_r))[:, None]),
+    )
+
+
+def _run_g1(op, field, coords, interpret: bool, tile: int = TILE):
     """Flatten batch dims -> (16, B) limb-major, pad to `tile`, run the
     kernel over a 1-D grid, restore (..., 16)."""
     from jax.experimental import pallas as pl
@@ -176,37 +289,85 @@ def _run(kernel, field, coords, interpret: bool, tile: int = TILE):
         if pad:
             x = jnp.pad(x, ((0, 0), (0, pad)))
         lm.append(x)
-    n_lm = jnp.asarray(np.asarray(int_to_limbs(field.modulus))[:, None])
-    np_lm = jnp.asarray(np.asarray(int_to_limbs(field.nprime_int))[:, None])
-    one_lm = jnp.asarray(np.asarray(int_to_limbs(field.mont_r))[:, None])
-    consts = [n_lm, np_lm, one_lm] if kernel is _add_mixed_kernel else [n_lm, np_lm]
 
     spec = pl.BlockSpec((NUM_LIMBS, tile), lambda i: (0, i))
     cspec = pl.BlockSpec((NUM_LIMBS, 1), lambda i: (0, 0))
     outs = pl.pallas_call(
-        kernel,
+        _G1_KERNELS[op],
         grid=((B + pad) // tile,),
-        in_specs=[spec] * len(lm) + [cspec] * len(consts),
+        in_specs=[spec] * len(lm) + [cspec] * 3,
         out_specs=[spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((NUM_LIMBS, B + pad), jnp.uint32)] * 3,
         interpret=interpret,
-    )(*lm, *consts)
+    )(*lm, *_consts(field))
     return tuple(jnp.moveaxis(o[:, :B], 0, -1).reshape(bshape + (NUM_LIMBS,)) for o in outs)
+
+
+def _run_g2(op, fq2, coords, interpret: bool, tile: int = G2_TILE):
+    """G2 coords are (..., 2, 16); split each into (c0, c1) limb-major
+    tiles, run the Fq2 kernel, restore."""
+    from jax.experimental import pallas as pl
+
+    bshape = jnp.broadcast_shapes(*(c.shape[:-2] for c in coords))
+    coords = tuple(jnp.broadcast_to(c, bshape + (2, NUM_LIMBS)) for c in coords)
+    B = int(np.prod(bshape)) if bshape else 1
+    pad = (-B) % tile
+    lm = []
+    for c in coords:
+        flat = c.reshape(B, 2, NUM_LIMBS)
+        for k in (0, 1):
+            x = jnp.moveaxis(flat[:, k, :], -1, 0)
+            if pad:
+                x = jnp.pad(x, ((0, 0), (0, pad)))
+            lm.append(x)
+
+    spec = pl.BlockSpec((NUM_LIMBS, tile), lambda i: (0, i))
+    cspec = pl.BlockSpec((NUM_LIMBS, 1), lambda i: (0, 0))
+    outs = pl.pallas_call(
+        _G2_KERNELS[op],
+        grid=((B + pad) // tile,),
+        in_specs=[spec] * len(lm) + [cspec] * 3,
+        out_specs=[spec] * 6,
+        out_shape=[jax.ShapeDtypeStruct((NUM_LIMBS, B + pad), jnp.uint32)] * 6,
+        interpret=interpret,
+    )(*lm, *_consts(fq2.fq))
+    pts = []
+    for i in range(3):
+        c0 = jnp.moveaxis(outs[2 * i][:, :B], 0, -1)
+        c1 = jnp.moveaxis(outs[2 * i + 1][:, :B], 0, -1)
+        pts.append(jnp.stack([c0, c1], axis=-2).reshape(bshape + (2, NUM_LIMBS)))
+    return tuple(pts)
 
 
 @partial(jax.jit, static_argnums=(0, 3))
 def g1_add(field, p, q, interpret: bool = False):
     """Complete Jacobian + Jacobian, one fused kernel.  p, q: (X, Y, Z)
     triples of (..., 16) uint32 Montgomery limbs."""
-    return _run(_add_kernel, field, (*p, *q), interpret)
+    return _run_g1("add", field, (*p, *q), interpret)
 
 
 @partial(jax.jit, static_argnums=(0, 3))
 def g1_add_mixed(field, p, a, interpret: bool = False):
     """Complete Jacobian + affine ((0,0) = infinity), one fused kernel."""
-    return _run(_add_mixed_kernel, field, (*p, *a), interpret)
+    return _run_g1("add_mixed", field, (*p, *a), interpret)
 
 
 @partial(jax.jit, static_argnums=(0, 2))
 def g1_double(field, p, interpret: bool = False):
-    return _run(_double_kernel, field, p, interpret)
+    return _run_g1("double", field, p, interpret)
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def g2_add(fq2, p, q, interpret: bool = False):
+    """G2 Jacobian + Jacobian over Fq2; coords (..., 2, 16)."""
+    return _run_g2("add", fq2, (*p, *q), interpret)
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def g2_add_mixed(fq2, p, a, interpret: bool = False):
+    return _run_g2("add_mixed", fq2, (*p, *a), interpret)
+
+
+@partial(jax.jit, static_argnums=(0, 2))
+def g2_double(fq2, p, interpret: bool = False):
+    return _run_g2("double", fq2, p, interpret)
