@@ -26,7 +26,7 @@ import time
 CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
 BASELINE_CONSTRAINTS = 6_618_823
 BASELINE_PROOFS_PER_SEC = 1.0 / 9.2
-BATCH = int(os.environ.get("BENCH_BATCH", "4"))
+BATCH = int(os.environ.get("BENCH_BATCH", "16"))
 HEADER = int(os.environ.get("BENCH_HEADER", "256"))
 BODY = int(os.environ.get("BENCH_BODY", "192"))
 
@@ -241,6 +241,12 @@ def main():
             _cpu_fallback_bench(plat)
         return
 
+    # TPU tier: 8-bit MSM digits.  The per-chunk multiples table
+    # ((2^w - 2) adds) is witness-independent, so vmap leaves it
+    # unbatched and it amortises over the proof batch; at batch>=8 the
+    # halved accumulate work (32 digit planes instead of 64) wins.
+    # Must be set before the first zkp2p_tpu.prover import.
+    os.environ.setdefault("ZKP2P_MSM_WINDOW", "8")
     from zkp2p_tpu.prover.groth16_tpu import prove_tpu_batch
     from zkp2p_tpu.snark.groth16 import verify
     from zkp2p_tpu.utils.trace import dump_trace, trace
@@ -262,12 +268,29 @@ def main():
 
     log("warmup (compile) ...")
     t0 = time.time()
-    with trace("first_batch_incl_compile", batch=BATCH):
-        proofs = prove_tpu_batch(dpk, wits)
-    first = time.time() - t0
-    log(f"first batch (incl compile): {first:.1f}s")
+    try:
+        with trace("first_batch_incl_compile", batch=BATCH):
+            proofs = prove_tpu_batch(dpk, wits)
+        first = time.time() - t0
+        log(f"first batch (incl compile): {first:.1f}s")
+        assert verify(vk, proofs[0], pubs[0]), "proof failed verification"
+    except Exception:
+        # The pallas kernels are differentially tested in interpret mode,
+        # but Mosaic lowering on real hardware has already surfaced two
+        # behaviours interpret mode accepted (scatter-add, u32 reduction).
+        # If the armed kernels fail — loudly or by emitting a proof the
+        # pairing rejects — re-exec once with the portable XLA paths
+        # forced so the driver still records a real TPU number.
+        if os.environ.get("BENCH_NO_REEXEC"):
+            raise
+        import traceback
 
-    assert verify(vk, proofs[0], pubs[0]), "proof failed verification"
+        traceback.print_exc(file=sys.stderr)
+        log("device prove failed with the armed kernels; re-exec with XLA paths forced")
+        os.environ.update(
+            BENCH_NO_REEXEC="1", ZKP2P_CURVE_KERNEL="xla", ZKP2P_FIELD_MUL="xla", ZKP2P_MSM_WINDOW="4"
+        )
+        os.execv(sys.executable, [sys.executable] + sys.argv)
     log("proof[0] verified against the pairing equation")
 
     log("timed runs ...")
@@ -286,12 +309,21 @@ def main():
     dump_trace()
     plat = devs[0].platform if devs else "?"
     fb = " CPU-FALLBACK" if fell_back else ""
+    # Name the kernel mode in the record: a re-exec'd XLA-fallback run
+    # must be distinguishable from the armed-pallas path (a silent ~16x
+    # kernel regression would otherwise look like a normal datapoint).
+    from zkp2p_tpu.curve.jcurve import CURVE_IMPL
+    from zkp2p_tpu.prover.groth16_tpu import MSM_WINDOW
+
+    mode = f"curve={CURVE_IMPL} w={MSM_WINDOW}"
+    if os.environ.get("BENCH_NO_REEXEC"):
+        mode += " PALLAS-FAILED-XLA-REEXEC"
     print(
         json.dumps(
             {
                 "metric": "venmo_groth16_proofs_per_sec_constraint_normalized",
                 "value": round(proofs_per_sec, 4),
-                "unit": f"proofs/s @ {cs.num_constraints}-constraint venmo ({HEADER}/{BODY}), batch={BATCH}, 1 {plat}{fb}",
+                "unit": f"proofs/s @ {cs.num_constraints}-constraint venmo ({HEADER}/{BODY}), batch={BATCH}, {mode}, 1 {plat}{fb}",
                 "vs_baseline": round(vs, 4),
             }
         )

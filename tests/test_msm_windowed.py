@@ -62,3 +62,29 @@ def test_digit_planes_shape_and_values():
     # digit k (MSB-first) = nibble (63-k) of the scalar
     for k in range(64):
         assert planes[k, 0] == (s >> (4 * (63 - k))) & 0xF
+
+
+def test_msm_windowed_g1_w8_vs_host():
+    """window=8 (the batch-bench configuration, ZKP2P_MSM_WINDOW=8): the
+    halved digit-plane count and 255-entry table must stay bit-exact."""
+    n = 21
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(n)]
+    scalars = [rng.randrange(R) for _ in range(n)]
+    pts[0] = None
+    scalars[5] = 0
+    planes = jmsm.digit_planes_from_limbs(_limbs(scalars), window=8)
+    assert planes.shape[0] == 32
+    got = g1_jac_to_host(
+        jax.jit(lambda b, p: jmsm.msm_windowed(G1J, b, p, lanes=8, window=8))(
+            g1_to_affine_arrays(pts), planes
+        )
+    )[0]
+    assert got == g1_msm(pts, scalars)
+
+
+def test_digit_planes_w8_values():
+    s = 0x1234567890ABCDEF
+    planes = np.asarray(jmsm.digit_planes_from_limbs(_limbs([s]), window=8))
+    assert planes.shape == (32, 1)
+    for k in range(32):
+        assert planes[k, 0] == (s >> (8 * (31 - k))) & 0xFF

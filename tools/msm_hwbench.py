@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Hardware A/B microbench: XLA vs Pallas curve kernels inside the MSM.
+
+Round-4 follow-up to docs/ROOFLINE.md: the fused Montgomery mul measured
+136.5 M muls/s (7.9x XLA) on the chip; this script measures what that
+buys at the POINT and MSM level, which is what the prover actually runs
+(SURVEY.md §3.1 hot loop 2 — the reference's rapidsnark MSMs).
+
+Selects the implementation via the existing env flags (read at import
+time, so each arm runs in its own process).  The defaults are "auto"
+(= pallas on TPU), so the XLA arm must PIN BOTH flags:
+
+  ZKP2P_CURVE_KERNEL=xla ZKP2P_FIELD_MUL=xla python tools/msm_hwbench.py \
+      [--n 131072] [--window 4] [--lanes ...]
+
+Prints per-stage rates: batched add_mixed (the MSM inner op), and a full
+G1 msm_windowed at the requested size.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 17)
+    ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--lanes", type=int, default=0, help="0 = default_lanes(n)")
+    ap.add_argument("--adds", type=int, default=1 << 20, help="batch size for the raw add bench")
+    ap.add_argument("--skip-msm", action="store_true")
+    ap.add_argument("--skip-adds", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from zkp2p_tpu.utils.jaxcfg import enable_cache
+
+    enable_cache()
+    dev = jax.devices()[0]
+    # Print the RESOLVED implementations (the "auto" default resolves by
+    # backend), not the raw env — a bare run on TPU measures pallas.
+    from zkp2p_tpu.curve.jcurve import G1J
+    from zkp2p_tpu.field.jfield import FIELD_MUL_IMPL
+
+    on_tpu = jax.default_backend() == "tpu"
+    curve_impl = "pallas" if G1J._pallas() else "xla"
+    mul_impl = "pallas" if (FIELD_MUL_IMPL == "pallas" or (FIELD_MUL_IMPL == "auto" and on_tpu)) else "xla"
+    print(f"device={dev} curve={curve_impl} fieldmul={mul_impl}", flush=True)
+
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
+    from zkp2p_tpu.curve.jcurve import G1J, g1_to_affine_arrays
+    from zkp2p_tpu.ops.msm import default_lanes, digit_planes_from_limbs, msm_windowed
+
+    curve = G1J
+    rng = np.random.default_rng(7)
+
+    # random-ish affine bases: k*G for 64 distinct k, tiled to n
+    host_pts = [g1_mul(G1_GENERATOR, int(k)) for k in rng.integers(1, 1 << 30, 64)]
+    ax_np, ay_np = (np.asarray(c) for c in g1_to_affine_arrays(host_pts))
+    n = args.n
+    reps = (n + 63) // 64
+    bx = jnp.asarray(np.tile(ax_np, (reps, 1))[:n])
+    by = jnp.asarray(np.tile(ay_np, (reps, 1))[:n])
+    bases = (bx, by)
+
+    # ---- raw batched add_mixed rate (the MSM inner op) ----
+    if not args.skip_adds:
+        B = args.adds
+        reps_b = (B + 63) // 64
+        px = jnp.asarray(np.tile(ax_np, (reps_b, 1))[:B])
+        py = jnp.asarray(np.tile(ay_np, (reps_b, 1))[:B])
+        P = curve.from_affine((px, py))
+        qx = jnp.roll(px, 1, axis=0)
+        qy = jnp.roll(py, 1, axis=0)
+
+        addm = jax.jit(lambda p, a: curve.add_mixed(p, a))
+        out = addm(P, (qx, qy))
+        jax.block_until_ready(out)
+        t0 = time.time()
+        iters = 4
+        for _ in range(iters):
+            out = addm(P, (qx, qy))
+        jax.block_until_ready(out)
+        dt = (time.time() - t0) / iters
+        print(f"add_mixed: B={B} {dt*1e3:.1f} ms -> {B/dt/1e6:.2f} M adds/s", flush=True)
+
+    if args.skip_msm:
+        return
+
+    # ---- full windowed MSM ----
+    limbs_np = rng.integers(0, 1 << 16, size=(n, 16), dtype=np.uint32)
+    planes = digit_planes_from_limbs(jnp.asarray(limbs_np), window=args.window)
+    lanes = args.lanes or default_lanes(n)
+
+    f = jax.jit(lambda b, p: msm_windowed(curve, b, p, lanes=lanes, window=args.window))
+    t0 = time.time()
+    r = f(bases, planes)
+    jax.block_until_ready(r)
+    compile_and_first = time.time() - t0
+    print(f"msm first (incl compile): {compile_and_first:.1f}s", flush=True)
+    t0 = time.time()
+    r = f(bases, planes)
+    jax.block_until_ready(r)
+    dt = time.time() - t0
+    print(f"msm_windowed: n={n} lanes={lanes} w={args.window} {dt:.2f} s "
+          f"-> {n/dt/1e6:.3f} M pts/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -151,12 +151,15 @@ def msm_windowed(curve: JCurve, bases: AffPoint, digit_planes: jnp.ndarray, lane
 
     # Lane fold: G1 takes the pairwise tree — log2(lanes) halving adds
     # instead of a `lanes`-step scan (cheaper dispatch on 1-core hosts,
-    # wider batches on TPU).  G2 keeps the single-adder scan: the tree
-    # inlines log2(lanes) copies of the Fq2 add graph and the XLA:CPU
-    # compile time — the driver's dryrun budget — blows up (r4 rehearsal:
-    # the G2 executable alone compiled >400 s with the tree fold, vs
-    # ~180 s total for compile+run with the scan).
-    if curve.F.zero_limbs.ndim == 1:
+    # wider batches on TPU).  G2 joins the tree only when the pallas
+    # point kernels are in use (there a `lanes`-step scan is `lanes`
+    # tiny sequential kernel dispatches): with the XLA formulas the tree
+    # inlines log2(lanes) copies of the Fq2 add graph and compile time
+    # blows up (r4 rehearsal on XLA:CPU: the G2 executable alone
+    # compiled >400 s with the tree fold, vs ~180 s total for
+    # compile+run with the scan) — including bench's forced-XLA
+    # fallback re-exec on a TPU backend, which must stay compilable.
+    if curve.F.zero_limbs.ndim == 1 or curve._pallas():
         return tree_reduce(curve, per_lane, lanes)
 
     def fold_lanes(acc, p):

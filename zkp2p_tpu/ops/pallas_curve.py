@@ -65,9 +65,11 @@ def _f_sub(a, b, n_lm):
 
 
 def _f_is_zero(a):
-    """(16, T) -> (1, T) bool.  Canonical limbs are < 2^16 so the u32 sum
-    cannot overflow; a sum avoids relying on Mosaic's reduce_and."""
-    return jnp.sum(a, axis=0, keepdims=True) == 0
+    """(16, T) -> (1, T) bool.  Canonical limbs are < 2^16 so the sum
+    cannot overflow; a sum avoids relying on Mosaic's reduce_and.  The
+    sum runs in i32 — Mosaic has no unsigned reductions (found on real
+    hardware; interpret mode accepted the u32 sum)."""
+    return jnp.sum(a.astype(jnp.int32), axis=0, keepdims=True) == 0
 
 
 class _FqOps:
