@@ -287,8 +287,12 @@ def main():
 
         traceback.print_exc(file=sys.stderr)
         log("device prove failed with the armed kernels; re-exec with XLA paths forced")
+        # BENCH_REEXECED marks the child for the JSON label; the
+        # user-facing BENCH_NO_REEXEC switch must not imply a fallback
+        # actually happened.
         os.environ.update(
-            BENCH_NO_REEXEC="1", ZKP2P_CURVE_KERNEL="xla", ZKP2P_FIELD_MUL="xla", ZKP2P_MSM_WINDOW="4"
+            BENCH_NO_REEXEC="1", BENCH_REEXECED="1",
+            ZKP2P_CURVE_KERNEL="xla", ZKP2P_FIELD_MUL="xla", ZKP2P_MSM_WINDOW="4",
         )
         os.execv(sys.executable, [sys.executable] + sys.argv)
     log("proof[0] verified against the pairing equation")
@@ -316,7 +320,7 @@ def main():
     from zkp2p_tpu.prover.groth16_tpu import MSM_WINDOW
 
     mode = f"curve={CURVE_IMPL} w={MSM_WINDOW}"
-    if os.environ.get("BENCH_NO_REEXEC"):
+    if os.environ.get("BENCH_REEXECED"):
         mode += " PALLAS-FAILED-XLA-REEXEC"
     print(
         json.dumps(

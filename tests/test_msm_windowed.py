@@ -88,3 +88,30 @@ def test_digit_planes_w8_values():
     assert planes.shape == (32, 1)
     for k in range(32):
         assert planes[k, 0] == (s >> (8 * (31 - k))) & 0xFF
+
+
+def test_msm_windowed_signed_g1_vs_host():
+    """Signed digit recoding (the default prover path): half-size table,
+    Y-negation on negative digits — must stay bit-exact vs the host MSM."""
+    n = 23
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(n)]
+    scalars = [rng.randrange(R) for _ in range(n)]
+    pts[2] = None
+    scalars[3] = 0
+    for w in (4, 8):
+        mags, negs = jmsm.signed_digit_planes_from_limbs(_limbs(scalars), w)
+        got = g1_jac_to_host(
+            jax.jit(lambda b, m, s, w=w: jmsm.msm_windowed_signed(G1J, b, m, s, lanes=8, window=w))(
+                g1_to_affine_arrays(pts), mags, negs
+            )
+        )[0]
+        assert got == g1_msm(pts, scalars), f"window {w}"
+
+
+def test_msm_windowed_signed_g2_vs_host():
+    n = 5
+    pts = [g2_mul(G2_GENERATOR, rng.randrange(1, R)) for _ in range(n)]
+    scalars = [rng.randrange(R) for _ in range(n)]
+    mags, negs = jmsm.signed_digit_planes_from_limbs(_limbs(scalars), 4)
+    got = g2_jac_to_host(jmsm.msm_windowed_signed(G2J, g2_to_affine_arrays(pts), mags, negs, lanes=8, window=4))[0]
+    assert got == g2_msm(pts, scalars)

@@ -47,15 +47,13 @@ def main():
     # Print the RESOLVED implementations (the "auto" default resolves by
     # backend), not the raw env — a bare run on TPU measures pallas.
     from zkp2p_tpu.curve.jcurve import G1J
-    from zkp2p_tpu.field.jfield import FIELD_MUL_IMPL
+    from zkp2p_tpu.field.jfield import field_mul_impl
 
-    on_tpu = jax.default_backend() == "tpu"
     curve_impl = "pallas" if G1J._pallas() else "xla"
-    mul_impl = "pallas" if (FIELD_MUL_IMPL == "pallas" or (FIELD_MUL_IMPL == "auto" and on_tpu)) else "xla"
-    print(f"device={dev} curve={curve_impl} fieldmul={mul_impl}", flush=True)
+    print(f"device={dev} curve={curve_impl} fieldmul={field_mul_impl()}", flush=True)
 
     from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
-    from zkp2p_tpu.curve.jcurve import G1J, g1_to_affine_arrays
+    from zkp2p_tpu.curve.jcurve import g1_to_affine_arrays
     from zkp2p_tpu.ops.msm import default_lanes, digit_planes_from_limbs, msm_windowed
 
     curve = G1J

@@ -113,6 +113,17 @@ CONV_LAYOUT = os.environ.get("ZKP2P_FIELD_CONV", "matmul")
 FIELD_MUL_IMPL = os.environ.get("ZKP2P_FIELD_MUL", "auto")
 
 
+def field_mul_impl() -> str:
+    """The RESOLVED field-mul implementation ("pallas" or "xla") — the
+    one place the "auto" rule lives (mirror of JCurve._pallas; used by
+    JPrimeField.mul and by tools that label A/B arms)."""
+    import jax as _jax
+
+    if FIELD_MUL_IMPL == "pallas" or (FIELD_MUL_IMPL == "auto" and _jax.default_backend() == "tpu"):
+        return "pallas"
+    return "xla"
+
+
 def _mul_wide_limb_major(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook conv with limbs on axis 0 and the flattened batch on
     the minor axis: 16 iterations of (Lb, B) u32 multiply + two padded
@@ -256,13 +267,12 @@ class JPrimeField:
         takes the fused VMEM kernel (ops.pallas_mont, docs/ROOFLINE.md)
         on a real TPU backend and the XLA path elsewhere; "pallas"
         forces the kernel (interpret mode off-TPU — tests only)."""
-        import jax as _jax
+        if field_mul_impl() == "pallas":
+            import jax as _jax
 
-        on_tpu = _jax.default_backend() == "tpu"
-        if FIELD_MUL_IMPL == "pallas" or (FIELD_MUL_IMPL == "auto" and on_tpu):
             from ..ops.pallas_mont import mont_mul
 
-            return mont_mul(self, a, b, not on_tpu)
+            return mont_mul(self, a, b, _jax.default_backend() != "tpu")
         t = _mul_wide(a, b)  # (..., 32)
         m = _mul_wide(t[..., :NUM_LIMBS], self.nprime_limbs)[..., :NUM_LIMBS]
         u = _mul_wide(m, self.n_limbs)  # (..., 32)

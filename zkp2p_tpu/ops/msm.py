@@ -28,6 +28,8 @@ the group op) — see zkp2p_tpu.parallel.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -83,6 +85,70 @@ def digit_planes_from_limbs(limbs: jnp.ndarray, window: int = 4) -> jnp.ndarray:
     return jnp.moveaxis(flat, -1, 0)
 
 
+def signed_digit_planes_from_limbs(limbs: jnp.ndarray, window: int = 4):
+    """Standard-form scalar limbs (..., n, 16) -> signed base-2^window
+    digits, most significant first: (mags, negs) with
+    mags (256/window, ..., n) uint32 in [0, 2^(window-1)] and negs a bool
+    mask for negated digits.
+
+    Recoding d -> d' in [-(2^(w-1) - 1), 2^(w-1)]: LSB-first, carry into
+    the next digit whenever d + carry > 2^(w-1).  The multiples table
+    then only needs 2^(w-1) entries — HALF the unsigned table — because
+    -|d'|*P is (x, -y) for free.  The top digit cannot overflow for
+    BN254 Fr scalars (< 2^254, and the final carry is absorbed by the
+    unused high bits: the top base-2^w digit of an Fr scalar is at most
+    0x30, so digit + carry never exceeds 2^(w-1)).
+
+    Carry resolution is a 5-pass Kogge-Stone over the digit axis
+    (generate = d > half, propagate = d == half), vectorised over the
+    scalar batch — no sequential scan."""
+    assert 16 % window == 0
+    n_digits = 256 // window
+    half = jnp.uint32(1 << (window - 1))
+    full = jnp.uint32(1 << window)
+
+    planes = digit_planes_from_limbs(limbs, window)  # (n_digits, ..., n) MSB first
+    d = jnp.flip(planes, axis=0)  # LSB first for the carry recurrence
+    # carry c[i+1] arrives at digit i+1 iff d[i] + c[i] > half:
+    #   generate g = d > half, propagate p = (d == half)
+    g = d > half
+    p = d == half
+    k = 1
+    gg, pp = g, p
+    while k < n_digits:
+        shifted_g = jnp.concatenate([jnp.zeros_like(gg[:k]), gg[:-k]], axis=0)
+        shifted_p = jnp.concatenate([jnp.zeros_like(pp[:k]), pp[:-k]], axis=0)
+        gg = gg | (pp & shifted_g)
+        pp = pp & shifted_p
+        k *= 2
+    carry_in = jnp.concatenate([jnp.zeros_like(gg[:1]), gg[:-1]], axis=0)
+    e = d + carry_in.astype(jnp.uint32)  # in [0, 2^w]
+    neg = e > half
+    mag = jnp.where(neg, full - e, e)  # in [0, half]
+    mags = jnp.flip(mag, axis=0)
+    negs = jnp.flip(neg, axis=0)
+    return mags, negs
+
+
+def msm_windowed_signed(
+    curve: JCurve,
+    bases: AffPoint,
+    mags: jnp.ndarray,
+    negs: jnp.ndarray,
+    lanes: int = 64,
+    window: int = 4,
+) -> JacPoint:
+    """`msm_windowed` on signed digits: the per-chunk multiples table is
+    2^(w-1) entries instead of 2^w - 1 (built with half the adds), and a
+    negated digit flips the selected point's Y (one conditional field
+    subtract — negligible next to a curve add).  The table cost is the
+    batch-amortised term of the windowed MSM (it is witness-independent
+    under vmap), so halving it is what makes w=8 win at small batches
+    too: ~63.8 adds/pt at batch=4 vs 95.5 unsigned (see the bench
+    arming note in prover.groth16_tpu)."""
+    return _msm_windowed_impl(curve, bases, mags, negs, lanes, window)
+
+
 def default_lanes(n: int, cap: int = 4096) -> int:
     """Lane width for an n-point MSM: TPU ops are latency-bound until the
     per-step batch is ~10^5+ elements (measured: FR.mul at B=4096 runs at
@@ -100,41 +166,81 @@ def msm_windowed(curve: JCurve, bases: AffPoint, digit_planes: jnp.ndarray, lane
     then SELECTS its multiple (cheap wheres) and does one masked
     accumulate on the (n_planes, lanes) batch.  Same zero-scatter dataflow,
     same one-adder-per-scan-body compile discipline."""
-    n_digits = digit_planes.shape[0]
+    return _msm_windowed_impl(curve, bases, digit_planes, None, lanes, window)
+
+
+def _msm_windowed_impl(
+    curve: JCurve,
+    bases: AffPoint,
+    planes_in: jnp.ndarray,
+    negs: Optional[jnp.ndarray],
+    lanes: int,
+    window: int,
+) -> JacPoint:
+    """Shared body of `msm_windowed` (negs=None: unsigned 2^w - 1 table +
+    masked accumulate — kept op-for-op identical so the sharded/dryrun
+    executables and their compile cache are untouched) and
+    `msm_windowed_signed` (half table + Y negation)."""
+    signed = negs is not None
+    n_digits = planes_in.shape[0]
     n = bases[0].shape[0]
     lanes = min(lanes, n)
     pad = (-n) % lanes
     if pad:
         bases = tuple(jnp.pad(c, [(0, pad)] + [(0, 0)] * (c.ndim - 1)) for c in bases)
-        digit_planes = jnp.pad(digit_planes, [(0, 0), (0, pad)])
+        planes_in = jnp.pad(planes_in, [(0, 0), (0, pad)])
+        if signed:
+            negs = jnp.pad(negs, [(0, 0), (0, pad)])
     steps = (n + pad) // lanes
 
     pts = tuple(c.reshape((steps, lanes) + c.shape[1:]) for c in bases)
-    planes = digit_planes.reshape(n_digits, steps, lanes).transpose(1, 0, 2)
+    planes = planes_in.reshape(n_digits, steps, lanes).transpose(1, 0, 2)
 
-    n_mult = 1 << window
+    # table entries 1..n_table (signed digits only reach 2^(w-1))
+    n_table = (1 << (window - 1)) if signed else (1 << window) - 1
+    F = curve.F
 
     def accumulate(acc, xs):
-        pt, digits = xs  # pt: (lanes, elem) affine; digits: (n_digits, lanes)
+        # the neg planes ride the scan only on the signed path — the
+        # unsigned jaxpr must stay IDENTICAL to keep the sharded/dryrun
+        # compile-cache entries valid
+        if signed:
+            pt, digits, neg = xs
+        else:
+            (pt, digits), neg = xs, None  # pt: (lanes, elem) affine
         base_jac = curve.from_affine(pt)
 
         def table_step(prev, _):
             nxt = curve.add_mixed(prev, pt)
             return nxt, prev
 
-        # multiples 1..2^w-1: scan collects [1P..(2^w-1)P] (ys = prev of each step)
-        last, stacked = jax.lax.scan(table_step, base_jac, None, length=n_mult - 1)
-        # stacked: (2^w-1, lanes, elem) = [1P, 2P, ..., (2^w-1)P]
+        # multiples 1..n_table: scan collects [1P, 2P, ...] (ys = prev)
+        last, stacked = jax.lax.scan(table_step, base_jac, None, length=n_table)
         table = tuple(
             jnp.concatenate([jnp.zeros_like(c[:1]), c], axis=0) for c in stacked
-        )  # index 0 = infinity
+        )  # index 0 = infinity (Z = 0)
 
         lane_ix = jnp.arange(digits.shape[-1])[None, :]
-        sel = tuple(c[digits, lane_ix] for c in table)  # per-lane multiple -> (n_digits, lanes, elem)
-        nxt = curve.add(acc, sel)
+        sel = list(c[digits, lane_ix] for c in table)  # per-lane multiple -> (n_digits, lanes, elem)
+        if signed:
+            # negate Y where the digit is negative; F.neg keeps -0 = 0,
+            # so infinity lanes (digit 0) stay (0, 0, 0).  The mask
+            # broadcasts over the element dims (one for G1 limbs, two
+            # for G2 Fq2 pairs).  Digit 0 selects the Z = 0 infinity
+            # entry, which curve.add's case selects pass through — no
+            # explicit mask needed.
+            mask = neg.reshape(neg.shape + (1,) * (sel[1].ndim - neg.ndim))
+            sel[1] = jnp.where(mask, F.neg(sel[1]), sel[1])
+            return curve.add(acc, tuple(sel)), None
+        nxt = curve.add(acc, tuple(sel))
         return curve.select(digits != 0, nxt, acc), None
 
-    partials, _ = jax.lax.scan(accumulate, curve.infinity((n_digits, lanes)), (pts, planes))
+    if signed:
+        neg_t = negs.reshape(n_digits, steps, lanes).transpose(1, 0, 2)
+        xs_in = (pts, planes, neg_t)
+    else:
+        xs_in = (pts, planes)
+    partials, _ = jax.lax.scan(accumulate, curve.infinity((n_digits, lanes)), xs_in)
 
     def fold_planes(acc, ps):
         # window doublings as a nested scan: ONE compiled double graph

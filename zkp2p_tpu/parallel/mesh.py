@@ -110,6 +110,70 @@ def msm_sharded(
     return _msm_sharded_fn(curve, len(bases), mesh, axis, lanes, window)(bases, planes)
 
 
+@lru_cache(maxsize=None)
+def _msm_pod_fn(curve: JCurve, n_bases: int, mesh: Mesh, dcn_axis: str, ici_axis: str, lanes: int, window: int):
+    def local(bs, pl):
+        # pl: (B_local, n_planes, n_local) — this slice's share of the
+        # proof batch over its shard of the base axis
+        def one(p):
+            if window:
+                return msm_windowed(curve, bs, p, lanes=lanes, window=window)
+            return msm(curve, bs, p, lanes=lanes)
+
+        part = jax.vmap(one)(pl)
+        # ICI allreduce within the slice: combine base-axis partials
+        gathered = jax.lax.all_gather(part, ici_axis, axis=1)
+        acc = _fold_gathered_batched(curve, gathered, mesh.shape[ici_axis])
+        # DCN all-gather across slices: assemble the full proof batch
+        # (one point per proof — the only cross-slice traffic, matching
+        # the make_pod_mesh contract of data-parallel-only over dcn)
+        return tuple(jax.lax.all_gather(c, dcn_axis, axis=0, tiled=True) for c in acc)
+
+    in_specs = (
+        tuple(P(ici_axis) for _ in range(n_bases)),
+        P(dcn_axis, None, ici_axis),
+    )
+    out_specs = tuple(P() for _ in range(3))
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False))
+
+
+def _fold_gathered_batched(curve: JCurve, gathered: JacPoint, n: int) -> JacPoint:
+    """Fold per-device partials with a batch axis: gathered components
+    are (B_local, n_dev, ...); scan over the device axis."""
+
+    def body(acc, p):
+        return curve.add(acc, p), None
+
+    moved = tuple(jnp.moveaxis(c, 1, 0) for c in gathered)
+    acc, _ = jax.lax.scan(body, curve.infinity((moved[0].shape[1],)), moved)
+    return acc
+
+
+def msm_pod_batched(
+    curve: JCurve,
+    bases: AffPoint,
+    planes_batch: jnp.ndarray,
+    mesh: Mesh,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "shard",
+    lanes: int = 64,
+    window: int = 4,
+) -> JacPoint:
+    """Batched MSM over a pod mesh (`make_pod_mesh`): the proof batch is
+    data-parallel over the `dcn` axis (each slice proves its share of
+    the batch) while each slice shards the base-point axis over its ICI
+    `shard` axis — the v5e-256 configuration of BASELINE.json, with the
+    only DCN traffic being one proof point per batch element.
+
+    planes_batch: (B, n_planes, N) digit planes, B divisible by the dcn
+    width, N by the ici width.  Returns (B,)-batched Jacobian points,
+    replicated everywhere."""
+    B = planes_batch.shape[0]
+    assert B % mesh.shape[dcn_axis] == 0, "batch must divide the dcn axis"
+    assert bases[0].shape[0] % mesh.shape[ici_axis] == 0, "pad the base axis first"
+    return _msm_pod_fn(curve, len(bases), mesh, dcn_axis, ici_axis, lanes, window)(bases, planes_batch)
+
+
 def pad_to_multiple(bases: AffPoint, bit_planes: jnp.ndarray, multiple: int) -> Tuple[AffPoint, jnp.ndarray]:
     n = bases[0].shape[0]
     pad = (-n) % multiple

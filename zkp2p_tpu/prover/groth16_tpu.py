@@ -47,7 +47,13 @@ from ..curve.jcurve import (
 )
 from ..field.bn254 import R
 from ..field.jfield import FR, NUM_LIMBS, lazy_segment_sum_mod
-from ..ops.msm import default_lanes, digit_planes_from_limbs, msm_windowed
+from ..ops.msm import (
+    default_lanes,
+    digit_planes_from_limbs,
+    msm_windowed,
+    msm_windowed_signed,
+    signed_digit_planes_from_limbs,
+)
 from ..ops.ntt import coset_shift, intt, ntt
 
 # Window width for the prover MSMs: 4-bit digits -> ~78 point-adds per
@@ -59,6 +65,25 @@ from ..ops.ntt import coset_shift, intt, ntt
 import os as _os
 
 MSM_WINDOW = int(_os.environ.get("ZKP2P_MSM_WINDOW", "4"))
+# Signed digit recoding (default on): the per-chunk multiples table
+# halves to 2^(w-1) entries because a negative digit is (x, -y) for
+# free — strictly less work at every batch size (ops.msm.
+# msm_windowed_signed).  The sharded/dryrun path keeps unsigned planes
+# (its XLA:CPU compile budget is tuned around the existing graphs).
+MSM_SIGNED = _os.environ.get("ZKP2P_MSM_SIGNED", "1") == "1"
+# Unified G1 MSM shape ("auto" = on for a real TPU backend): pad the
+# a/b1/c/h MSM inputs to one common base count so all four share ONE
+# compiled executable instead of four — on a cold driver box each TPU
+# MSM compile measured ~2 min, and the masked-lane work the padding adds
+# is small once adds/pt is low (w=8 signed: ~+33% G1 element-adds =
+# ~+0.1 s/proof at measured kernel rates).  The G2 MSM keeps its own
+# (minimal) size: its planes come from the unpadded b_sel gather, so
+# the padding never touches the 3x-cost Fq2 path.
+MSM_UNIFIED = _os.environ.get("ZKP2P_MSM_UNIFIED", "auto")
+
+
+def _unified() -> bool:
+    return MSM_UNIFIED == "1" or (MSM_UNIFIED == "auto" and jax.default_backend() == "tpu")
 from ..snark.groth16 import Proof, ProvingKey, coset_gen, domain_size_for, qap_rows
 from ..snark.r1cs import ConstraintSystem
 
@@ -242,6 +267,10 @@ def h_evals(dpk: DeviceProvingKey, w_mont: jnp.ndarray) -> jnp.ndarray:
 
 def _h_and_planes(dpk: DeviceProvingKey, w_mont: jnp.ndarray):
     h = h_evals(dpk, w_mont)
+    if MSM_SIGNED:
+        w_mags, w_negs = signed_digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW)
+        h_mags, h_negs = signed_digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW)
+        return (w_mags, w_negs), (h_mags, h_negs)
     return (
         digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW),
         digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW),
@@ -252,11 +281,19 @@ def _msm_g1(bases, planes):
     # lanes from the static base count: wide steps keep the VPU batch
     # large (TPU ops are latency-bound at small batches — see
     # ops.msm.default_lanes).
-    return msm_windowed(G1J, bases, planes, lanes=default_lanes(bases[0].shape[0]), window=MSM_WINDOW)
+    lanes = default_lanes(bases[0].shape[0])
+    if MSM_SIGNED:
+        mags, negs = planes
+        return msm_windowed_signed(G1J, bases, mags, negs, lanes=lanes, window=MSM_WINDOW)
+    return msm_windowed(G1J, bases, planes, lanes=lanes, window=MSM_WINDOW)
 
 
 def _msm_g2(bases, planes):
-    return msm_windowed(G2J, bases, planes, lanes=default_lanes(bases[0].shape[0], cap=2048), window=MSM_WINDOW)
+    lanes = default_lanes(bases[0].shape[0], cap=2048)
+    if MSM_SIGNED:
+        mags, negs = planes
+        return msm_windowed_signed(G2J, bases, mags, negs, lanes=lanes, window=MSM_WINDOW)
+    return msm_windowed(G2J, bases, planes, lanes=lanes, window=MSM_WINDOW)
 
 
 # Stage-wise jits, NOT one fused program: XLA compile time scales with
@@ -284,14 +321,43 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
         else (_jit_h_planes, _jit_msm_g1, _jit_msm_g2)
     )
     w_planes, h_planes = jh(dpk, w_mont)
-    b_planes = jnp.take(w_planes, dpk.b_sel, axis=-1)
-    c_planes = jnp.take(w_planes, dpk.c_sel, axis=-1)
+
+    def take(planes, sel):
+        # signed planes are a (mags, negs) pair; both gather on wires
+        if isinstance(planes, tuple):
+            return tuple(jnp.take(p, sel, axis=-1) for p in planes)
+        return jnp.take(planes, sel, axis=-1)
+
+    b_planes = take(w_planes, dpk.b_sel)
+    c_planes = take(w_planes, dpk.c_sel)
+
+    g1_n = 0
+    if _unified():
+        g1_n = max(
+            dpk.a_bases[0].shape[0], dpk.b1_bases[0].shape[0],
+            dpk.c_bases[0].shape[0], dpk.h_bases[0].shape[0],
+        )
+
+    def g1(bases, planes):
+        # Unified shape: pad bases with the (0, 0) infinity sentinel and
+        # planes with zero digits — contributes nothing, and all four G1
+        # MSMs then share one compiled executable (pad at trace time, so
+        # the DeviceProvingKey layout and key cache stay unchanged).
+        n = bases[0].shape[0]
+        if g1_n and n < g1_n:
+            bases = tuple(jnp.pad(c, [(0, g1_n - n)] + [(0, 0)] * (c.ndim - 1)) for c in bases)
+            if isinstance(planes, tuple):
+                planes = tuple(jnp.pad(p, [(0, 0)] * (p.ndim - 1) + [(0, g1_n - n)]) for p in planes)
+            else:
+                planes = jnp.pad(planes, [(0, 0)] * (planes.ndim - 1) + [(0, g1_n - n)])
+        return m1(bases, planes)
+
     return (
-        m1(dpk.a_bases, w_planes),
-        m1(dpk.b1_bases, b_planes),
+        g1(dpk.a_bases, w_planes),
+        g1(dpk.b1_bases, b_planes),
         m2(dpk.b2_bases, b_planes),
-        m1(dpk.c_bases, c_planes),
-        m1(dpk.h_bases, h_planes),
+        g1(dpk.c_bases, c_planes),
+        g1(dpk.h_bases, h_planes),
     )
 
 
