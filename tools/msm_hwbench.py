@@ -34,6 +34,7 @@ def main():
     ap.add_argument("--adds", type=int, default=1 << 20, help="batch size for the raw add bench")
     ap.add_argument("--skip-msm", action="store_true")
     ap.add_argument("--skip-adds", action="store_true")
+    ap.add_argument("--signed", action="store_true", help="signed digit recoding (half-size table)")
     args = ap.parse_args()
 
     import jax
@@ -54,7 +55,13 @@ def main():
 
     from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
     from zkp2p_tpu.curve.jcurve import g1_to_affine_arrays
-    from zkp2p_tpu.ops.msm import default_lanes, digit_planes_from_limbs, msm_windowed
+    from zkp2p_tpu.ops.msm import (
+        default_lanes,
+        digit_planes_from_limbs,
+        msm_windowed,
+        msm_windowed_signed,
+        signed_digit_planes_from_limbs,
+    )
 
     curve = G1J
     rng = np.random.default_rng(7)
@@ -94,21 +101,28 @@ def main():
 
     # ---- full windowed MSM ----
     limbs_np = rng.integers(0, 1 << 16, size=(n, 16), dtype=np.uint32)
-    planes = digit_planes_from_limbs(jnp.asarray(limbs_np), window=args.window)
+    limbs_np[:, 15] &= 0x3FFF  # < 2^254, like Fr scalars (signed recoding bound)
     lanes = args.lanes or default_lanes(n)
-
-    f = jax.jit(lambda b, p: msm_windowed(curve, b, p, lanes=lanes, window=args.window))
+    tag = f"n={n} lanes={lanes} w={args.window}"
+    if args.signed:
+        mags, negs = signed_digit_planes_from_limbs(jnp.asarray(limbs_np), args.window)
+        f = jax.jit(lambda b, m, s: msm_windowed_signed(curve, b, m, s, lanes=lanes, window=args.window))
+        fargs = (bases, mags, negs)
+        tag += " signed"
+    else:
+        planes = digit_planes_from_limbs(jnp.asarray(limbs_np), window=args.window)
+        f = jax.jit(lambda b, p: msm_windowed(curve, b, p, lanes=lanes, window=args.window))
+        fargs = (bases, planes)
     t0 = time.time()
-    r = f(bases, planes)
+    r = f(*fargs)
     jax.block_until_ready(r)
     compile_and_first = time.time() - t0
     print(f"msm first (incl compile): {compile_and_first:.1f}s", flush=True)
     t0 = time.time()
-    r = f(bases, planes)
+    r = f(*fargs)
     jax.block_until_ready(r)
     dt = time.time() - t0
-    print(f"msm_windowed: n={n} lanes={lanes} w={args.window} {dt:.2f} s "
-          f"-> {n/dt/1e6:.3f} M pts/s", flush=True)
+    print(f"msm_windowed: {tag} {dt:.2f} s -> {n/dt/1e6:.3f} M pts/s", flush=True)
 
 
 if __name__ == "__main__":

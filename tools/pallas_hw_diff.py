@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Compiled-kernel differential on REAL hardware: the fused Pallas
+point kernels (G1 + G2, every special-case lane) vs the XLA jcurve
+formulas, compiled for the chip.
+
+The interpret-mode tests (tests/test_pallas_curve.py) pin the MATH;
+this pins the MOSAIC LOWERING — the layer that has already produced two
+behaviours interpret mode accepted and the chip rejected (scatter-add,
+u32 reductions).  Run whenever the kernels change, before trusting a
+bench number.
+"""
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from zkp2p_tpu.utils.jaxcfg import enable_cache
+
+    enable_cache()
+    # Compiled on a real chip (the point of the tool); interpret mode
+    # off-TPU so the tool itself stays smoke-testable on CPU.
+    interp = jax.default_backend() != "tpu"
+    t0 = time.time()
+
+    def log(m):
+        print(f"[{time.time()-t0:6.1f}s] {m}", flush=True)
+
+    from zkp2p_tpu.curve.host import G1_GENERATOR, G2_GENERATOR, g1_mul, g2_mul
+    from zkp2p_tpu.curve.jcurve import G1J, G2J, g1_to_affine_arrays, g2_to_affine_arrays
+    from zkp2p_tpu.field.jfield import FQ, FQ2
+    from zkp2p_tpu.ops import pallas_curve as pc
+
+    rng = np.random.default_rng(11)
+
+    def check(name, got, want):
+        ok = all(bool(jnp.array_equal(x, y)) for x, y in zip(got, want))
+        log(f"{name} {'OK' if ok else 'MISMATCH'}")
+        assert ok, name
+
+    # Lanes: [0]=inf+Q, [1]=P+P, [2]=P+(-P), [3]=P+inf, [5:]=generic
+    pts = [g1_mul(G1_GENERATOR, int(k)) for k in rng.integers(1, 2**60, 16)]
+    aff = g1_to_affine_arrays([None] + pts[:7])
+    aff_q = g1_to_affine_arrays(pts[7:15])
+    P = G1J.from_affine(aff)
+    Q = G1J.from_affine(aff_q)
+    lane = jnp.arange(8)
+
+    def force(dst, src, i):
+        return tuple(jnp.where((lane == i)[:, None], s, d) for s, d in zip(src, dst))
+
+    Q = force(Q, P, 1)
+    Q = force(Q, G1J.neg(P), 2)
+    Q = force(Q, G1J.infinity((8,)), 3)
+    # add_mixed needs its special cases in the AFFINE operand: lane 1 =
+    # same point (doubling fallthrough), lane 2 = negated (-> infinity),
+    # lane 3 = (0, 0) sentinel (affine infinity)
+    aff_m = list(aff_q)
+    aff_m[0] = jnp.where((lane == 1)[:, None], aff[0], aff_m[0])
+    aff_m[1] = jnp.where((lane == 1)[:, None], aff[1], aff_m[1])
+    aff_m[0] = jnp.where((lane == 2)[:, None], aff[0], aff_m[0])
+    aff_m[1] = jnp.where((lane == 2)[:, None], FQ.neg(aff[1]), aff_m[1])
+    aff_m = tuple(jnp.where((lane == 3)[:, None], jnp.zeros_like(c), c) for c in aff_m)
+    log("g1 cases built")
+    check("g1_add", pc.g1_add(FQ, P, Q, interp), G1J.add(P, Q))
+    check("g1_add_mixed", pc.g1_add_mixed(FQ, P, aff_m, interp), G1J.add_mixed(P, aff_m))
+    check("g1_double", pc.g1_double(FQ, P, interp), G1J.double(P))
+
+    g2pts = [g2_mul(G2_GENERATOR, int(k)) for k in rng.integers(1, 2**60, 16)]
+    aff2 = g2_to_affine_arrays([None] + g2pts[:7])
+    aff2q = g2_to_affine_arrays(g2pts[7:15])
+    P2 = G2J.from_affine(aff2)
+    Q2 = G2J.from_affine(aff2q)
+
+    def force2(dst, src, i):
+        return tuple(jnp.where((lane == i)[:, None, None], s, d) for s, d in zip(src, dst))
+
+    Q2 = force2(Q2, P2, 1)
+    Q2 = force2(Q2, G2J.neg(P2), 2)
+    Q2 = force2(Q2, G2J.infinity((8,)), 3)
+    aff2_m = list(aff2q)
+    m1 = (lane == 1)[:, None, None]
+    m2c = (lane == 2)[:, None, None]
+    aff2_m[0] = jnp.where(m1, aff2[0], aff2_m[0])
+    aff2_m[1] = jnp.where(m1, aff2[1], aff2_m[1])
+    aff2_m[0] = jnp.where(m2c, aff2[0], aff2_m[0])
+    aff2_m[1] = jnp.where(m2c, FQ2.neg(aff2[1]), aff2_m[1])
+    aff2_m = tuple(jnp.where((lane == 3)[:, None, None], jnp.zeros_like(c), c) for c in aff2_m)
+    log("g2 cases built")
+    check("g2_add", pc.g2_add(FQ2, P2, Q2, interp), G2J.add(P2, Q2))
+    check("g2_add_mixed", pc.g2_add_mixed(FQ2, P2, aff2_m, interp), G2J.add_mixed(P2, aff2_m))
+    check("g2_double", pc.g2_double(FQ2, P2, interp), G2J.double(P2))
+
+    # Mont mul kernel vs the host bignum oracle on canonical residues
+    from zkp2p_tpu.field.bn254 import P as PMOD
+    from zkp2p_tpu.field.jfield import MONT_R, int_to_limbs, limbs_to_int
+    from zkp2p_tpu.ops.pallas_mont import mont_mul
+
+    B = 1024
+    ints_a = [int.from_bytes(rng.bytes(32), "little") % PMOD for _ in range(B)]
+    ints_b = [int.from_bytes(rng.bytes(32), "little") % PMOD for _ in range(B)]
+    a = jnp.asarray(np.stack([int_to_limbs(x) for x in ints_a]))
+    b = jnp.asarray(np.stack([int_to_limbs(x) for x in ints_b]))
+    ga = np.asarray(mont_mul(FQ, a, b, interp))
+    rinv = pow(MONT_R, -1, PMOD)
+    for i in range(32):
+        expect = (ints_a[i] * ints_b[i] * rinv) % PMOD
+        assert limbs_to_int(ga[i]) == expect, i
+    log("mont_mul OK (vs host oracle)")
+    log("ALL HARDWARE DIFFS OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Orchestrated TPU hardware session: run the moment the tunnel is up.
+# Each phase logs to docs/logs/tpu_session_<ts>/ and later phases run
+# even if earlier ones fail (the bench self-protects via its XLA
+# re-exec fallback).  Order: correctness diff -> microbench arms ->
+# full venmo bench (the driver's command) -> artifacts summary.
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+OUT=docs/logs/tpu_session_$TS
+mkdir -p "$OUT"
+echo "== TPU session $TS -> $OUT"
+
+FAILS=0
+phase() {
+  local name=$1 tmo=$2; shift 2
+  echo "-- $name (timeout ${tmo}s): $*" | tee -a "$OUT/session.log"
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "   rc=$rc" | tee -a "$OUT/session.log"
+  tail -4 "$OUT/$name.log" | sed 's/^/   /'
+  [ $rc -ne 0 ] && FAILS=$((FAILS + 1))
+  return $rc
+}
+
+# 1. compiled-kernel differential vs the XLA path on chip (G1+G2, all
+#    special-case lanes) — the check interpret mode cannot do.
+phase diff 1500 python -u tools/pallas_hw_diff.py
+
+# 2. microbench arms: signed w=8 (the bench config), lanes sweep
+phase msm_w8 1200 python -u tools/msm_hwbench.py --n 131072 --window 8 --signed --skip-adds
+phase msm_lanes8k 900 python -u tools/msm_hwbench.py --n 131072 --lanes 8192 --skip-adds
+phase msm_lanes16k 900 python -u tools/msm_hwbench.py --n 131072 --lanes 16384 --skip-adds
+
+# 3. the real thing: venmo bench exactly as the driver runs it
+phase bench 900 python -u bench.py
+# a second pass rides the warm compile cache — the steady-state number
+phase bench_warm 900 python -u bench.py
+
+echo "== session done ($FAILS failed phases); logs in $OUT" | tee -a "$OUT/session.log"
+exit $((FAILS > 0))
