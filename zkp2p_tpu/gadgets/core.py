@@ -43,6 +43,7 @@ def num2bits(cs: ConstraintSystem, x: int, n: int, tag: str = "num2bits", hook: 
     for b in bits:
         cs.enforce_bool(b, f"{tag}/bool")
     cs.enforce_eq(lc_sum(bits, [1 << i for i in range(n)]), LC.of(x), f"{tag}/recompose")
+    cs.set_width(x, n)  # recomposition from n bool bits bounds x < 2^n
     if not hook:
         return bits
     import numpy as np
@@ -71,6 +72,8 @@ def bits2num(cs: ConstraintSystem, bits: Sequence[int], tag: str = "bits2num") -
     """Little-endian bit wires -> one wire (no booleanity re-check)."""
     out = cs.new_wire(f"{tag}.out")
     cs.enforce_eq(lc_sum(bits, [1 << i for i in range(len(bits))]), LC.of(out), tag)
+    if all(cs.wire_width.get(b, 254) == 1 for b in bits):
+        cs.set_width(out, len(bits))
     import numpy as np
 
     if len(bits) <= 62:
@@ -187,8 +190,12 @@ def one_hot(cs: ConstraintSystem, idx: int, n: int, tag: str = "onehot") -> List
         cs.enforce(LC.of(idx) - i, LC.of(out), LC(), f"{tag}.{i}/zero")
         invs.append(inv)
         inds.append(out)
+        # ind*(idx-i)=0 with sum(ind)=1 and sum(i*ind)=idx makes each
+        # lane 0/1 for satisfying witnesses (invs stay full-width)
+        cs.set_width(out, 1)
     cs.enforce_eq(lc_sum(inds), LC.const(1), f"{tag}/onehot")
     cs.enforce_eq(lc_sum(inds, list(range(n))), LC.of(idx), f"{tag}/index")
+    cs.set_width(idx, max(1, (n - 1).bit_length()))
 
     def vfn(m, n=n):
         v = m[0]  # (K,) object

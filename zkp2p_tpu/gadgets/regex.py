@@ -74,6 +74,7 @@ class CharClassCache:
             a = LC.of(bits4[0]) if v & 1 else LC.const(1) - LC.of(bits4[0])
             b = LC.of(bits4[1]) if v & 2 else LC.const(1) - LC.of(bits4[1])
             cs.enforce(a, b, LC.of(w), f"{tag}/p")
+            cs.set_width(w, 1)
             pair0.append(w)
         pair1: List[int] = []  # one-hot of bits4[2:4]
         for v in range(4):
@@ -81,11 +82,13 @@ class CharClassCache:
             a = LC.of(bits4[2]) if v & 1 else LC.const(1) - LC.of(bits4[2])
             b = LC.of(bits4[3]) if v & 2 else LC.const(1) - LC.of(bits4[3])
             cs.enforce(a, b, LC.of(w), f"{tag}/q")
+            cs.set_width(w, 1)
             pair1.append(w)
         out: List[int] = []
         for v in range(16):
             w = cs.new_wire(f"{tag}.n{v}")
             cs.enforce(LC.of(pair0[v & 3]), LC.of(pair1[v >> 2]), LC.of(w), f"{tag}/n")
+            cs.set_width(w, 1)
             out.append(w)
 
         def vfn(m):
@@ -136,6 +139,7 @@ class CharClassCache:
                 full_his.append(hi16[h])  # whole row: no product needed
                 continue
             p = cs.new_wire("re.cls.p")
+            cs.set_width(p, 1)  # hi-lane x (disjoint lo-lane sum) is bool
             mask = lc_sum([lo16[l] for l in los])
             cs.enforce(LC.of(hi16[h]), mask, LC.of(p), "re.cls/p")
             row = [len(ins)]
@@ -154,6 +158,7 @@ class CharClassCache:
             return out
         else:
             out = cs.new_wire("re.cls")
+            cs.set_width(out, 1)  # disjoint bool parts sum to 0/1
             cs.enforce_eq(lc_sum(parts + full_his), LC.of(out), "re.cls/sum")
         if parts:
             self._register_indicator_block(
@@ -198,6 +203,7 @@ class CharClassCache:
                 parts = []
                 for h, los in groups:
                     p = cs.new_wire("re.cls.p")
+                    cs.set_width(p, 1)  # hi-lane x (disjoint lo-lane sum)
                     cs.enforce(LC.of(hi16[h]), lc_sum([lo16[l] for l in los]), LC.of(p), "re.cls/p")
                     ins.append(hi16[h])
                     ins.extend(lo16[l] for l in los)
@@ -205,6 +211,7 @@ class CharClassCache:
                 ins.extend(hi16[h] for h in fulls)
                 if needs_sum:
                     o = cs.new_wire("re.cls")
+                    cs.set_width(o, 1)  # disjoint bool parts sum to 0/1
                     cs.enforce_eq(lc_sum(parts + [hi16[h] for h in fulls]), LC.of(o), "re.cls/sum")
                 else:
                     o = parts[0]
@@ -281,6 +288,7 @@ def dfa_scan(
     for j in range(S):
         w = cs.new_wire(f"{tag}.s0.{j}")
         cs.enforce_eq(LC.of(w), LC.const(1 if j == 0 else 0), f"{tag}/init")
+        cs.set_width(w, 1)
         s0.append(w)
     init = np.asarray([1] + [0] * (S - 1), dtype=np.int64)
     cs.compute_block(s0, lambda m, c=init: np.broadcast_to(c[:, None], (S, m.shape[1])), [])
@@ -308,6 +316,7 @@ def dfa_scan(
             ind = class_cols[chars][t]
             p = cs.new_wire(f"{tag}.t{t}.{src}.{dst}.out")
             cs.enforce(LC.of(prev[src]), LC.of(ind), LC.of(p), f"{tag}.t{t}")
+            cs.set_width(p, 1)  # one-hot state x class indicator
             prods.append(p)
             srcs.append(src)
             ind_ins.append(ind)
@@ -319,6 +328,7 @@ def dfa_scan(
         for j in range(S):
             w = cs.new_wire(f"{tag}.s{t + 1}.{j}")
             cs.enforce_eq(lc_sum(terms_by_dst.get(j, [])), LC.of(w), f"{tag}/step")
+            cs.set_width(w, 1)  # deterministic DFA: at most one product fires
             nxt.append(w)
         src_idx = np.asarray(srcs)
         dst_onehot = np.zeros((S, len(prods)), dtype=np.int64)
@@ -342,6 +352,7 @@ def match_count(cs: ConstraintSystem, states: List[List[int]], accept: FrozenSet
     out = cs.new_wire(tag)
     acc_wires = [states[t][a] for t in range(1, len(states)) for a in accept]
     cs.enforce_eq(lc_sum(acc_wires), LC.of(out), tag)
+    cs.set_width(out, max(1, len(acc_wires).bit_length()))
     cs.compute_block([out], lambda m: m.sum(axis=0, keepdims=True), acc_wires)
     return out
 
@@ -369,9 +380,11 @@ def reveal_bytes(
         else:
             mask = cs.new_wire(f"{tag}.m{i}")
             cs.enforce_eq(lc_sum(mask_wires), LC.of(mask), f"{tag}/mask")
+            cs.set_width(mask, 1)  # disjoint one-hot state lanes
             block_outs.append(mask)
         p = cs.new_wire(f"{tag}.{i}.out")
         cs.enforce(LC.of(byte), LC.of(mask), LC.of(p), f"{tag}.{i}")
+        cs.set_width(p, max(cs.wire_width.get(byte, 254), 1))  # byte x bool mask
         block_outs.append(p)
         out.append(p)
 

@@ -59,6 +59,7 @@ def _xor2_bit(cs: ConstraintSystem, x: int, y: int, tag: str) -> int:
     # out = x + y - 2xy  <=>  (2x) * y = x + y - out
     cs.enforce(LC.of(x, 2), LC.of(y), LC.of(x) + LC.of(y) - LC.of(out), tag)
     cs.compute(out, lambda a, b: a ^ b, [x, y])
+    cs.set_width(out, 1)  # xor of bool wires is bool
     return out
 
 
@@ -101,6 +102,7 @@ def _xor_words(cs: ConstraintSystem, words: Sequence[Word], tag: str) -> Word:
         for j, b in enumerate(live[1:]):
             o = cs.new_wire(f"{tag}.{i}.x{j}")
             cs.enforce(LC.of(acc, 2), LC.of(b), LC.of(acc) + LC.of(b) - LC.of(o), f"{tag}.{i}")
+            cs.set_width(o, 1)  # xor chain over bool wires
             chain_wires.append(o)
             sel_rows.append(row)
             sel_cols.append(j + 1)
@@ -159,6 +161,7 @@ def _ch(cs: ConstraintSystem, e: Word, f: Word, g: Word, tag: str) -> Word:
     for i in range(32):
         o = cs.new_wire(f"{tag}.{i}")
         cs.enforce(LC.of(e[i]), LC.of(f[i]) - LC.of(g[i]), LC.of(o) - LC.of(g[i]), f"{tag}/ch")
+        cs.set_width(o, 1)  # mux of bool wires is bool
         out.append(o)
 
     def vfn(m):
@@ -181,6 +184,8 @@ def _maj(cs: ConstraintSystem, a: Word, b: Word, c: Word, tag: str) -> Word:
         cs.enforce(LC.of(a[i]), LC.of(b[i]), LC.of(t), f"{tag}/t")
         o = cs.new_wire(f"{tag}.{i}")
         cs.enforce(LC.of(c[i]), LC.of(a[i]) + LC.of(b[i]) - LC.of(t, 2), LC.of(o) - LC.of(t), f"{tag}/maj")
+        cs.set_width(t, 1)  # and / majority of bool wires are bool
+        cs.set_width(o, 1)
         ts.append(t)
         out.append(o)
 
@@ -293,10 +298,12 @@ def sha256_blocks(
     for wi in range(8):
         for bi in range(32):
             o = cs.new_wire(f"{tag}.out.{wi}.{bi}")
+            cs.set_width(o, 1)  # one-hot select over bool state bits
             prods = []
             for blk in range(max_blocks):
                 p = cs.new_wire(f"{tag}.outp.{wi}.{bi}.{blk}")
                 cs.enforce(LC.of(inds[blk + 1]), LC.of(per_block_out[blk][wi][bi]), LC.of(p), f"{tag}/selmul")
+                cs.set_width(p, 1)
                 prods.append(p)
             cs.enforce_eq(lc_sum(prods), LC.of(o), f"{tag}/selsum")
             block_outs.extend(prods)

@@ -46,11 +46,14 @@ def shift_window(
                 continue
             p = cs.new_wire(f"{tag}.p{j}.{i}.out")
             cs.enforce(LC.of(ind), LC.of(data[i + j]), LC.of(p), f"{tag}.p{j}.{i}")
+            # one-hot lane x data byte: bounded by the data wire's width
+            cs.set_width(p, cs.wire_width.get(data[i + j], 254))
             prods.append(p)
             block_outs.append(p)
             rows.append((j, i))
         w = cs.new_wire(f"{tag}.out{j}")
         cs.enforce_eq(core.lc_sum(prods), LC.of(w), f"{tag}/sum{j}")
+        cs.set_width(w, max((cs.wire_width.get(q, 254) for q in prods), default=254))
         block_outs.append(w)
         out.append(w)
 

@@ -119,6 +119,18 @@ class DeviceProvingKey:
     h_bases: AffPoint  # coset-Lagrange H basis, m lanes (zkey section 9)
     b_sel: jnp.ndarray  # wire indices backing b1/b2 lanes
     c_sel: jnp.ndarray  # wire indices backing c lanes
+    # Width-classed MSM split (snark.r1cs wire_width: constraint-backed
+    # value bounds — ~90% of venmo wires are SHA/DFA bits).  Positions
+    # into each query's base array whose wire value is provably < 2^11
+    # ("narrow": 3 signed w=4 digit planes suffice) vs the rest ("wide").
+    # Empty narrow arrays (zkey import, width-free circuits) degrade to
+    # the single-class path.
+    a_nsel: jnp.ndarray
+    a_wsel: jnp.ndarray
+    b_nsel: jnp.ndarray
+    b_wsel: jnp.ndarray
+    c_nsel: jnp.ndarray
+    c_wsel: jnp.ndarray
     # Host-side blinding points for final assembly.
     alpha_1: G1Point
     beta_1: G1Point
@@ -131,6 +143,7 @@ _DPK_ARRAY_FIELDS = (
     "a_coeff", "a_wire", "a_row", "b_coeff", "b_wire", "b_row",
     "a_bases", "b1_bases", "b2_bases", "c_bases", "h_bases",
     "b_sel", "c_sel",
+    "a_nsel", "a_wsel", "b_nsel", "b_wsel", "c_nsel", "c_wsel",
 )
 _DPK_META_FIELDS = ("n_public", "n_wires", "log_m", "alpha_1", "beta_1", "beta_2", "delta_1", "delta_2")
 
@@ -167,13 +180,45 @@ def _rows_to_arrays(rows: Sequence[dict], m: int) -> Tuple[jnp.ndarray, jnp.ndar
     )
 
 
+# Width classing (see DeviceProvingKey): wires with constraint-backed
+# value bounds < 2^NARROW_WIDTH need only NARROW_PLANES signed w=4 digit
+# planes (k planes exactly hold v < 2^(4k-1) after signed recoding).
+NARROW_WIDTH = 11
+NARROW_PLANES = 3
+
+
+def widths_array(cs: "ConstraintSystem") -> np.ndarray:
+    """cs.wire_width dict -> dense per-wire bound array (254 = unbounded)."""
+    widths = np.full(cs.num_wires, 254, dtype=np.int32)
+    for w, bits in cs.wire_width.items():
+        widths[w] = bits
+    return widths
+
+
+def class_sels(widths: Optional[np.ndarray], wire_ids: np.ndarray):
+    """(narrow positions, wide positions) into a base array whose row p
+    holds the point for wire wire_ids[p] — THE classing rule, shared by
+    device_pk_from_rows and setup_device so the dev-setup and pk-import
+    paths can never drift."""
+    if widths is None:
+        n = len(wire_ids)
+        return np.zeros(0, dtype=np.int32), np.arange(n, dtype=np.int32)
+    narrow = widths[wire_ids] <= NARROW_WIDTH
+    return (
+        np.flatnonzero(narrow).astype(np.int32),
+        np.flatnonzero(~narrow).astype(np.int32),
+    )
+
+
 def device_pk(pk: ProvingKey, cs: ConstraintSystem) -> DeviceProvingKey:
     """Host ProvingKey + R1CS -> device arrays.  One-time load, amortised
     over every proof (the TPU analog of the browser's IndexedDB zkey cache,
     `app/src/helpers/zkp.ts:56-61`)."""
     rows = qap_rows(cs)
+    widths = widths_array(cs)
     return device_pk_from_rows(
-        pk, [t[0] for t in rows], [t[1] for t in rows], domain_size_for(cs), cs.num_wires
+        pk, [t[0] for t in rows], [t[1] for t in rows], domain_size_for(cs), cs.num_wires,
+        widths=widths,
     )
 
 
@@ -181,7 +226,8 @@ def device_pk_from_zkey(zk) -> DeviceProvingKey:
     """snarkjs zkey (formats.zkey.ZkeyData) -> device arrays: the
     ceremony-key import path (`app/src/helpers/zkp.ts:13` chunk flow).
     The zkey coeff section already contains the public binding rows, so
-    the QAP rows come from the file, not from a ConstraintSystem."""
+    the QAP rows come from the file, not from a ConstraintSystem — and
+    carries no width metadata, so every wire rides the wide class."""
     a_rows, b_rows = zk.qap_row_arrays()
     return device_pk_from_rows(zk.to_proving_key(), a_rows, b_rows, zk.domain_size, zk.n_vars)
 
@@ -199,6 +245,7 @@ def device_pk_from_rows(
     b_rows: Sequence[dict],
     m: int,
     n_wires: int,
+    widths: Optional[np.ndarray] = None,
 ) -> DeviceProvingKey:
     log_m = m.bit_length() - 1
     a = _rows_to_arrays(a_rows, m)
@@ -208,6 +255,11 @@ def device_pk_from_rows(
         [p1 is not None or p2 is not None for p1, p2 in zip(pk.b1_query, pk.b2_query)]
     )
     c_sel = _prune_sel([p is not None for p in pk.c_query])
+
+    all_wires = np.arange(n_wires, dtype=np.int32)
+    a_nsel, a_wsel = class_sels(widths, all_wires)
+    b_nsel, b_wsel = class_sels(widths, np.asarray(b_sel))
+    c_nsel, c_wsel = class_sels(widths, np.asarray(c_sel))
     return DeviceProvingKey(
         n_public=pk.n_public,
         n_wires=n_wires,
@@ -221,6 +273,9 @@ def device_pk_from_rows(
         h_bases=g1_to_affine_arrays(h_pts),
         b_sel=jnp.asarray(b_sel),
         c_sel=jnp.asarray(c_sel),
+        a_nsel=jnp.asarray(a_nsel), a_wsel=jnp.asarray(a_wsel),
+        b_nsel=jnp.asarray(b_nsel), b_wsel=jnp.asarray(b_wsel),
+        c_nsel=jnp.asarray(c_nsel), c_wsel=jnp.asarray(c_wsel),
         alpha_1=pk.alpha_1,
         beta_1=pk.beta_1,
         beta_2=pk.beta_2,
@@ -268,9 +323,21 @@ def h_evals(dpk: DeviceProvingKey, w_mont: jnp.ndarray) -> jnp.ndarray:
 def _h_and_planes(dpk: DeviceProvingKey, w_mont: jnp.ndarray):
     h = h_evals(dpk, w_mont)
     if MSM_SIGNED:
-        w_mags, w_negs = signed_digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW)
+        w_std = FR.from_mont(w_mont)
+        w_mags, w_negs = signed_digit_planes_from_limbs(w_std, MSM_WINDOW)
         h_mags, h_negs = signed_digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW)
-        return (w_mags, w_negs), (h_mags, h_negs)
+        # Narrow-class planes: witness wires with width bounds <= 2^11
+        # only populate the last NARROW_PLANES signed w=4 digits — the
+        # upper 61 planes are provably zero and never reach an MSM.
+        # Keys with no narrow class (zkey import) skip the w=4 recode
+        # entirely — shapes are static under jit, so this prunes at
+        # trace time.
+        if int(dpk.a_nsel.shape[0]) > 0:
+            n4_mags, n4_negs = signed_digit_planes_from_limbs(w_std, 4)
+            narrow = (n4_mags[-NARROW_PLANES:], n4_negs[-NARROW_PLANES:])
+        else:
+            narrow = ()
+        return ((w_mags, w_negs), narrow), (h_mags, h_negs)
     return (
         digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW),
         digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW),
@@ -286,6 +353,23 @@ def _msm_g1(bases, planes):
         mags, negs = planes
         return msm_windowed_signed(G1J, bases, mags, negs, lanes=lanes, window=MSM_WINDOW)
     return msm_windowed(G1J, bases, planes, lanes=lanes, window=MSM_WINDOW)
+
+
+def _msm_g1_narrow(bases, planes):
+    # 3-plane signed w=4 MSM for width-bounded wires: ~3.5 adds/pt at
+    # batch=16 vs ~40 on the wide path.  Wider lanes keep the per-step
+    # batch (NARROW_PLANES x lanes) off the latency floor.
+    mags, negs = planes
+    return msm_windowed_signed(
+        G1J, bases, mags, negs, lanes=default_lanes(bases[0].shape[0], cap=16384), window=4
+    )
+
+
+def _msm_g2_narrow(bases, planes):
+    mags, negs = planes
+    return msm_windowed_signed(
+        G2J, bases, mags, negs, lanes=default_lanes(bases[0].shape[0], cap=4096), window=4
+    )
 
 
 def _msm_g2(bases, planes):
@@ -306,58 +390,134 @@ def _msm_g2(bases, planes):
 _jit_h_planes = jax.jit(_h_and_planes)
 _jit_msm_g1 = jax.jit(_msm_g1)
 _jit_msm_g2 = jax.jit(_msm_g2)
+_jit_msm_g1_narrow = jax.jit(_msm_g1_narrow)
+_jit_msm_g2_narrow = jax.jit(_msm_g2_narrow)
 _jit_h_planes_batch = jax.jit(jax.vmap(_h_and_planes, in_axes=(None, 0)))
 _jit_msm_g1_batch = jax.jit(jax.vmap(_msm_g1, in_axes=(None, 0)))
 _jit_msm_g2_batch = jax.jit(jax.vmap(_msm_g2, in_axes=(None, 0)))
+_jit_msm_g1_narrow_batch = jax.jit(jax.vmap(_msm_g1_narrow, in_axes=(None, 0)))
+_jit_msm_g2_narrow_batch = jax.jit(jax.vmap(_msm_g2_narrow, in_axes=(None, 0)))
+
+
+def _take_planes(planes, sel):
+    # signed planes are a (mags, negs) pair; both gather on wires
+    if isinstance(planes, tuple):
+        return tuple(jnp.take(p, sel, axis=-1) for p in planes)
+    return jnp.take(planes, sel, axis=-1)
+
+
+def _take_bases(bases, pos):
+    return tuple(jnp.take(c, pos, axis=0) for c in bases)
+
+
+def _pad_msm(bases, planes, n_to: int):
+    """Pad an MSM's inputs to `n_to` bases: the (0, 0) infinity sentinel
+    and zero digit planes contribute nothing, and equal shapes let MSMs
+    share one compiled executable."""
+    n = bases[0].shape[0]
+    if n_to and n < n_to:
+        bases = tuple(jnp.pad(c, [(0, n_to - n)] + [(0, 0)] * (c.ndim - 1)) for c in bases)
+        if isinstance(planes, tuple):
+            planes = tuple(jnp.pad(p, [(0, 0)] * (p.ndim - 1) + [(0, n_to - n)]) for p in planes)
+        else:
+            planes = jnp.pad(planes, [(0, 0)] * (planes.ndim - 1) + [(0, n_to - n)])
+    return bases, planes
 
 
 def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = False):
     """The five big MSMs; everything else about the proof is host-cheap.
-    The b/c MSMs run only over their pruned non-infinity lanes — the
-    plane columns are gathered through b_sel/c_sel (last axis = wires)."""
+    The b/c MSMs run only over their pruned non-infinity lanes (plane
+    columns gathered through b_sel/c_sel), and with width metadata each
+    witness MSM splits into a narrow class (3 signed w=4 planes — the
+    ~90% of wires that are constraint-bounded bits/bytes) and a wide
+    class (full planes); the two partial sums combine with one Jacobian
+    add per query."""
+    classed = MSM_SIGNED and int(dpk.a_nsel.shape[0]) > 0
     jh, m1, m2 = (
         (_jit_h_planes_batch, _jit_msm_g1_batch, _jit_msm_g2_batch)
         if batched
         else (_jit_h_planes, _jit_msm_g1, _jit_msm_g2)
     )
-    w_planes, h_planes = jh(dpk, w_mont)
+    m1n, m2n = (
+        (_jit_msm_g1_narrow_batch, _jit_msm_g2_narrow_batch)
+        if batched
+        else (_jit_msm_g1_narrow, _jit_msm_g2_narrow)
+    )
+    w_all, h_planes = jh(dpk, w_mont)
+    if MSM_SIGNED:
+        w_planes, w_narrow = w_all
+    else:
+        w_planes, w_narrow = w_all, None
 
-    def take(planes, sel):
-        # signed planes are a (mags, negs) pair; both gather on wires
-        if isinstance(planes, tuple):
-            return tuple(jnp.take(p, sel, axis=-1) for p in planes)
-        return jnp.take(planes, sel, axis=-1)
-
-    b_planes = take(w_planes, dpk.b_sel)
-    c_planes = take(w_planes, dpk.c_sel)
-
-    g1_n = 0
-    if _unified():
-        g1_n = max(
+    if not classed:
+        g1_n = 0 if not _unified() else max(
             dpk.a_bases[0].shape[0], dpk.b1_bases[0].shape[0],
             dpk.c_bases[0].shape[0], dpk.h_bases[0].shape[0],
         )
+        b_planes = _take_planes(w_planes, dpk.b_sel)
+        c_planes = _take_planes(w_planes, dpk.c_sel)
+        return (
+            m1(*_pad_msm(dpk.a_bases, w_planes, g1_n)),
+            m1(*_pad_msm(dpk.b1_bases, b_planes, g1_n)),
+            m2(dpk.b2_bases, b_planes),
+            m1(*_pad_msm(dpk.c_bases, c_planes, g1_n)),
+            m1(*_pad_msm(dpk.h_bases, h_planes, g1_n)),
+        )
 
-    def g1(bases, planes):
-        # Unified shape: pad bases with the (0, 0) infinity sentinel and
-        # planes with zero digits — contributes nothing, and all four G1
-        # MSMs then share one compiled executable (pad at trace time, so
-        # the DeviceProvingKey layout and key cache stay unchanged).
-        n = bases[0].shape[0]
-        if g1_n and n < g1_n:
-            bases = tuple(jnp.pad(c, [(0, g1_n - n)] + [(0, 0)] * (c.ndim - 1)) for c in bases)
-            if isinstance(planes, tuple):
-                planes = tuple(jnp.pad(p, [(0, 0)] * (p.ndim - 1) + [(0, g1_n - n)]) for p in planes)
-            else:
-                planes = jnp.pad(planes, [(0, 0)] * (planes.ndim - 1) + [(0, g1_n - n)])
-        return m1(bases, planes)
+    # Unify shapes WITHIN each class (a/b1/c wide together, narrows
+    # together) but NOT with the h MSM: the wide query classes are ~6%
+    # of wires while h spans the full domain — padding them to h's size
+    # would burn ~16x the work the classing just removed.  Three G1
+    # executables total (narrow, query-wide, h).
+    g1_wide_n = g1_narrow_n = 0
+    if _unified():
+        g1_wide_n = max(dpk.a_wsel.shape[0], dpk.b_wsel.shape[0], dpk.c_wsel.shape[0])
+        g1_narrow_n = max(dpk.a_nsel.shape[0], dpk.b_nsel.shape[0], dpk.c_nsel.shape[0])
+
+    # The split bases/wire arrays depend only on the KEY — memoise them
+    # on the dpk instance so the gathers (O(key size) HBM copies) run
+    # once per key, not once per proof.
+    split = getattr(dpk, "_split_cache", None)
+    if split is None:
+        split = {}
+        setattr(dpk, "_split_cache", split)
+
+    def key_split(name, bases, sel, wires_of):
+        got = split.get((name, "b"))
+        if got is None:
+            got = _take_bases(bases, sel)
+            split[(name, "b")] = got
+            split[(name, "w")] = jnp.take(wires_of, sel) if wires_of is not None else sel
+        return got, split[(name, "w")]
+
+    def query(name, bases, nsel, wsel, wires_of):
+        """One witness MSM (a/b1/c): narrow + wide class partial sums.
+        wires_of maps base positions to wire ids (None = identity)."""
+        accs = []
+        if int(nsel.shape[0]):
+            nb, nw = key_split(name + ".n", bases, nsel, wires_of)
+            accs.append(m1n(*_pad_msm(nb, _take_planes(w_narrow, nw), g1_narrow_n)))
+        if int(wsel.shape[0]):
+            wb, ww = key_split(name + ".w", bases, wsel, wires_of)
+            accs.append(m1(*_pad_msm(wb, _take_planes(w_planes, ww), g1_wide_n)))
+        return accs[0] if len(accs) == 1 else G1J.add(accs[0], accs[1])
+
+    def query_g2(name, bases, nsel, wsel, wires_of):
+        accs = []
+        if int(nsel.shape[0]):
+            nb, nw = key_split(name + ".n", bases, nsel, wires_of)
+            accs.append(m2n(nb, _take_planes(w_narrow, nw)))
+        if int(wsel.shape[0]):
+            wb, ww = key_split(name + ".w", bases, wsel, wires_of)
+            accs.append(m2(wb, _take_planes(w_planes, ww)))
+        return accs[0] if len(accs) == 1 else G2J.add(accs[0], accs[1])
 
     return (
-        g1(dpk.a_bases, w_planes),
-        g1(dpk.b1_bases, b_planes),
-        m2(dpk.b2_bases, b_planes),
-        g1(dpk.c_bases, c_planes),
-        g1(dpk.h_bases, h_planes),
+        query("a", dpk.a_bases, dpk.a_nsel, dpk.a_wsel, None),
+        query("b1", dpk.b1_bases, dpk.b_nsel, dpk.b_wsel, dpk.b_sel),
+        query_g2("b2", dpk.b2_bases, dpk.b_nsel, dpk.b_wsel, dpk.b_sel),
+        query("c", dpk.c_bases, dpk.c_nsel, dpk.c_wsel, dpk.c_sel),
+        m1(dpk.h_bases, h_planes),
     )
 
 

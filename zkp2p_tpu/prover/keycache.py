@@ -21,7 +21,7 @@ from .groth16_tpu import _DPK_ARRAY_FIELDS, DeviceProvingKey
 # Bump whenever _DPK_ARRAY_FIELDS / the npz layout changes: a cache written
 # by an older schema must fail fast here (triggering re-setup upstream),
 # not materialize empty arrays that crash deep inside jit (r3 advisor).
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3  # v3: width-classed MSM position arrays (a/b/c x narrow/wide)
 
 
 class KeyCacheSchemaError(RuntimeError):
@@ -43,6 +43,15 @@ def circuit_digest(cs) -> str:
     for i in range(0, n, step):
         c = cs.constraints[i]
         h.update(repr((i, sorted(c.a.items()), sorted(c.b.items()), sorted(c.c.items()))).encode())
+    # The v3 cache stores narrow/wide classification arrays derived from
+    # wire_width and the NARROW_WIDTH rule — a width-tag or rule change
+    # with unchanged constraints must invalidate the cache, or the prover
+    # would silently drop nonzero digit planes (caught only at verify).
+    from .groth16_tpu import NARROW_PLANES, NARROW_WIDTH
+
+    h.update(f"|nw{NARROW_WIDTH}|np{NARROW_PLANES}|".encode())
+    widths = getattr(cs, "wire_width", {})
+    h.update(hashlib.sha256(repr(sorted(widths.items())).encode()).digest())
     return h.hexdigest()[:16]
 
 

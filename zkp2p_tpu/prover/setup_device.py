@@ -112,6 +112,17 @@ def setup_device(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[Dev
 
     a_arr = _rows_to_arrays([t[0] for t in rows], m)
     b_arr = _rows_to_arrays([t[1] for t in rows], m)
+
+    # Width-classed MSM split — THE shared rule from groth16_tpu
+    # (class_sels), so this dev-setup path and the pk-import path can
+    # never drift.  The degenerate [0] fallback lanes are infinity
+    # bases, harmless in either class.
+    from .groth16_tpu import class_sels, widths_array
+
+    widths = widths_array(cs)
+    a_nsel, a_wsel = class_sels(widths, np.arange(n_wires, dtype=np.int32))
+    b_nsel, b_wsel = class_sels(widths, np.asarray(b_sel))
+    c_nsel, c_wsel = class_sels(widths, np.asarray(c_sel))
     dpk = DeviceProvingKey(
         n_public=cs.num_public,
         n_wires=n_wires,
@@ -125,6 +136,9 @@ def setup_device(cs: ConstraintSystem, seed: str = "zkp2p-tpu-dev") -> Tuple[Dev
         h_bases=tuple(jnp.asarray(x) for x in h_bases),
         b_sel=jnp.asarray(b_sel),
         c_sel=jnp.asarray(c_sel),
+        a_nsel=jnp.asarray(a_nsel), a_wsel=jnp.asarray(a_wsel),
+        b_nsel=jnp.asarray(b_nsel), b_wsel=jnp.asarray(b_wsel),
+        c_nsel=jnp.asarray(c_nsel), c_wsel=jnp.asarray(c_wsel),
         alpha_1=g1_gen_mul(alpha),
         beta_1=g1_gen_mul(beta),
         beta_2=g2_gen_mul(beta),

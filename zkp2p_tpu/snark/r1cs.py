@@ -145,6 +145,13 @@ class ConstraintSystem:
         self.hooks: List[ComputeHook] = []
         self._public_frozen = False
         self.labels: Dict[int, str] = {0: "one"}
+        # Static value-width bounds (bits), PROVEN by constraints for any
+        # satisfying witness (booleanity, num2bits recomposition, ...).
+        # The prover's width-classed MSM drops the provably-zero scalar
+        # digit planes of narrow wires — ~90% of venmo wires are bits
+        # (SHA/DFA), so this is the structured-scalar analog of
+        # rapidsnark's bit-concentrated-digit fast path.  Absent = 254.
+        self.wire_width: Dict[int, int] = {0: 1}
 
     # ---------------------------------------------------------- allocation
 
@@ -187,6 +194,19 @@ class ConstraintSystem:
     def enforce_bool(self, w: int, tag: str = "") -> None:
         """w * (w - 1) = 0."""
         self.enforce(LC.of(w), LC.of(w) - 1, LC(), tag or "bool")
+        self.set_width(w, 1)
+
+    def set_width(self, w: int, bits: int) -> None:
+        """Record a constraint-backed value-width bound for wire `w`.
+
+        ONLY call where a constraint actually enforces value < 2^bits for
+        every satisfying witness — the width-classed MSM silently drops
+        the digit planes above the bound (a wrong tag would emit a proof
+        that fails verification, never a wrong-but-verifying one, since
+        pi stays on the curve but differs from the honest proof)."""
+        cur = self.wire_width.get(w, 254)
+        if bits < cur:
+            self.wire_width[w] = bits
 
     # ---------------------------------------------------------- witness gen
 
