@@ -24,3 +24,52 @@ def test_venmo_modulus_limb_roundtrip():
     n = venmo_modulus_int()
     assert n.bit_length() == 1024  # the production key is RSA-1024
     assert int_to_limbs_host(n, 121, 17) == VENMO_RSA_KEY_LIMBS
+
+
+def test_signed_digit_recoding_reconstructs():
+    """Signed w=4/w=8 recoding (ops.msm): digits reconstruct the scalar
+    exactly, magnitudes stay within the half-table bound, and the edge
+    scalars (0, 1, R-1, all-half digits) carry correctly.  Fast: pure
+    plane plumbing, no curve ops."""
+    import numpy as np
+
+    from zkp2p_tpu.field.bn254 import R
+    from zkp2p_tpu.field.jfield import FR
+    from zkp2p_tpu.ops.msm import signed_digit_planes_from_limbs
+
+    import random
+
+    rng = random.Random(3)
+    scalars = [rng.randrange(R) for _ in range(32)] + [
+        0, 1, R - 1, int("8" * 63, 16), (1 << 252) - 1
+    ]
+    import jax.numpy as jnp
+
+    limbs = jnp.asarray(np.stack([FR.to_std_host(s) for s in scalars]))
+    for w in (4, 8):
+        mags, negs = (np.asarray(a) for a in signed_digit_planes_from_limbs(limbs, w))
+        assert mags.max() <= (1 << (w - 1))
+        nd = 256 // w
+        for j, s in enumerate(scalars):
+            v = 0
+            for k in range(nd):  # MSB first
+                v = (v << w) + int(mags[k, j]) * (-1 if negs[k, j] else 1)
+            assert v == s, (w, j)
+
+
+def test_check_widths_rejects_violations():
+    """A violated width tag must raise loudly (the classed MSM would
+    otherwise only fail at pairing verification), and values that are
+    only unreduced (v + R) must NOT be rejected."""
+    import pytest
+
+    from zkp2p_tpu.field.bn254 import R
+    from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+    cs = ConstraintSystem("widths")
+    x = cs.new_wire("x")
+    cs.enforce_bool(x, "b")
+    cs.check_widths([1, 1])          # in bound
+    cs.check_widths([1, 1 + R])      # unreduced alias of 1: accepted
+    with pytest.raises(AssertionError, match="width bound"):
+        cs.check_widths([1, 2])      # 2 >= 2^1

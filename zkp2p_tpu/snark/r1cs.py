@@ -472,7 +472,9 @@ class ConstraintSystem:
     def check_witness(self, w: Sequence[int]) -> None:
         """Assert every constraint is satisfied (the Az*Bz=Cz self-check —
         the ZK analog of the reference's `circom --inspect` lint, see
-        SURVEY.md §5 race-detection)."""
+        SURVEY.md §5 race-detection), plus every wire_width tag (a wrong
+        width tag would make the classed MSM drop nonzero digit planes —
+        failing only at pairing verification; this localises it)."""
         for idx, con in enumerate(self.constraints):
             a = sum(c * w[i] for i, c in con.a.items()) % R
             b = sum(c * w[i] for i, c in con.b.items()) % R
@@ -480,6 +482,20 @@ class ConstraintSystem:
             if a * b % R != c_:
                 raise AssertionError(
                     f"constraint {idx} ({con.tag}) unsatisfied: {a}*{b} != {c_}"
+                )
+        self.check_widths(w)
+
+    def check_widths(self, w: Sequence[int]) -> None:
+        """Assert every constraint-backed width bound actually holds for
+        this witness (prover.groth16_tpu width classing relies on it).
+        Values reduce mod R first, matching the constraint loop — an
+        unreduced-but-equivalent witness must not be rejected."""
+        for i, bits in self.wire_width.items():
+            v = w[i] % R
+            if v >= (1 << bits):
+                raise AssertionError(
+                    f"wire {i} ({self.labels.get(i, '?')}): value {v} exceeds "
+                    f"its tagged width bound of {bits} bits"
                 )
 
     # ---------------------------------------------------------- stats
