@@ -112,3 +112,29 @@ def test_dfa_gadget_venmo_id_reveal():
     revealed = bytes(w[r] for r in rev)
     # the accept states cover the payload chars after "user_id=3D"
     assert revealed.rstrip(b"\x00")[-6:] == b"4499\r\n"[-6:]
+
+
+def test_lookup_table_artifact(tmp_path):
+    """The halo2-analog lookup artifact (`gen.py:41-51`): every row must
+    be a real transition, every non-DEAD transition must appear, and the
+    DFA must be reconstructible from the rows."""
+    from zkp2p_tpu.regexc.compiler import DEAD, VENMO_AMOUNT, compile_regex
+
+    dfa = compile_regex(VENMO_AMOUNT)
+    rows = dfa.lookup_rows()
+    assert rows, "amount DFA has transitions"
+    seen = set()
+    for src, dst, c in rows:
+        assert int(dfa.next[src, c]) == dst
+        seen.add((src, c))
+    for s in range(dfa.n_states):
+        for c in range(256):
+            if int(dfa.next[s, c]) != DEAD:
+                assert (s, c) in seen
+
+    out = tmp_path / "lookup.txt"
+    dfa.emit_lookup_table(str(out))
+    lines = out.read_text().splitlines()
+    accepts = [int(x) for x in lines[0].split()]
+    assert set(accepts) == set(dfa.accept)
+    assert len(lines) - 1 == len(rows)

@@ -195,6 +195,29 @@ class DFA:
         final = states[-1] if states else 0
         return final in self.accept
 
+    def lookup_rows(self) -> List[Tuple[int, int, int]]:
+        """Dense (src, dst, byte) transition triples, DEAD edges omitted —
+        the lookup-argument artifact of the reference's regex compiler
+        (`regex_to_circom/gen.py` OUTPUT_HALO2 path): a lookup proof
+        system shows each scan step's (state, char, state') row is in
+        this table instead of compiling per-transition constraints."""
+        rows = []
+        for st in range(self.n_states):
+            for c in range(ALPHABET):
+                d = int(self.next[st, c])
+                if d != DEAD:
+                    rows.append((st, int(d), c))
+        return rows
+
+    def emit_lookup_table(self, path: str) -> None:
+        """Write the lookup artifact in the reference's file format
+        (`halo2_regex_lookup.txt`, gen.py:41-51): line 1 = the accept
+        states, then one `src dst char` row per dense transition."""
+        with open(path, "w") as f:
+            f.write(" ".join(str(a) for a in sorted(self.accept)) + " \n")
+            for src, dst, c in self.lookup_rows():
+                f.write(f"{src} {dst} {c}\n")
+
     def transitions(self) -> List[Tuple[int, int, FrozenSet[int]]]:
         """(src, dst, charset) triples, DEAD edges omitted — the gadget's
         sparse view."""
