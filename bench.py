@@ -43,27 +43,14 @@ def _init_backend():
     HANG rather than raise — so probe the TPU in a subprocess with a
     timeout first, and pin the platform to CPU through the config API
     when the probe fails.  The bench must always emit a JSON record."""
-    import subprocess
+    from zkp2p_tpu.utils.jaxcfg import enable_cache, tpu_probe_ok
 
     tpu_ok = False
     if not os.environ.get("BENCH_FORCE_CPU"):
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-                capture_output=True,
-                timeout=int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120")),
-                text=True,
-            )
-            # Match on the platform attribute, not the repr: the device repr
-            # has changed across plugin versions ("TpuDevice" -> "TPU v5
-            # lite0"), and a repr-substring check silently diverted a
-            # healthy-TPU run to the CPU fallback tier.
-            tpu_ok = probe.returncode == 0 and "tpu" in probe.stdout.lower()
-        except subprocess.TimeoutExpired:
-            log("TPU probe timed out (tunnel down?)")
+        tpu_ok = tpu_probe_ok()
+        if not tpu_ok:
+            log("TPU probe failed (tunnel down?)")
     import jax
-
-    from zkp2p_tpu.utils.jaxcfg import enable_cache
 
     enable_cache()
     if not tpu_ok:

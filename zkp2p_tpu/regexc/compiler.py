@@ -201,13 +201,10 @@ class DFA:
         (`regex_to_circom/gen.py` OUTPUT_HALO2 path): a lookup proof
         system shows each scan step's (state, char, state') row is in
         this table instead of compiling per-transition constraints."""
-        rows = []
-        for st in range(self.n_states):
-            for c in range(ALPHABET):
-                d = int(self.next[st, c])
-                if d != DEAD:
-                    rows.append((st, int(d), c))
-        return rows
+        return [
+            (int(s), int(self.next[s, c]), int(c))
+            for s, c in np.argwhere(self.next != DEAD)
+        ]
 
     def emit_lookup_table(self, path: str) -> None:
         """Write the lookup artifact in the reference's file format

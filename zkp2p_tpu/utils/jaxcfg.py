@@ -48,3 +48,29 @@ def enable_cache(path: str | None = None) -> None:
     jax.config.update("jax_compilation_cache_dir", cache_dir(path))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def tpu_probe_ok(timeout: int | None = None) -> bool:
+    """Probe the TPU in a SUBPROCESS with a timeout.
+
+    The axon plugin force-selects its platform through jax.config
+    (overriding JAX_PLATFORMS) and a wedged tunnel makes backend init
+    HANG rather than raise — so any entry point that must always
+    complete (bench, the driver's entry() compile check) probes here
+    first and pins `jax.config.update("jax_platforms", "cpu")` when the
+    probe fails.  Timeout from BENCH_TPU_PROBE_TIMEOUT (default 120 s).
+    Matches on the device's platform attribute, not the repr (which has
+    changed across plugin versions)."""
+    import subprocess
+    import sys
+
+    if timeout is None:
+        timeout = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120"))
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout, text=True,
+        )
+        return probe.returncode == 0 and "tpu" in probe.stdout.lower()
+    except subprocess.TimeoutExpired:
+        return False
