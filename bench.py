@@ -46,7 +46,11 @@ def _init_backend():
     from zkp2p_tpu.utils.jaxcfg import enable_cache, tpu_probe_ok
 
     tpu_ok = False
-    if not os.environ.get("BENCH_FORCE_CPU"):
+    if os.environ.get("BENCH_TPU_INNER"):
+        # the guard parent just proved the tunnel healthy — don't spend
+        # the child's compile budget re-proving it
+        tpu_ok = True
+    elif not os.environ.get("BENCH_FORCE_CPU"):
         tpu_ok = tpu_probe_ok()
         if not tpu_ok:
             log("TPU probe failed (tunnel down?)")
@@ -164,12 +168,16 @@ def _native_fallback_bench(plat: str) -> bool:
     log(f"native fallback: venmo {cs.num_constraints} constraints, first={first:.1f}s steady={best:.1f}s")
     dump_trace()
     vs = ((1 / best) * cs.num_constraints / BASELINE_CONSTRAINTS) / BASELINE_PROOFS_PER_SEC
+    # Name the true reason this tier ran: a guard degradation (tunnel UP
+    # but the TPU tier over budget / crashed) must not masquerade as a
+    # tunnel outage in the driver's record.
+    why = os.environ.get("BENCH_DEGRADED", "TPU TUNNEL DOWN")
     print(
         json.dumps(
             {
                 "metric": "venmo_groth16_proofs_per_sec_constraint_normalized",
                 "value": round(1 / best, 4),
-                "unit": f"proofs/s @ {cs.num_constraints}-constraint venmo ({HEADER}/{BODY}), native C++ prover, 1 {plat} core (TPU TUNNEL DOWN)",
+                "unit": f"proofs/s @ {cs.num_constraints}-constraint venmo ({HEADER}/{BODY}), native C++ prover, 1 {plat} core ({why})",
                 "vs_baseline": round(vs, 4),
             }
         )
@@ -216,7 +224,79 @@ def _cpu_fallback_bench(plat: str):
     )
 
 
+def _tpu_tier_guarded() -> bool:
+    """Run the TPU tier in a CHILD process under a hard time budget.
+
+    A cold box pays every TPU executable compile inside the driver's
+    bench window (r2 measured 1,124 s first-compile) — if the child
+    overruns BENCH_TPU_BUDGET (default 550 s) or dies, the parent still
+    has time to record the native tier instead of handing the driver a
+    timeout.  The child's JSON line is relayed verbatim.  Returns True
+    if a record was emitted."""
+    import signal
+    import subprocess
+
+    budget = int(os.environ.get("BENCH_TPU_BUDGET", "550"))
+    env = dict(os.environ, BENCH_TPU_INNER="1")
+    # Own session so a timeout kills the WHOLE process group — a plain
+    # child kill would orphan grandchildren (e.g. a hung probe) that
+    # keep holding the single-chip tunnel.
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        log(f"TPU tier exceeded its {budget}s budget (cold compiles?); falling back to the native tier")
+        os.environ["BENCH_DEGRADED"] = f"TPU TIER OVER {budget}s BUDGET"
+        return False
+    sys.stderr.write(stderr)
+    lines = [ln for ln in stdout.splitlines() if ln.strip().startswith("{")]
+    if proc.returncode == 0 and lines:
+        try:
+            rec = json.loads(lines[-1])
+            if "metric" in rec and rec["metric"] != "bench_failed":
+                print(lines[-1])
+                return True
+        except ValueError:
+            pass
+    log(f"TPU tier child failed (rc={proc.returncode}); falling back to the native tier")
+    os.environ["BENCH_DEGRADED"] = f"TPU TIER FAILED rc={proc.returncode}"
+    return False
+
+
 def main():
+    # The TPU-tier guard must run BEFORE this process touches the
+    # backend: the single-chip tunnel dial blocks while another process
+    # holds the chip, so a parent that initialised the TPU would
+    # deadlock its own child.  On guard failure the parent degrades to
+    # the CPU/native tier without ever dialing the tunnel itself.
+    if (
+        not os.environ.get("BENCH_TPU_INNER")
+        and not os.environ.get("BENCH_DRY")
+        and not os.environ.get("BENCH_NO_GUARD")
+        and not os.environ.get("BENCH_FORCE_CPU")
+        and not os.environ.get("BENCH_FORCE_VENMO")
+    ):
+        from zkp2p_tpu.utils.jaxcfg import tpu_probe_ok
+
+        if tpu_probe_ok():
+            if _tpu_tier_guarded():
+                return
+            os.environ["BENCH_FORCE_CPU"] = "1"  # degrade tunnel-free
+        else:
+            # Probe already failed here — skip _init_backend's second
+            # 120 s probe and go straight to the fallback tier.
+            log("TPU probe failed (tunnel down?)")
+            os.environ["BENCH_FORCE_CPU"] = "1"
+
     devs, fell_back = _init_backend()
     log("devices:", devs)
     # Route on the PROBE RESULT, not env state (a stale BENCH_FALLBACK
