@@ -38,3 +38,26 @@ def test_pallas_mont_padding_and_batch_dims():
     got = mont_mul(FR, a, b, interpret=True)
     assert got.shape == (2, 3, 16)
     assert jnp.array_equal(got, FR.mul(a, b))
+
+
+def test_pallas_mont_pow_inverse():
+    """The fused square-and-multiply ladder (one kernel launch) vs the
+    host Fermat inverse — the batched-inversion primitive of the affine
+    MSM tier (ops.msm_affine)."""
+    from zkp2p_tpu.ops.pallas_mont import mont_pow
+
+    xs = [rng.randrange(1, P) for _ in range(5)] + [1, P - 1]
+    a = jnp.asarray(np.stack([FQ.to_mont_host(x) for x in xs]))
+    got = mont_pow(FQ, a, P - 2, interpret=True)
+    for i, x in enumerate(xs):
+        assert FQ.from_mont_host(np.asarray(got[i])) == pow(x, P - 2, P)
+
+
+def test_pallas_mont_pow_small_exponent():
+    xs = [rng.randrange(R) for _ in range(4)]
+    a = jnp.asarray(np.stack([FR.to_mont_host(x) for x in xs]))
+    from zkp2p_tpu.ops.pallas_mont import mont_pow
+
+    got = mont_pow(FR, a, 5, interpret=True)
+    for i, x in enumerate(xs):
+        assert FR.from_mont_host(np.asarray(got[i])) == pow(x, 5, R)

@@ -334,6 +334,19 @@ class JPrimeField:
         """Fermat inverse a^(N-2); 0 maps to 0 (callers select around it)."""
         return self.pow_const(a, self.modulus - 2)
 
+    def inv_fused(self, a: jnp.ndarray) -> jnp.ndarray:
+        """`inv`, but one kernel launch on TPU: pow_const's scan issues 2
+        mul dispatches per exponent bit (~508 launches per call), which
+        makes small-batch inversions latency-bound; the fused ladder
+        (ops.pallas_mont.mont_pow) runs the whole ladder in VMEM."""
+        if field_mul_impl() == "pallas":
+            import jax as _jax
+
+            from ..ops.pallas_mont import mont_pow
+
+            return mont_pow(self, a, self.modulus - 2, _jax.default_backend() != "tpu")
+        return self.inv(a)
+
 
 FQ = JPrimeField(P, "fq")
 FR = JPrimeField(R, "fr")

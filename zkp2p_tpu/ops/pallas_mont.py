@@ -108,6 +108,86 @@ def _kernel(a_ref, b_ref, n_ref, np_ref, out_ref):
     out_ref[:] = _mont_mul_math(a_ref[:], b_ref[:], n_ref[:], np_ref[:])
 
 
+def _pow_kernel(nbits: int):
+    """Fused square-and-multiply for a COMPILE-TIME exponent: the whole
+    254-step ladder runs inside one kernel (fori_loop, all state in
+    VMEM).  The XLA-level `JPrimeField.pow_const` scan issues 2 mul
+    dispatches per exponent bit — ~508 kernel launches per inversion —
+    which makes the per-chunk batch-inversion totals of the affine MSM
+    (ops.msm_affine) latency-bound; this kernel is one launch.
+
+    The exponent bits ride as a (nbits, 1) u32 operand (LSB first) —
+    kernels cannot capture traced constants (Mosaic note above) and a
+    Python-unrolled ladder would inline ~500 mul graphs."""
+
+    def kernel(a_ref, bits_ref, n_ref, np_ref, one_ref, out_ref):
+        from jax.experimental import pallas as pl
+
+        n_lm = n_ref[:]
+        np_lm = np_ref[:]
+        base0 = a_ref[:]
+        acc0 = jnp.broadcast_to(one_ref[:], base0.shape)
+
+        def body(i, carry):
+            acc, base = carry
+            bit = bits_ref[pl.ds(i, 1), :][0, 0]
+            nacc = _mont_mul_math(acc, base, n_lm, np_lm)
+            acc = jnp.where(bit != 0, nacc, acc)
+            base = _mont_mul_math(base, base, n_lm, np_lm)
+            return (acc, base)
+
+        acc, _ = jax.lax.fori_loop(0, nbits, body, (acc0, base0))
+        out_ref[:] = acc
+
+    return kernel
+
+
+@partial(jax.jit, static_argnums=(0, 2, 3))
+def mont_pow(field, a: jnp.ndarray, e: int, interpret: bool = False) -> jnp.ndarray:
+    """a^e (Montgomery in, Montgomery out) via the fused ladder kernel.
+
+    Montgomery mul is a ring isomorphism, so mont(x)^e mont-wise =
+    mont(x^e): callers use e = modulus - 2 for batched Fermat inversion
+    (0 maps to 0 like JPrimeField.inv — select around it)."""
+    assert e >= 1
+    nbits = e.bit_length()
+    bits = jnp.asarray(
+        np.array([(e >> i) & 1 for i in range(nbits)], dtype=np.uint32)[:, None]
+    )
+    n_lm = jnp.asarray(np.asarray(int_to_limbs(field.modulus))[:, None])
+    np_lm = jnp.asarray(np.asarray(int_to_limbs(field.nprime_int))[:, None])
+    one_lm = jnp.asarray(np.asarray(int_to_limbs(field.mont_r))[:, None])
+    return _run_tiled(
+        _pow_kernel(nbits), (a,), (bits, n_lm, np_lm, one_lm), a.shape[:-1], interpret
+    )
+
+
+def _to_limb_major(x: jnp.ndarray, B: int, pad: int) -> jnp.ndarray:
+    """(..., 16) batch-major -> (16, B+pad) limb-major tile input."""
+    lm = jnp.moveaxis(x.reshape(B, NUM_LIMBS), -1, 0)
+    return jnp.pad(lm, ((0, 0), (0, pad))) if pad else lm
+
+
+def _run_tiled(kernel, batch_ins, const_ins, bshape, interpret: bool):
+    """Shared pallas_call wrapper: flatten batch dims to the 128-lane
+    axis, pad to TILE, run a 1-D grid, restore (..., 16)."""
+    from jax.experimental import pallas as pl
+
+    B = int(np.prod(bshape)) if bshape else 1
+    pad = (-B) % TILE
+    spec = pl.BlockSpec((NUM_LIMBS, TILE), lambda i: (0, i))
+    out = pl.pallas_call(
+        kernel,
+        grid=((B + pad) // TILE,),
+        in_specs=[spec] * len(batch_ins)
+        + [pl.BlockSpec(c.shape, lambda i: (0, 0)) for c in const_ins],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((NUM_LIMBS, B + pad), jnp.uint32),
+        interpret=interpret,
+    )(*(_to_limb_major(x, B, pad) for x in batch_ins), *const_ins)
+    return jnp.moveaxis(out[:, :B], 0, -1).reshape(bshape + (NUM_LIMBS,))
+
+
 @partial(jax.jit, static_argnums=(0, 3))
 def mont_mul(field, a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     """Montgomery product (a*b*R^-1 mod N) via the fused kernel.
@@ -116,33 +196,10 @@ def mont_mul(field, a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False) -> 
     field: a JPrimeField (supplies modulus / N' limb constants).
     interpret=True runs the Pallas interpreter (CPU differential tests).
     """
-    from jax.experimental import pallas as pl
-
     n_lm = jnp.asarray(np.asarray(int_to_limbs(field.modulus))[:, None])
     np_lm = jnp.asarray(np.asarray(int_to_limbs(field.nprime_int))[:, None])
 
     bshape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, bshape + (NUM_LIMBS,))
     b = jnp.broadcast_to(b, bshape + (NUM_LIMBS,))
-    B = int(np.prod(bshape)) if bshape else 1
-    pad = (-B) % TILE
-    a_lm = jnp.moveaxis(a.reshape(B, NUM_LIMBS), -1, 0)
-    b_lm = jnp.moveaxis(b.reshape(B, NUM_LIMBS), -1, 0)
-    if pad:
-        a_lm = jnp.pad(a_lm, ((0, 0), (0, pad)))
-        b_lm = jnp.pad(b_lm, ((0, 0), (0, pad)))
-
-    out = pl.pallas_call(
-        _kernel,
-        grid=((B + pad) // TILE,),
-        in_specs=[
-            pl.BlockSpec((NUM_LIMBS, TILE), lambda i: (0, i)),
-            pl.BlockSpec((NUM_LIMBS, TILE), lambda i: (0, i)),
-            pl.BlockSpec((NUM_LIMBS, 1), lambda i: (0, 0)),
-            pl.BlockSpec((NUM_LIMBS, 1), lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((NUM_LIMBS, TILE), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((NUM_LIMBS, B + pad), jnp.uint32),
-        interpret=interpret,
-    )(a_lm, b_lm, n_lm, np_lm)
-    return jnp.moveaxis(out[:, :B], 0, -1).reshape(bshape + (NUM_LIMBS,))
+    return _run_tiled(_kernel, (a, b), (n_lm, np_lm), bshape, interpret)

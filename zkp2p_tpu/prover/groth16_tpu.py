@@ -80,10 +80,20 @@ MSM_SIGNED = _os.environ.get("ZKP2P_MSM_SIGNED", "1") == "1"
 # (minimal) size: its planes come from the unpadded b_sel gather, so
 # the padding never touches the 3x-cost Fq2 path.
 MSM_UNIFIED = _os.environ.get("ZKP2P_MSM_UNIFIED", "auto")
+# Batch-affine accumulate tier (ops.msm_affine, docs/NEXT.md lever 1):
+# affine accumulators + one batched inversion per chunk step instead of
+# Jacobian adds — ~1.45x fewer field muls on the wide/h MSMs.  "0" until
+# proven on hardware (Mosaic lowering has twice accepted interpret-mode
+# semantics it could not run); "auto" arms it on a real TPU backend.
+MSM_AFFINE = _os.environ.get("ZKP2P_MSM_AFFINE", "0")
 
 
 def _unified() -> bool:
     return MSM_UNIFIED == "1" or (MSM_UNIFIED == "auto" and jax.default_backend() == "tpu")
+
+
+def _affine() -> bool:
+    return MSM_AFFINE == "1" or (MSM_AFFINE == "auto" and jax.default_backend() == "tpu")
 from ..snark.groth16 import Proof, ProvingKey, coset_gen, domain_size_for, qap_rows
 from ..snark.r1cs import ConstraintSystem
 
@@ -351,6 +361,10 @@ def _msm_g1(bases, planes):
     lanes = default_lanes(bases[0].shape[0])
     if MSM_SIGNED:
         mags, negs = planes
+        if _affine():
+            from ..ops.msm_affine import msm_windowed_affine
+
+            return msm_windowed_affine(G1J, bases, mags, negs, lanes=lanes, window=MSM_WINDOW)
         return msm_windowed_signed(G1J, bases, mags, negs, lanes=lanes, window=MSM_WINDOW)
     return msm_windowed(G1J, bases, planes, lanes=lanes, window=MSM_WINDOW)
 
