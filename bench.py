@@ -360,7 +360,7 @@ def main():
         os.environ.update(
             BENCH_NO_REEXEC="1", BENCH_REEXECED="1",
             ZKP2P_CURVE_KERNEL="xla", ZKP2P_FIELD_MUL="xla", ZKP2P_MSM_WINDOW="4",
-            ZKP2P_MSM_AFFINE="0",
+            ZKP2P_MSM_AFFINE="0", ZKP2P_MSM_H="windowed",
         )
         os.execv(sys.executable, [sys.executable] + sys.argv)
     log("proof[0] verified against the pairing equation")

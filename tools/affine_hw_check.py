@@ -121,6 +121,47 @@ def main():
             f"{name}: first={compile_s:.1f}s steady={best:.3f}s -> {n/best/1e6:.3f} M pts/s",
             flush=True,
         )
+
+    # ------------------------- 3. bucket MSM (lever 2): correctness + A/B
+    from zkp2p_tpu.ops.msm_bucket import msm_bucket_affine
+
+    nb = 4096
+    pts_b = [base_pts[i % 64] for i in range(nb)]
+    pts_b[3] = None
+    sc_b = [rng.randrange(R) for _ in range(nb)]
+    sc_b[7] = 0
+    bases_b = g1_to_affine_arrays(pts_b)
+    mags8, negs8 = jmsm.signed_digit_planes_from_limbs(limbs(sc_b), 8)
+    t0 = time.time()
+    got = g1_jac_to_host(
+        jax.jit(lambda b, m, s: msm_bucket_affine(G1J, b, m, s, window=8))(bases_b, mags8, negs8)
+    )[0]
+    want = g1_jac_to_host(
+        jax.jit(lambda b, m, s: jmsm.msm_windowed_signed(G1J, b, m, s, lanes=512, window=8))(
+            bases_b, mags8, negs8
+        )
+    )[0]
+    ok = got == want
+    print(f"bucket correctness w=8: {'OK' if ok else 'MISMATCH'} ({time.time()-t0:.1f}s incl compile)", flush=True)
+    if not ok:
+        print("BUCKET TIER MISCOMPARES ON HARDWARE — do not arm", flush=True)
+        return 1
+
+    mags16, negs16 = jmsm.signed_digit_planes_from_limbs(limbs(scalars), 16)
+    bkt = jax.jit(lambda b, m, s: msm_bucket_affine(G1J, b, m, s, window=16))
+    t0 = time.time()
+    jax.block_until_ready(bkt(bases, mags16, negs16))
+    compile_s = time.time() - t0
+    ts = []
+    for _ in range(3):
+        t0 = time.time()
+        jax.block_until_ready(bkt(bases, mags16, negs16))
+        ts.append(time.time() - t0)
+    best = min(ts)
+    print(
+        f"bucket w=16: first={compile_s:.1f}s steady={best:.3f}s -> {n/best/1e6:.3f} M pts/s",
+        flush=True,
+    )
     return 0
 
 

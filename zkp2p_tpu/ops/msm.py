@@ -71,6 +71,27 @@ def tree_reduce(curve: JCurve, pts: JacPoint, axis_len: int) -> JacPoint:
     return tuple(jnp.squeeze(c, axis=ax) for c in pts)
 
 
+def horner_fold_planes(curve: JCurve, init: JacPoint, planes_stacked, window: int) -> JacPoint:
+    """MSB-first Horner fold over stacked digit-plane partials (leading
+    axis = planes): acc = 2^window * acc + plane.  Shared by the
+    windowed, batch-affine, and bucket MSMs.
+
+    The window doublings are a nested lax.scan: ONE compiled double
+    graph instead of `window` inlined copies — for G2 (Fq2 limb towers)
+    the unrolled form alone pushed XLA:CPU past the driver's dryrun
+    budget (MULTICHIP_r04 rehearsal: >300 s compiling jit_local)."""
+
+    def fold(acc, ps):
+        def dbl(a, _):
+            return curve.double(a), None
+
+        acc, _ = jax.lax.scan(dbl, acc, None, length=window)
+        return curve.add(acc, ps), None
+
+    out, _ = jax.lax.scan(fold, init, planes_stacked)
+    return out
+
+
 def digit_planes_from_limbs(limbs: jnp.ndarray, window: int = 4) -> jnp.ndarray:
     """Standard-form scalar limbs (..., n, 16) -> (256/window, ..., n)
     base-2^window digit planes, most significant first.  Vectorised like
@@ -242,18 +263,9 @@ def _msm_windowed_impl(
         xs_in = (pts, planes)
     partials, _ = jax.lax.scan(accumulate, curve.infinity((n_digits, lanes)), xs_in)
 
-    def fold_planes(acc, ps):
-        # window doublings as a nested scan: ONE compiled double graph
-        # instead of `window` inlined copies — for G2 (Fq2 limb towers)
-        # the unrolled form alone pushed XLA:CPU past the driver's dryrun
-        # budget (MULTICHIP_r04 rehearsal: >300 s compiling jit_local).
-        def dbl(a, _):
-            return curve.double(a), None
-
-        acc, _ = jax.lax.scan(dbl, acc, None, length=window)
-        return curve.add(acc, ps), None
-
-    per_lane, _ = jax.lax.scan(fold_planes, curve.infinity((lanes,)), tuple(c for c in partials))
+    per_lane = horner_fold_planes(
+        curve, curve.infinity((lanes,)), tuple(c for c in partials), window
+    )
 
     # Lane fold: G1 takes the pairwise tree — log2(lanes) halving adds
     # instead of a `lanes`-step scan (cheaper dispatch on 1-core hosts,

@@ -38,9 +38,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..curve.jcurve import AffPoint, JacPoint, JCurve
-from .msm import tree_reduce
+from .msm import horner_fold_planes, tree_reduce
 
 
 def _one(F, like: jnp.ndarray) -> jnp.ndarray:
@@ -142,6 +143,24 @@ def _affine_add_apply(F, a, b, dinv: jnp.ndarray, flags) -> tuple:
     return rx, ry, rinf
 
 
+def affine_add_complete(F, a, b, fused_inv: bool = True):
+    """Complete affine add of two (x, y, is_inf) triples with any
+    leading batch shape: phase-1 denominators are batch-inverted over
+    the whole (power-of-2-padded) flattened batch, then phase 2
+    completes.  The building block of the prefix-scan bucket MSM
+    (ops.msm_bucket) and of ad-hoc affine folds."""
+    assert F.zero_limbs.ndim == 1, "affine_add_complete is G1/Fq-only (Fq2 needs the norm trick)"
+    den, flags = _affine_add_den(F, a, b)
+    bshape = den.shape[:-1]
+    flat = int(np.prod(bshape)) if bshape else 1
+    n_pad = (1 << (flat - 1).bit_length()) - flat if flat > 1 else 0
+    d = den.reshape((flat, -1))
+    if n_pad:
+        d = jnp.concatenate([d, jnp.broadcast_to(F.one_mont, (n_pad, d.shape[-1]))])
+    dinv = batch_inverse(F, d, fused_inv)[:flat].reshape(den.shape)
+    return _affine_add_apply(F, a, b, dinv, flags)
+
+
 def _pow2_floor(n: int) -> int:
     return 1 << (n.bit_length() - 1)
 
@@ -217,13 +236,7 @@ def msm_windowed_affine(
 
     # inf lanes carry (0, 0) by construction -> from_affine's sentinel
     partials = curve.from_affine((ax, ay))
-
-    def fold_planes(acc, ps):
-        def dbl(a, _):
-            return curve.double(a), None
-
-        acc, _ = jax.lax.scan(dbl, acc, None, length=window)
-        return curve.add(acc, ps), None
-
-    per_lane, _ = jax.lax.scan(fold_planes, curve.infinity((lanes,)), tuple(c for c in partials))
+    per_lane = horner_fold_planes(
+        curve, curve.infinity((lanes,)), tuple(c for c in partials), window
+    )
     return tree_reduce(curve, per_lane, lanes)

@@ -86,6 +86,12 @@ MSM_UNIFIED = _os.environ.get("ZKP2P_MSM_UNIFIED", "auto")
 # proven on hardware (Mosaic lowering has twice accepted interpret-mode
 # semantics it could not run); "auto" arms it on a real TPU backend.
 MSM_AFFINE = _os.environ.get("ZKP2P_MSM_AFFINE", "0")
+# h-MSM formulation (docs/NEXT.md lever 2): "windowed" (the signed
+# digit-plane accumulate above) or "bucket" (ops.msm_bucket sorted-
+# prefix Pippenger buckets at w=16 — no multiples table, ~34 affine
+# adds/pt, batch-independent).  Hardware-gated like MSM_AFFINE.
+MSM_H = _os.environ.get("ZKP2P_MSM_H", "windowed")
+H_BUCKET_WINDOW = 16
 
 
 def _unified() -> bool:
@@ -94,6 +100,12 @@ def _unified() -> bool:
 
 def _affine() -> bool:
     return MSM_AFFINE == "1" or (MSM_AFFINE == "auto" and jax.default_backend() == "tpu")
+
+
+def _h_bucket() -> bool:
+    return MSM_SIGNED and (
+        MSM_H == "bucket" or (MSM_H == "auto" and jax.default_backend() == "tpu")
+    )
 from ..snark.groth16 import Proof, ProvingKey, coset_gen, domain_size_for, qap_rows
 from ..snark.r1cs import ConstraintSystem
 
@@ -335,7 +347,8 @@ def _h_and_planes(dpk: DeviceProvingKey, w_mont: jnp.ndarray):
     if MSM_SIGNED:
         w_std = FR.from_mont(w_mont)
         w_mags, w_negs = signed_digit_planes_from_limbs(w_std, MSM_WINDOW)
-        h_mags, h_negs = signed_digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW)
+        h_window = H_BUCKET_WINDOW if _h_bucket() else MSM_WINDOW
+        h_mags, h_negs = signed_digit_planes_from_limbs(FR.from_mont(h), h_window)
         # Narrow-class planes: witness wires with width bounds <= 2^11
         # only populate the last NARROW_PLANES signed w=4 digits — the
         # upper 61 planes are provably zero and never reach an MSM.
@@ -394,6 +407,17 @@ def _msm_g2(bases, planes):
     return msm_windowed(G2J, bases, planes, lanes=lanes, window=MSM_WINDOW)
 
 
+def _msm_h(bases, planes):
+    """The h MSM: full-width coset-quotient scalars, the dominant prover
+    cost — routed to the sorted-prefix bucket formulation when armed."""
+    if _h_bucket():
+        from ..ops.msm_bucket import msm_bucket_affine
+
+        mags, negs = planes
+        return msm_bucket_affine(G1J, bases, mags, negs, window=H_BUCKET_WINDOW)
+    return _msm_g1(bases, planes)
+
+
 # Stage-wise jits, NOT one fused program: XLA compile time scales with
 # traced-graph size, so the pipeline is a handful of small executables
 # with intermediates staying on device between stages.  Since b/c
@@ -404,11 +428,13 @@ def _msm_g2(bases, planes):
 _jit_h_planes = jax.jit(_h_and_planes)
 _jit_msm_g1 = jax.jit(_msm_g1)
 _jit_msm_g2 = jax.jit(_msm_g2)
+_jit_msm_h = jax.jit(_msm_h)
 _jit_msm_g1_narrow = jax.jit(_msm_g1_narrow)
 _jit_msm_g2_narrow = jax.jit(_msm_g2_narrow)
 _jit_h_planes_batch = jax.jit(jax.vmap(_h_and_planes, in_axes=(None, 0)))
 _jit_msm_g1_batch = jax.jit(jax.vmap(_msm_g1, in_axes=(None, 0)))
 _jit_msm_g2_batch = jax.jit(jax.vmap(_msm_g2, in_axes=(None, 0)))
+_jit_msm_h_batch = jax.jit(jax.vmap(_msm_h, in_axes=(None, 0)))
 _jit_msm_g1_narrow_batch = jax.jit(jax.vmap(_msm_g1_narrow, in_axes=(None, 0)))
 _jit_msm_g2_narrow_batch = jax.jit(jax.vmap(_msm_g2_narrow, in_axes=(None, 0)))
 
@@ -452,6 +478,7 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
         if batched
         else (_jit_h_planes, _jit_msm_g1, _jit_msm_g2)
     )
+    mh = _jit_msm_h_batch if batched else _jit_msm_h
     m1n, m2n = (
         (_jit_msm_g1_narrow_batch, _jit_msm_g2_narrow_batch)
         if batched
@@ -464,18 +491,29 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
         w_planes, w_narrow = w_all, None
 
     if not classed:
+        # bucket-h mode: h no longer shares the unified executable, so
+        # padding a/b1/c up to the (domain-sized) h base count would be
+        # pure waste — unify the three query MSMs among themselves only.
         g1_n = 0 if not _unified() else max(
             dpk.a_bases[0].shape[0], dpk.b1_bases[0].shape[0],
-            dpk.c_bases[0].shape[0], dpk.h_bases[0].shape[0],
+            dpk.c_bases[0].shape[0],
+            *(() if _h_bucket() else (dpk.h_bases[0].shape[0],)),
         )
         b_planes = _take_planes(w_planes, dpk.b_sel)
         c_planes = _take_planes(w_planes, dpk.c_sel)
+        # windowed mode keeps the m1 wrapper so the compiled-executable
+        # identity (and its persistent-cache entry) is unchanged
+        h_acc = (
+            mh(dpk.h_bases, h_planes)
+            if _h_bucket()
+            else m1(*_pad_msm(dpk.h_bases, h_planes, g1_n))
+        )
         return (
             m1(*_pad_msm(dpk.a_bases, w_planes, g1_n)),
             m1(*_pad_msm(dpk.b1_bases, b_planes, g1_n)),
             m2(dpk.b2_bases, b_planes),
             m1(*_pad_msm(dpk.c_bases, c_planes, g1_n)),
-            m1(*_pad_msm(dpk.h_bases, h_planes, g1_n)),
+            h_acc,
         )
 
     # Unify shapes WITHIN each class (a/b1/c wide together, narrows
@@ -531,7 +569,7 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
         query("b1", dpk.b1_bases, dpk.b_nsel, dpk.b_wsel, dpk.b_sel),
         query_g2("b2", dpk.b2_bases, dpk.b_nsel, dpk.b_wsel, dpk.b_sel),
         query("c", dpk.c_bases, dpk.c_nsel, dpk.c_wsel, dpk.c_sel),
-        m1(dpk.h_bases, h_planes),
+        (mh if _h_bucket() else m1)(dpk.h_bases, h_planes),
     )
 
 
