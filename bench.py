@@ -314,6 +314,23 @@ def main():
     # halved accumulate work (32 digit planes instead of 64) wins.
     # Must be set before the first zkp2p_tpu.prover import.
     os.environ.setdefault("ZKP2P_MSM_WINDOW", "8")
+    # Hardware-gated tiers (batch-affine accumulate / bucket h MSM) are
+    # OFF by default until an on-chip A/B passes.  The tunnel-window
+    # session (tools/affine_hw_check.py via the watcher) records the
+    # winners in .bench_cache/armed_flags.json, so a later driver bench
+    # inherits validated arming without a human in the loop.  Explicit
+    # env always wins; the re-exec fallback clears everything.
+    try:
+        with open(os.path.join(CACHE, "armed_flags.json")) as f:
+            flags = json.load(f)
+        for k, v in flags.items():
+            if isinstance(k, str) and k.startswith("ZKP2P_"):
+                # booleans normalise to the "1"/"0" the prover checks
+                os.environ.setdefault(k, {True: "1", False: "0"}.get(v, str(v)))
+        log(f"armed flags applied: {[f'{k}={os.environ[k]}' for k in ('ZKP2P_MSM_AFFINE', 'ZKP2P_MSM_H') if k in os.environ]}")
+    except Exception as e:  # noqa: BLE001 — arming is best-effort, never fatal
+        if not isinstance(e, FileNotFoundError):
+            log(f"armed flags ignored: {e}")
     from zkp2p_tpu.prover.groth16_tpu import prove_tpu_batch
     from zkp2p_tpu.snark.groth16 import verify
     from zkp2p_tpu.utils.trace import dump_trace, trace

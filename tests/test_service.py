@@ -136,3 +136,81 @@ def test_service_restart_resumes_where_it_stopped(batched_world, tmp_path):
     assert os.path.exists(os.path.join(spool, "r1.proof.json"))
     stats2 = batched_world.process_dir(spool)
     assert stats2 == {"done": 0, "error-bad-input": 0, "error-failed-to-prove": 0}
+
+
+def _write_reqs(spool, pairs, prefix="r"):
+    for i, (xv, yv) in enumerate(pairs):
+        with open(os.path.join(spool, f"{prefix}{i}.req.json"), "w") as f:
+            json.dump({"x": xv, "y": yv}, f)
+
+
+def test_crash_recovery_restart_completes(world, tmp_path):
+    """A worker that dies mid-sweep (simulated KeyboardInterrupt in the
+    prover) leaves bare .req.json files and stale claims; a restarted
+    sweep with a healthy prover takes them over and finishes every
+    request exactly once (VERDICT r3 weak #8)."""
+    from zkp2p_tpu.prover.native_prove import prove_native
+
+    spool = str(tmp_path)
+    _write_reqs(spool, [(3, 5), (2, 7), (4, 4), (9, 2)])
+
+    calls = []
+
+    def dying_prover(dpk, wits):
+        if calls:  # first batch proves, second crashes the process
+            raise KeyboardInterrupt
+        calls.append(1)
+        return [prove_native(dpk, w) for w in wits]
+
+    crashy = ProvingService(
+        world.cs, world.dpk, world.vk, world.witness_fn,
+        public_fn=world.public_fn, batch_size=2,
+        prover_fn=dying_prover, stale_claim_s=0.0,
+    )
+    with pytest.raises(KeyboardInterrupt):
+        crashy.process_dir(spool)
+    done_before = len([f for f in os.listdir(spool) if f.endswith(".proof.json")])
+    assert done_before == 2  # first batch landed, second did not
+
+    healthy = ProvingService(
+        world.cs, world.dpk, world.vk, world.witness_fn,
+        public_fn=world.public_fn, batch_size=2,
+        prover_fn=lambda dpk, wits: [prove_native(dpk, w) for w in wits],
+        stale_claim_s=0.0,  # dead worker's claims are immediately stale
+    )
+    stats = healthy.process_dir(spool)
+    assert stats["done"] == 2  # exactly the crashed remainder, no re-proves
+    assert len([f for f in os.listdir(spool) if f.endswith(".proof.json")]) == 4
+    assert not [f for f in os.listdir(spool) if f.endswith(".claim")]
+
+
+def test_two_workers_partition_one_spool(world, tmp_path):
+    """Two concurrent workers on one spool: claim files partition the
+    requests — every request proven exactly once across both."""
+    import threading
+
+    from zkp2p_tpu.prover.native_prove import prove_native
+
+    spool = str(tmp_path)
+    _write_reqs(spool, [(3, 5), (2, 7), (4, 4), (9, 2), (5, 5), (6, 6)])
+
+    def mk():
+        return ProvingService(
+            world.cs, world.dpk, world.vk, world.witness_fn,
+            public_fn=world.public_fn, batch_size=1,
+            prover_fn=lambda dpk, wits: [prove_native(dpk, w) for w in wits],
+        )
+
+    results = {}
+
+    def run(name):
+        results[name] = mk().process_dir(spool)
+
+    t1 = threading.Thread(target=run, args=("a",))
+    t2 = threading.Thread(target=run, args=("b",))
+    t1.start(); t2.start(); t1.join(); t2.join()
+
+    total_done = results["a"]["done"] + results["b"]["done"]
+    assert total_done == 6  # partitioned, not duplicated
+    assert len([f for f in os.listdir(spool) if f.endswith(".proof.json")]) == 6
+    assert not [f for f in os.listdir(spool) if f.endswith(".claim")]

@@ -1,0 +1,44 @@
+#!/bin/bash
+# Tunnel-window session v2 (fired by tools/tpu_watch.sh the moment a
+# probe sees the TPU up).  Order of business for a window of unknown
+# length:
+#   1. bench with a budget wide enough to finish the remaining cold
+#      compiles in ONE window (every killed attempt still banks its
+#      completed executables in the persistent cache)
+#   2. the affine/bucket hardware A/B (tools/affine_hw_check.py)
+#   3. record the winning h-MSM formulation in
+#      .bench_cache/armed_flags.json — the driver's own bench.py reads
+#      it and inherits validated arming with no human in the loop
+#   4. kernel differential + a final bench with the winner armed
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+OUT=docs/logs/tpu_session2_$TS
+mkdir -p "$OUT"
+phase() {
+  local name=$1 tmo=$2; shift 2
+  echo "-- $name ($(date +%H:%M:%S), timeout ${tmo}s): $*" >> "$OUT/session.log"
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  echo "   rc=$? at $(date +%H:%M:%S)" >> "$OUT/session.log"
+}
+phase bench1 1800 env BENCH_TPU_BUDGET=1700 python -u bench.py
+phase bench2 900 env BENCH_TPU_BUDGET=820 python -u bench.py
+phase affine 2400 python -u tools/affine_hw_check.py
+AFFINE=0; HMODE=windowed
+if grep -q "correctness vmap B=2: OK" "$OUT/affine.log" 2>/dev/null; then
+  JR=$(grep -oP 'jacobian:.*-> \K[0-9.]+' "$OUT/affine.log" | head -1)
+  AR=$(grep -oP '^affine:.*-> \K[0-9.]+' "$OUT/affine.log" | head -1)
+  BR=$(grep -oP 'bucket w=16:.*-> \K[0-9.]+' "$OUT/affine.log" | head -1)
+  [ -n "$JR" ] && [ -n "$AR" ] && python -c "import sys; sys.exit(0 if float('$AR') > float('$JR') else 1)" && AFFINE=1
+  if grep -q "bucket correctness w=8: OK" "$OUT/affine.log" && [ -n "$BR" ] && [ -n "$JR" ]; then
+    BEST=$JR; [ "$AFFINE" = 1 ] && BEST=$AR
+    python -c "import sys; sys.exit(0 if float('$BR') > float('$BEST') else 1)" && HMODE=bucket
+  fi
+fi
+echo "   armed: ZKP2P_MSM_AFFINE=$AFFINE ZKP2P_MSM_H=$HMODE" >> "$OUT/session.log"
+mkdir -p .bench_cache
+printf '{"ZKP2P_MSM_AFFINE": "%s", "ZKP2P_MSM_H": "%s"}' "$AFFINE" "$HMODE" > .bench_cache/armed_flags.json
+phase diff 1200 python -u tools/pallas_hw_diff.py
+phase bench3 1800 env BENCH_TPU_BUDGET=1700 python -u bench.py
+phase msm_w8 900 python -u tools/msm_hwbench.py --n 131072 --window 8 --signed --skip-adds
+echo "== session2 done $(date +%H:%M:%S)" >> "$OUT/session.log"

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the TPU tunnel every ~8 min; fire the session script on the
+# first healthy probe of each window.  Run in the background for the
+# whole round: windows have been ~30 min and unannounced.
+cd "$(dirname "$0")/.."
+LOG=docs/logs/tpu_watch_r4.log
+while true; do
+  if python -c "from zkp2p_tpu.utils.jaxcfg import tpu_probe_ok; import sys; sys.exit(0 if tpu_probe_ok() else 1)" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) tunnel UP -> firing session" >> "$LOG"
+    tools/tpu_session2.sh
+    echo "$(date +%H:%M:%S) session done" >> "$LOG"
+  else
+    echo "$(date +%H:%M:%S) tunnel down" >> "$LOG"
+  fi
+  sleep 480
+done

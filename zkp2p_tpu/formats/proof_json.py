@@ -15,6 +15,7 @@ expects.
 from __future__ import annotations
 
 import json
+import os
 from typing import Dict, List, Sequence, Tuple
 
 from ..curve.host import G1Point, G2Point
@@ -100,8 +101,13 @@ def vkey_from_json(d: Dict) -> VerifyingKey:
 
 
 def dump(obj, path: str) -> None:
-    with open(path, "w") as f:
+    """Atomic write (temp + rename): concurrent service workers racing a
+    stale-claim takeover must never leave a torn half-written JSON — a
+    reader sees either the old complete file or the new complete file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
 
 
 def load(path: str):

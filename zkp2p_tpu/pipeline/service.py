@@ -49,6 +49,7 @@ class ProvingService:
         inputs_fn: Optional[Callable[[Dict], tuple]] = None,
         prover_fn: Optional[Callable] = None,
         prefetch: int = 1,
+        stale_claim_s: float = 300.0,
     ):
         """witness_fn: request payload -> witness vector (raises on bad
         input); public_fn: witness -> public signals.
@@ -61,7 +62,10 @@ class ProvingService:
         the vmapped device `prove_tpu_batch` — pass a sequential
         `prove_native` wrapper on chip-less hosts.
         prefetch: ready-batch queue depth (witness ∥ prove overlap
-        window; 1 = classic double buffering)."""
+        window; 1 = classic double buffering).
+        stale_claim_s: concurrent workers sweeping one spool partition
+        requests via O_EXCL <name>.claim files; a claim older than this
+        is treated as a crashed worker's and taken over."""
         self.cs = cs
         self.dpk = dpk
         self.vk = vk
@@ -72,6 +76,46 @@ class ProvingService:
         self.inputs_fn = inputs_fn
         self.prover_fn = prover_fn
         self.prefetch = max(1, prefetch)
+        self.stale_claim_s = stale_claim_s
+
+    # ------------------------------------------------------------- claims
+    #
+    # Crash/restart and multi-worker semantics (the service-level mirror
+    # of the reference's claim-with-expiry escrow pattern,
+    # `Ramp.sol:144` + `clawback`): a worker that dies mid-prove leaves
+    # a .claim file but no terminal output; any later sweep — same
+    # worker restarted or a peer — takes the request over once the claim
+    # is stale.  Terminal outputs (.proof/.error) always win over
+    # claims, so a request is never reprocessed after completion.
+
+    def _try_claim(self, base_path: str) -> bool:
+        claim = base_path + ".claim"
+        try:
+            fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                age = time.time() - os.path.getmtime(claim)
+            except OSError:
+                return False  # vanished: owner just completed it
+            if age < self.stale_claim_s:
+                return False
+            # stale claim: take over (best-effort refresh; losing a race
+            # here only risks duplicate work, never a wrong result)
+            try:
+                os.utime(claim, None)
+            except OSError:
+                return False
+            return True
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps({"pid": os.getpid(), "ts": time.time()}))
+        return True
+
+    @staticmethod
+    def _release_claim(base_path: str) -> None:
+        try:
+            os.unlink(base_path + ".claim")
+        except OSError:
+            pass
 
     # ------------------------------------------------------------ one pass
 
@@ -91,6 +135,7 @@ class ProvingService:
             if os.path.exists(os.path.join(spool, base + ".proof.json")) or os.path.exists(
                 os.path.join(spool, base + ".error.json")
             ):
+                self._release_claim(os.path.join(spool, base))
                 continue
             with open(os.path.join(spool, fn)) as f:
                 pending.append(Request(path=os.path.join(spool, base), payload=json.load(f)))
@@ -153,7 +198,11 @@ class ProvingService:
         def produce():
             try:
                 for i in range(0, len(pending), self.batch_size):
-                    cand = pending[i : i + self.batch_size]
+                    # Claim at DEQUEUE, not at scan: a long sweep must
+                    # not hold scan-time claims that go stale while
+                    # earlier batches prove (peer takeover would then
+                    # duplicate in-progress work).
+                    cand = [r for r in pending[i : i + self.batch_size] if self._try_claim(r.path)]
                     if self.inputs_fn is not None:
                         batch = batched_witness(cand)
                     else:
@@ -175,6 +224,14 @@ class ProvingService:
             if batch is None:
                 break
             try:
+                # heartbeat: refresh the batch's claims right before the
+                # prove so their age is bounded by ONE batch's prove
+                # time, not queue depth (stale_claim_s must exceed that)
+                for req in batch:
+                    try:
+                        os.utime(req.path + ".claim", None)
+                    except OSError:
+                        pass
                 with trace("service/prove", n=len(batch)):
                     prove = self.prover_fn or prove_tpu_batch
                     proofs = prove(self.dpk, [r.witness for r in batch])
@@ -185,6 +242,7 @@ class ProvingService:
                 for req, proof in zip(batch, proofs):
                     dump(proof_to_json(proof), req.path + ".proof.json")
                     dump(public_to_json(self.public_fn(req.witness)), req.path + ".public.json")
+                    self._release_claim(req.path)
                     stats["done"] += 1
             except Exception as e:  # noqa: BLE001
                 for req in batch:
@@ -198,14 +256,15 @@ class ProvingService:
             raise producer_error[0]
         return stats
 
-    @staticmethod
-    def _emit_error(req: Request, state: str, exc: Exception) -> None:
+    @classmethod
+    def _emit_error(cls, req: Request, state: str, exc: Exception) -> None:
         with open(req.path + ".error.json", "w") as f:
             json.dump(
                 {"state": state, "error": str(exc), "trace": traceback.format_exc(limit=3), "ts": time.time()},
                 f,
                 indent=1,
             )
+        cls._release_claim(req.path)
 
     # ------------------------------------------------------------- daemon
 
