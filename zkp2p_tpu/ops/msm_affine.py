@@ -72,9 +72,21 @@ def batch_inverse(F, x: jnp.ndarray, fused_inv: bool = True) -> jnp.ndarray:
     suffix_excl_i).  The suffix sweep is seeded with total^-1, so the
     combine is a single extra mul (~5 muls/element total).
 
+    Fq2 (F has a .fq base field) takes the norm route instead:
+    inv(a + bu) = (a - bu) * (a^2 + b^2)^-1 — the norm is never zero for
+    a nonzero element (u^2 = -1 irreducible means -1 is a non-residue),
+    so one Fq batch inversion of the norms serves the whole array at
+    ~9 Fq muls/element vs ~15 for Fq2 prefix products.
+
     Zero elements are mapped to 1 inside the products so they cannot
     zero the total; their output slots are GARBAGE — callers must select
     around them (same contract as JPrimeField.inv's 0 -> 0)."""
+    fq = getattr(F, "fq", None)
+    if fq is not None:
+        a, b = x[..., 0, :], x[..., 1, :]
+        norm = fq.add(fq.square(a), fq.square(b))
+        ninv = batch_inverse(fq, norm, fused_inv)
+        return jnp.stack([fq.mul(a, ninv), fq.neg(fq.mul(b, ninv))], axis=-2)
     one = _one(F, x)
     safe = F.select(F.is_zero(x), one, x)
     pe = excl_prefix_mul(F, safe, F.one_mont)
@@ -149,14 +161,14 @@ def affine_add_complete(F, a, b, fused_inv: bool = True):
     the whole (power-of-2-padded) flattened batch, then phase 2
     completes.  The building block of the prefix-scan bucket MSM
     (ops.msm_bucket) and of ad-hoc affine folds."""
-    assert F.zero_limbs.ndim == 1, "affine_add_complete is G1/Fq-only (Fq2 needs the norm trick)"
+    elem = F.zero_limbs.shape
     den, flags = _affine_add_den(F, a, b)
-    bshape = den.shape[:-1]
+    bshape = den.shape[: den.ndim - len(elem)]
     flat = int(np.prod(bshape)) if bshape else 1
     n_pad = (1 << (flat - 1).bit_length()) - flat if flat > 1 else 0
-    d = den.reshape((flat, -1))
+    d = den.reshape((flat,) + elem)
     if n_pad:
-        d = jnp.concatenate([d, jnp.broadcast_to(F.one_mont, (n_pad, d.shape[-1]))])
+        d = jnp.concatenate([d, jnp.broadcast_to(F.one_mont, (n_pad,) + elem)])
     dinv = batch_inverse(F, d, fused_inv)[:flat].reshape(den.shape)
     return _affine_add_apply(F, a, b, dinv, flags)
 
@@ -178,12 +190,11 @@ def msm_windowed_affine(
     accumulator out (up to Jacobian coordinate equivalence; the
     differential tests compare through the host conversion).
 
-    G1 only (element dims = one limb axis): the G2 MSM is ~3% of prover
-    adds after pruning, and Fq2 batch inversion needs the norm trick —
-    not worth the extra executable until the G1 path is proven on
-    hardware."""
-    assert curve.F.zero_limbs.ndim == 1, "affine MSM is G1-only (see docstring)"
+    Works for G1 (Fq) and G2 (Fq2 — `batch_inverse` takes the norm
+    route there, so a G2 accumulate add is ~4 Fq2 muls + ~9 amortised
+    Fq muls vs ~16 Fq2 muls for the Jacobian add)."""
     F = curve.F
+    elem = F.zero_limbs.shape
     n_digits = mags.shape[0]
     n = bases[0].shape[0]
     # lanes must keep the flattened (n_digits * lanes) denominator and
@@ -214,8 +225,9 @@ def msm_windowed_affine(
         _, stacked = jax.lax.scan(table_step, base_jac, None, length=n_table)
         flat = tuple(c.reshape((n_table * lanes,) + c.shape[2:]) for c in stacked)
         tx, ty = jac_to_affine_batch(F, flat)
-        tx = jnp.concatenate([jnp.zeros_like(tx[:lanes]), tx]).reshape(n_table + 1, lanes, -1)
-        ty = jnp.concatenate([jnp.zeros_like(ty[:lanes]), ty]).reshape(n_table + 1, lanes, -1)
+        tshape = (n_table + 1, lanes) + elem
+        tx = jnp.concatenate([jnp.zeros_like(tx[:lanes]), tx]).reshape(tshape)
+        ty = jnp.concatenate([jnp.zeros_like(ty[:lanes]), ty]).reshape(tshape)
 
         lane_ix = jnp.arange(lanes)[None, :]
         sx = tx[digits, lane_ix]
@@ -227,7 +239,7 @@ def msm_windowed_affine(
         addend = (sx, sy, sinf)
 
         den, flags = _affine_add_den(F, acc, addend)
-        dinv = batch_inverse(F, den.reshape((n_digits * lanes, -1))).reshape(den.shape)
+        dinv = batch_inverse(F, den.reshape((n_digits * lanes,) + elem)).reshape(den.shape)
         return _affine_add_apply(F, acc, addend, dinv, flags), None
 
     zero = jnp.zeros((n_digits, lanes) + F.zero_limbs.shape, dtype=jnp.uint32)
@@ -239,4 +251,14 @@ def msm_windowed_affine(
     per_lane = horner_fold_planes(
         curve, curve.infinity((lanes,)), tuple(c for c in partials), window
     )
-    return tree_reduce(curve, per_lane, lanes)
+    # Lane fold: same compile-budget rule as _msm_windowed_impl — the
+    # XLA G2 tree fold inlines log2(lanes) Fq2 add graphs and blows up
+    # XLA:CPU compile; scan-fold there, tree everywhere else.
+    if curve.F.zero_limbs.ndim == 1 or curve._pallas():
+        return tree_reduce(curve, per_lane, lanes)
+
+    def fold_lanes(acc, p):
+        return curve.add(acc, p), None
+
+    total, _ = jax.lax.scan(fold_lanes, curve.infinity(()), per_lane)
+    return total

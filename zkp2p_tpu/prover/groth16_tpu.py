@@ -373,37 +373,40 @@ def _msm_g1(bases, planes):
     # ops.msm.default_lanes).
     lanes = default_lanes(bases[0].shape[0])
     if MSM_SIGNED:
-        mags, negs = planes
-        if _affine():
-            from ..ops.msm_affine import msm_windowed_affine
-
-            return msm_windowed_affine(G1J, bases, mags, negs, lanes=lanes, window=MSM_WINDOW)
-        return msm_windowed_signed(G1J, bases, mags, negs, lanes=lanes, window=MSM_WINDOW)
+        return _signed_windowed(G1J, bases, planes, lanes, MSM_WINDOW)
     return msm_windowed(G1J, bases, planes, lanes=lanes, window=MSM_WINDOW)
+
+
+def _signed_windowed(curve, bases, planes, lanes, window):
+    """Signed windowed MSM with the accumulate-tier selector: batch
+    affine (ops.msm_affine) when armed, Jacobian otherwise."""
+    mags, negs = planes
+    if _affine():
+        from ..ops.msm_affine import msm_windowed_affine
+
+        return msm_windowed_affine(curve, bases, mags, negs, lanes=lanes, window=window)
+    return msm_windowed_signed(curve, bases, mags, negs, lanes=lanes, window=window)
 
 
 def _msm_g1_narrow(bases, planes):
     # 3-plane signed w=4 MSM for width-bounded wires: ~3.5 adds/pt at
     # batch=16 vs ~40 on the wide path.  Wider lanes keep the per-step
     # batch (NARROW_PLANES x lanes) off the latency floor.
-    mags, negs = planes
-    return msm_windowed_signed(
-        G1J, bases, mags, negs, lanes=default_lanes(bases[0].shape[0], cap=16384), window=4
+    return _signed_windowed(
+        G1J, bases, planes, default_lanes(bases[0].shape[0], cap=16384), 4
     )
 
 
 def _msm_g2_narrow(bases, planes):
-    mags, negs = planes
-    return msm_windowed_signed(
-        G2J, bases, mags, negs, lanes=default_lanes(bases[0].shape[0], cap=4096), window=4
+    return _signed_windowed(
+        G2J, bases, planes, default_lanes(bases[0].shape[0], cap=4096), 4
     )
 
 
 def _msm_g2(bases, planes):
     lanes = default_lanes(bases[0].shape[0], cap=2048)
     if MSM_SIGNED:
-        mags, negs = planes
-        return msm_windowed_signed(G2J, bases, mags, negs, lanes=lanes, window=MSM_WINDOW)
+        return _signed_windowed(G2J, bases, planes, lanes, MSM_WINDOW)
     return msm_windowed(G2J, bases, planes, lanes=lanes, window=MSM_WINDOW)
 
 
