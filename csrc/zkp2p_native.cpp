@@ -12,6 +12,10 @@
 
 #include <atomic>
 #include <cstdint>
+#include <array>
+#include <map>
+#include <mutex>
+#include <utility>
 #include <cstdlib>
 #include <cstring>
 #include <thread>
@@ -798,9 +802,25 @@ void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
   u64 root_m[4];
   fr_mul(root_m, root_std, R2R);
   long half_m = m / 2;
-  u64 *tw = new u64[(size_t)(half_m > 0 ? half_m : 1) * 4];
-  memcpy(tw, ONE_R, 32);
-  for (long j = 1; j < half_m; ++j) fr_mul(tw + 4 * j, tw + 4 * (j - 1), root_m);
+  // Twiddles depend only on (m, root): cache them across calls — the
+  // ladder runs 6 NTTs per prove and the sequential m/2-mul rebuild was
+  // ~5% of its time.  Guarded: ladder threads call fr_ntt concurrently.
+  static std::mutex tw_mu;
+  static std::map<std::array<u64, 5>, u64 *> tw_cache;
+  u64 *tw;
+  {
+    std::lock_guard<std::mutex> lk(tw_mu);
+    std::array<u64, 5> key = {(u64)m, root_std[0], root_std[1], root_std[2], root_std[3]};
+    auto it = tw_cache.find(key);
+    if (it != tw_cache.end()) {
+      tw = it->second;
+    } else {
+      tw = new u64[(size_t)(half_m > 0 ? half_m : 1) * 4];
+      memcpy(tw, ONE_R, 32);
+      for (long j = 1; j < half_m; ++j) fr_mul(tw + 4 * j, tw + 4 * (j - 1), root_m);
+      tw_cache[key] = tw;
+    }
+  }
   for (long len = 2; len <= m; len <<= 1) {
     long half = len >> 1;
     long stride = m / len;
@@ -817,7 +837,6 @@ void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
       }
     }
   }
-  delete[] tw;
   static const u64 ONE_STD[4] = {1, 0, 0, 0};
   if (memcmp(scale_std, ONE_STD, 32) != 0) {
     u64 scale_m[4];
@@ -846,16 +865,16 @@ void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
   fr_mul(minv_std, mim, ONE_STD);
   u64 gm[4];
   fr_mul(gm, g_std, R2R);
+  // One shared g^j table for all three ladders (each previously ran its
+  // own sequential m-mul power chain).
+  u64 *gpow = new u64[(size_t)m * 4];
+  memcpy(gpow, ONE_R, 32);
+  for (long j = 1; j < m; ++j) fr_mul(gpow + 4 * j, gpow + 4 * (j - 1), gm);
   u64 *vecs[3] = {a, b, c};
   auto ladder_one = [&](u64 *v) {
     fr_ntt(v, m, winv_std, minv_std);  // iNTT: evals -> coefficients
-    // coset shift: coeff[j] *= g^j (running power)
-    u64 p[4];
-    memcpy(p, ONE_R, 32);
-    for (long j = 1; j < m; ++j) {
-      fr_mul(p, p, gm);
-      fr_mul(v + 4 * j, v + 4 * j, p);
-    }
+    // coset shift: coeff[j] *= g^j
+    for (long j = 1; j < m; ++j) fr_mul(v + 4 * j, v + 4 * j, gpow + 4 * j);
     fr_ntt(v, m, w_std, ONE_STD);  // forward: coefficients -> coset evals
   };
   // The three polynomial ladders are independent: thread them when the
@@ -869,6 +888,7 @@ void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
   } else {
     for (int k = 0; k < 3; ++k) ladder_one(vecs[k]);
   }
+  delete[] gpow;
   for (long j = 0; j < m; ++j) {
     u64 t[4];
     fr_mul(t, a + 4 * j, b + 4 * j);

@@ -86,11 +86,24 @@ def test_jac_to_affine_batch_with_infinity():
     np.testing.assert_array_equal(np.asarray(ay), np.asarray(want_y))
 
 
-def _diff_affine(pts, scalars, lanes=8, window=4, jit=True):
+# ONE jitted executable per window, shared by every G1 case below: the
+# suite's wall time is XLA:CPU compile time, so all cases pad to n=24
+# (infinity points + zero scalars are MSM identities) and reuse it.
+@jax.jit
+def _affine24_w4(bases, mags, negs):
+    return msm_windowed_affine(G1J, bases, mags, negs, lanes=8, window=4)
+
+
+@jax.jit
+def _affine24_w8(bases, mags, negs):
+    return msm_windowed_affine(G1J, bases, mags, negs, lanes=8, window=8)
+
+
+def _diff_affine(pts, scalars, window=4):
+    pts = list(pts) + [None] * (24 - len(pts))
+    scalars = list(scalars) + [0] * (24 - len(scalars))
     mags, negs = jmsm.signed_digit_planes_from_limbs(_limbs(scalars), window)
-    fn = lambda b, m, s: msm_windowed_affine(G1J, b, m, s, lanes=lanes, window=window)
-    if jit:
-        fn = jax.jit(fn)
+    fn = _affine24_w4 if window == 4 else _affine24_w8
     got = g1_jac_to_host(fn(g1_to_affine_arrays(pts), mags, negs))[0]
     assert got == g1_msm(pts, scalars)
 
@@ -103,6 +116,11 @@ def test_msm_affine_random_vs_host():
     scalars[3] = 0  # zero scalar -> all-infinity addend lane
     for w in (4, 8):
         _diff_affine(pts, scalars, window=w)
+
+
+def test_msm_affine_all_zero_scalars():
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(8)]
+    _diff_affine(pts, [0] * 8)
 
 
 def test_msm_affine_forces_accumulate_doubling():
@@ -128,20 +146,17 @@ def test_msm_affine_forces_cancellation():
     _diff_affine(pts, scalars)
 
 
-def test_msm_affine_all_zero_scalars():
-    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(8)]
-    scalars = [0] * 8
-    mags, negs = jmsm.signed_digit_planes_from_limbs(_limbs(scalars), 4)
-    got = g1_jac_to_host(msm_windowed_affine(G1J, g1_to_affine_arrays(pts), mags, negs, lanes=8, window=4))[0]
-    assert got is None
-
-
 def test_msm_affine_nonpow2_lanes_rounds_down():
-    """lanes=6 must round to 4 internally and still match the oracle."""
+    """lanes=6 must round to 4 internally and still match the oracle
+    (eager, tiny n: no extra compiled executable)."""
     n = 13
     pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(n)]
     scalars = [rng.randrange(R) for _ in range(n)]
-    _diff_affine(pts, scalars, lanes=6, jit=False)
+    mags, negs = jmsm.signed_digit_planes_from_limbs(_limbs(scalars), 4)
+    got = g1_jac_to_host(
+        msm_windowed_affine(G1J, g1_to_affine_arrays(pts), mags, negs, lanes=6, window=4)
+    )[0]
+    assert got == g1_msm(pts, scalars)
 
 
 def test_msm_affine_batched_vmap():
@@ -198,3 +213,35 @@ def test_batch_inverse_fq2_norm_route():
         inv = e.inv()
         assert FQ.from_mont_host(np.asarray(out[i, 0])) == inv.c0
         assert FQ.from_mont_host(np.asarray(out[i, 1])) == inv.c1
+
+
+@pytest.mark.xslow
+def test_prove_tpu_affine_with_narrow_class(monkeypatch):
+    """Regression: a width-classed key routes its narrow MSMs (3 digit
+    planes — not a power of 2) through the affine tier when armed; the
+    batch inversion must pad, not assert (caught in review before the
+    first hardware A/B)."""
+    import zkp2p_tpu.prover.groth16_tpu as gt
+    from zkp2p_tpu.prover import device_pk, prove_tpu
+    from zkp2p_tpu.snark.groth16 import prove_host, setup, verify
+    from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+    monkeypatch.setattr(gt, "MSM_AFFINE", "1")
+    cs = ConstraintSystem("narrow_affine")
+    out = cs.new_public("out")
+    x, y, z = cs.new_wire(), cs.new_wire(), cs.new_wire()
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z))
+    cs.enforce(LC.of(z), LC.of(z), LC.of(out))
+    cs.compute(z, lambda a, b: a * b % R, [x, y])
+    # tag the private wires as narrow (their values fit 8 bits) so the
+    # key gets a real narrow class alongside the wide one
+    cs.set_width(x, 8)
+    cs.set_width(y, 8)
+    w = cs.witness([225], {x: 3, y: 5})
+    pk, vk = setup(cs)
+    dpk = device_pk(pk, cs)
+    assert int(dpk.a_nsel.shape[0]) > 0, "test must exercise the narrow class"
+    r, s = rng.randrange(1, R), rng.randrange(1, R)
+    got = prove_tpu(dpk, w, r=r, s=s)
+    assert got == prove_host(pk, cs, w, r=r, s=s)
+    assert verify(vk, got, [225])

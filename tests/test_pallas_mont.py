@@ -61,3 +61,21 @@ def test_pallas_mont_pow_small_exponent():
     got = mont_pow(FR, a, 5, interpret=True)
     for i, x in enumerate(xs):
         assert FR.from_mont_host(np.asarray(got[i])) == pow(x, 5, R)
+
+
+def test_pallas_mont_pow_under_vmap():
+    """The affine MSM tier calls inv_fused inside a scan UNDER VMAP in
+    the batched prover — exercise the pallas batching rule for the pow
+    kernel in interpret mode so the combination is not TPU-only."""
+    import jax
+
+    from zkp2p_tpu.ops.pallas_mont import mont_pow
+
+    xs = [[rng.randrange(1, P) for _ in range(3)] for _ in range(2)]
+    a = jnp.asarray(
+        np.stack([np.stack([FQ.to_mont_host(x) for x in row]) for row in xs])
+    )
+    got = jax.vmap(lambda v: mont_pow(FQ, v, P - 2, True))(a)
+    for i, row in enumerate(xs):
+        for j, x in enumerate(row):
+            assert FQ.from_mont_host(np.asarray(got[i, j])) == pow(x, P - 2, P)

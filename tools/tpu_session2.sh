@@ -41,4 +41,6 @@ printf '{"ZKP2P_MSM_AFFINE": "%s", "ZKP2P_MSM_H": "%s"}' "$AFFINE" "$HMODE" > .b
 phase diff 1200 python -u tools/pallas_hw_diff.py
 phase bench3 1800 env BENCH_TPU_BUDGET=1700 python -u bench.py
 phase msm_w8 900 python -u tools/msm_hwbench.py --n 131072 --window 8 --signed --skip-adds
+# single-proof latency (batch=1): the north-star p50 metric
+phase bench_lat 1200 env BENCH_TPU_BUDGET=1100 BENCH_BATCH=1 python -u bench.py
 echo "== session2 done $(date +%H:%M:%S)" >> "$OUT/session.log"

@@ -71,6 +71,26 @@ def tree_reduce(curve: JCurve, pts: JacPoint, axis_len: int) -> JacPoint:
     return tuple(jnp.squeeze(c, axis=ax) for c in pts)
 
 
+def fold_lanes_per_curve(curve: JCurve, per_lane: JacPoint, lanes: int) -> JacPoint:
+    """Final lane fold of a windowed MSM, shared by the Jacobian and
+    batch-affine tiers.  G1 takes the pairwise tree — log2(lanes)
+    halving adds instead of a `lanes`-step scan (cheaper dispatch on
+    1-core hosts, wider batches on TPU).  G2 joins the tree only when
+    the pallas point kernels are in use: with the XLA formulas the tree
+    inlines log2(lanes) copies of the Fq2 add graph and XLA:CPU compile
+    time blows up (r4 rehearsal: the G2 executable alone compiled
+    >400 s with the tree fold) — including bench's forced-XLA fallback
+    re-exec on a TPU backend, which must stay compilable."""
+    if curve.F.zero_limbs.ndim == 1 or curve._pallas():
+        return tree_reduce(curve, per_lane, lanes)
+
+    def fold(acc, p):
+        return curve.add(acc, p), None
+
+    total, _ = jax.lax.scan(fold, curve.infinity(()), per_lane)
+    return total
+
+
 def horner_fold_planes(curve: JCurve, init: JacPoint, planes_stacked, window: int) -> JacPoint:
     """MSB-first Horner fold over stacked digit-plane partials (leading
     axis = planes): acc = 2^window * acc + plane.  Shared by the
@@ -266,25 +286,7 @@ def _msm_windowed_impl(
     per_lane = horner_fold_planes(
         curve, curve.infinity((lanes,)), tuple(c for c in partials), window
     )
-
-    # Lane fold: G1 takes the pairwise tree — log2(lanes) halving adds
-    # instead of a `lanes`-step scan (cheaper dispatch on 1-core hosts,
-    # wider batches on TPU).  G2 joins the tree only when the pallas
-    # point kernels are in use (there a `lanes`-step scan is `lanes`
-    # tiny sequential kernel dispatches): with the XLA formulas the tree
-    # inlines log2(lanes) copies of the Fq2 add graph and compile time
-    # blows up (r4 rehearsal on XLA:CPU: the G2 executable alone
-    # compiled >400 s with the tree fold, vs ~180 s total for
-    # compile+run with the scan) — including bench's forced-XLA
-    # fallback re-exec on a TPU backend, which must stay compilable.
-    if curve.F.zero_limbs.ndim == 1 or curve._pallas():
-        return tree_reduce(curve, per_lane, lanes)
-
-    def fold_lanes(acc, p):
-        return curve.add(acc, p), None
-
-    total, _ = jax.lax.scan(fold_lanes, curve.infinity(()), per_lane)
-    return total
+    return fold_lanes_per_curve(curve, per_lane, lanes)
 
 
 def msm(curve: JCurve, bases: AffPoint, bit_planes: jnp.ndarray, lanes: int = 64) -> JacPoint:

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..curve.jcurve import AffPoint, JacPoint, JCurve
-from .msm import horner_fold_planes, tree_reduce
+from .msm import fold_lanes_per_curve, horner_fold_planes, tree_reduce
 
 
 def _one(F, like: jnp.ndarray) -> jnp.ndarray:
@@ -87,6 +87,11 @@ def batch_inverse(F, x: jnp.ndarray, fused_inv: bool = True) -> jnp.ndarray:
         norm = fq.add(fq.square(a), fq.square(b))
         ninv = batch_inverse(fq, norm, fused_inv)
         return jnp.stack([fq.mul(a, ninv), fq.neg(fq.mul(b, ninv))], axis=-2)
+    n = x.shape[0]
+    if n & (n - 1):  # pad to power-of-2 with 1s (e.g. 3-plane narrow MSMs)
+        pad = (1 << n.bit_length()) - n
+        xp = jnp.concatenate([x, jnp.broadcast_to(F.one_mont, (pad,) + x.shape[1:])])
+        return batch_inverse(F, xp, fused_inv)[:n]
     one = _one(F, x)
     safe = F.select(F.is_zero(x), one, x)
     pe = excl_prefix_mul(F, safe, F.one_mont)
@@ -251,14 +256,4 @@ def msm_windowed_affine(
     per_lane = horner_fold_planes(
         curve, curve.infinity((lanes,)), tuple(c for c in partials), window
     )
-    # Lane fold: same compile-budget rule as _msm_windowed_impl — the
-    # XLA G2 tree fold inlines log2(lanes) Fq2 add graphs and blows up
-    # XLA:CPU compile; scan-fold there, tree everywhere else.
-    if curve.F.zero_limbs.ndim == 1 or curve._pallas():
-        return tree_reduce(curve, per_lane, lanes)
-
-    def fold_lanes(acc, p):
-        return curve.add(acc, p), None
-
-    total, _ = jax.lax.scan(fold_lanes, curve.infinity(()), per_lane)
-    return total
+    return fold_lanes_per_curve(curve, per_lane, lanes)

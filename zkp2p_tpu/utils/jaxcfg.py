@@ -43,6 +43,14 @@ def cache_dir(base: str | None = None) -> str:
 
 
 def enable_cache(path: str | None = None) -> None:
+    # ZKP2P_NO_CACHE=1 is a global off-switch (every caller, including
+    # in-process CLI drives inside the test suite): long full-suite runs
+    # have segfaulted inside the persistent-cache WRITE path
+    # (executable.serialize() under put_executable_and_time,
+    # docs/logs/slow_suite_r4b crash stacks) — the green-log suite run
+    # trades cache reuse for stability.
+    if os.environ.get("ZKP2P_NO_CACHE") == "1":
+        return
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir(path))
