@@ -165,6 +165,13 @@ def _native_fallback_bench(plat: str) -> bool:
         traceback.print_exc(file=sys.stderr)
         log("native fallback tier failed; downgrading to the XLA tier")
         return False
+    # a second steady run guards against one-off host perturbation (the
+    # tunnel watcher's probe subprocess landing mid-measurement halved a
+    # rehearsal number); keep the best
+    with trace("prove_native_2"):
+        t0 = time.time()
+        prove_native(dpk, w)
+        best = min(best, time.time() - t0)
     log(f"native fallback: venmo {cs.num_constraints} constraints, first={first:.1f}s steady={best:.1f}s")
     dump_trace()
     vs = ((1 / best) * cs.num_constraints / BASELINE_CONSTRAINTS) / BASELINE_PROOFS_PER_SEC
@@ -384,7 +391,7 @@ def main():
 
     log("timed runs ...")
     times = []
-    n_runs = int(os.environ.get("BENCH_TIMED_RUNS", "2"))
+    n_runs = int(os.environ.get("BENCH_TIMED_RUNS", "3"))
     for run in range(n_runs):
         t0 = time.time()
         with trace("prove_batch", run=run, batch=BATCH):
