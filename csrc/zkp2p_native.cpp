@@ -829,7 +829,14 @@ void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
         u64 *u = data + 4 * (i0 + j);
         u64 *v = data + 4 * (i0 + j + half);
         u64 t[4];
-        fr_mul(t, v, tw + 4 * (j * stride));
+        // j == 0 is the identity twiddle: every stage's first
+        // butterfly (and ALL of stage len=2) — skipping the Montgomery
+        // mul there removes ~m of the m/2·log2(m) twiddle muls
+        if (j == 0) {
+          memcpy(t, v, 32);
+        } else {
+          fr_mul(t, v, tw + 4 * (j * stride));
+        }
         u64 usave[4];
         memcpy(usave, u, 32);
         fr_add(u, usave, t);
@@ -865,16 +872,20 @@ void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
   fr_mul(minv_std, mim, ONE_STD);
   u64 gm[4];
   fr_mul(gm, g_std, R2R);
-  // One shared g^j table for all three ladders (each previously ran its
-  // own sequential m-mul power chain).
+  // One shared table for all three ladders, with the iNTT's 1/m scale
+  // FOLDED IN: gpow[j] = (1/m)·g^j in Montgomery form, so the unscaled
+  // iNTT plus one coset mul replaces scale-pass + coset-pass (each
+  // previously ran its own sequential m-mul power chain too).
+  u64 minv_m[4];
+  fr_mul(minv_m, minv_std, R2R);
   u64 *gpow = new u64[(size_t)m * 4];
-  memcpy(gpow, ONE_R, 32);
+  memcpy(gpow, minv_m, 32);
   for (long j = 1; j < m; ++j) fr_mul(gpow + 4 * j, gpow + 4 * (j - 1), gm);
   u64 *vecs[3] = {a, b, c};
   auto ladder_one = [&](u64 *v) {
-    fr_ntt(v, m, winv_std, minv_std);  // iNTT: evals -> coefficients
-    // coset shift: coeff[j] *= g^j
-    for (long j = 1; j < m; ++j) fr_mul(v + 4 * j, v + 4 * j, gpow + 4 * j);
+    fr_ntt(v, m, winv_std, ONE_STD);  // unscaled iNTT: evals -> m·coeffs
+    // coset shift + deferred 1/m scale in one pass: v[j] *= (1/m)·g^j
+    for (long j = 0; j < m; ++j) fr_mul(v + 4 * j, v + 4 * j, gpow + 4 * j);
     fr_ntt(v, m, w_std, ONE_STD);  // forward: coefficients -> coset evals
   };
   // The three polynomial ladders are independent: thread them when the
