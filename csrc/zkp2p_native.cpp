@@ -966,6 +966,47 @@ static inline unsigned digit_at(const u64 s[4], int bit, int c) {
   return (unsigned)(v & ((1ULL << c) - 1));
 }
 
+// Signed base-2^c recoding of one scalar: digits in [-(2^(c-1)-1),
+// 2^(c-1)], LSW first.  Halves the bucket count per window (a negative
+// digit adds the NEGATED point: (x, p - y) is free next to a bucket
+// add).  The top window absorbs the final carry whenever nwin*c >= 255
+// (true for every c in the sweep range; asserted by the callers) since
+// Fr scalars are < 2^254.
+static void signed_digits(const u64 s[4], int c, int nwin, int32_t *out) {
+  long half = 1L << (c - 1), full = 1L << c;
+  long carry = 0;
+  for (int wi = 0; wi < nwin; ++wi) {
+    long d = (long)digit_at(s, wi * c, c) + carry;
+    if (d > half) {
+      out[wi] = (int32_t)(d - full);
+      carry = 1;
+    } else {
+      out[wi] = (int32_t)d;
+      carry = 0;
+    }
+  }
+}
+
+// y -> p - y (Montgomery), the negation used for negative digits.
+static inline void neg_y(u64 out[4], const u64 y[4]) {
+  if (is_zero4(y)) {
+    memset(out, 0, 32);
+    return;
+  }
+  sub_nored(out, P, y);
+}
+
+// The digit-signed y of a point: shared by every G1 fill path so the
+// sign handling cannot diverge between the batch-affine, jac, and bail
+// tiers.
+static inline void signed_pt_y(u64 out[4], const u64 y[4], bool negate) {
+  if (negate) {
+    neg_y(out, y);
+  } else {
+    memcpy(out, y, 32);
+  }
+}
+
 // One Pippenger window sum: bucket fill over all n points + suffix-sum
 // reduction.  Windows are independent, which is the parallel axis (the
 // same split rapidsnark's thread pool uses): each worker owns its bucket
@@ -991,18 +1032,21 @@ static inline bool aff_is_empty(const AffPt &p) {
 // digit range is tiny (the TOP window often has only a few bits: its
 // points pile into a handful of buckets and the batch-affine conflict
 // queue degenerates into near-serial passes).
-static void g1_window_sum_jac(const u64 *bases_xy, const u64 *scalars, long n,
-                              int c, int wi, G1Jac *out) {
-  long nbuckets = 1L << c;
+static void g1_window_sum_jac(const u64 *bases_xy, const int32_t *sd, long n,
+                              int c, int nwin, int wi, G1Jac *out) {
+  long nbuckets = (1L << (c - 1)) + 1;  // signed digits reach 2^(c-1)
   G1Jac *buckets = new G1Jac[nbuckets];
   memset(buckets, 0, (size_t)nbuckets * sizeof(G1Jac));
   for (long i = 0; i < n; ++i) {
-    unsigned d = digit_at(scalars + 4 * i, wi * c, c);
+    int32_t d = sd[i * nwin + wi];
     if (!d) continue;
     const u64 *x = bases_xy + 8 * i;
     const u64 *y = x + 4;
     if (is_zero4(x) && is_zero4(y)) continue;
-    jac_add_mixed(buckets[d], buckets[d], x, y);
+    long b = d < 0 ? -d : d;
+    u64 ys[4];
+    signed_pt_y(ys, y, d < 0);
+    jac_add_mixed(buckets[b], buckets[b], x, ys);
   }
   G1Jac run, wsum;
   memset(&run, 0, sizeof(run));
@@ -1015,14 +1059,14 @@ static void g1_window_sum_jac(const u64 *bases_xy, const u64 *scalars, long n,
   *out = wsum;
 }
 
-static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
-                          int c, int wi, G1Jac *out) {
-  const long nbuckets = 1L << c;
+static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
+                          int c, int nwin, int wi, G1Jac *out) {
+  const long nbuckets = (1L << (c - 1)) + 1;  // signed digit magnitudes
   const long B = 2048;  // chunk size for the shared inversion
   int bits_here = 254 - wi * c;
   if (bits_here > c) bits_here = c;
   if (bits_here < 1 || (1L << bits_here) < 4 * B) {
-    g1_window_sum_jac(bases_xy, scalars, n, c, wi, out);
+    g1_window_sum_jac(bases_xy, sd, n, c, nwin, wi, out);
     return;
   }
   AffPt *bk = new AffPt[nbuckets]();
@@ -1032,8 +1076,7 @@ static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
   std::vector<long> cur, next;
   cur.reserve(n);
   for (long i = 0; i < n; ++i) {
-    unsigned d = digit_at(scalars + 4 * i, wi * c, c);
-    if (!d) continue;
+    if (!sd[i * nwin + wi]) continue;
     const u64 *x = bases_xy + 8 * i;
     if (is_zero4(x) && is_zero4(x + 4)) continue;
     cur.push_back(i);
@@ -1056,14 +1099,16 @@ static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
       long m = 0;
       for (size_t k = lo; k < hi; ++k) {
         long i = cur[k];
-        long b = digit_at(scalars + 4 * i, wi * c, c);
+        int32_t dgt = sd[i * nwin + wi];
+        long b = dgt < 0 ? -dgt : dgt;
         if (stamp[b] == chunk_id) {  // bucket already touched this chunk
           next.push_back(i);
           continue;
         }
         stamp[b] = chunk_id;
         const u64 *px = bases_xy + 8 * i;
-        const u64 *py = px + 4;
+        u64 py[4];
+        signed_pt_y(py, px + 4, dgt < 0);
         if (aff_is_empty(bk[b])) {  // install: no field ops at all
           memcpy(bk[b].x, px, 32);
           memcpy(bk[b].y, py, 32);
@@ -1138,9 +1183,12 @@ static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
       memset(jb, 0, (size_t)nbuckets * sizeof(G1Jac));
       next.insert(next.end(), cur.begin() + processed, cur.end());
       for (long i : next) {
-        long b = digit_at(scalars + 4 * i, wi * c, c);
+        int32_t dgt = sd[i * nwin + wi];
+        long b = dgt < 0 ? -dgt : dgt;
         const u64 *x = bases_xy + 8 * i;
-        jac_add_mixed(jb[b], jb[b], x, x + 4);
+        u64 ys[4];
+        signed_pt_y(ys, x + 4, dgt < 0);
+        jac_add_mixed(jb[b], jb[b], x, ys);
       }
       G1Jac run, wsum;
       memset(&run, 0, sizeof(run));
@@ -1182,14 +1230,15 @@ static void g1_window_sum(const u64 *bases_xy, const u64 *scalars, long n,
   *out = wsum;
 }
 
-static void g2_window_sum(const u64 *bases, const u64 *scalars, long n,
-                          int c, int wi, G2Jac *out) {
-  long nbuckets = 1L << c;
+static void g2_window_sum(const u64 *bases, const int32_t *sd, long n,
+                          int c, int nwin, int wi, G2Jac *out) {
+  long nbuckets = (1L << (c - 1)) + 1;  // signed digit magnitudes
   G2Jac *buckets = new G2Jac[nbuckets];
   memset(buckets, 0, (size_t)nbuckets * sizeof(G2Jac));
   for (long i = 0; i < n; ++i) {
-    unsigned d = digit_at(scalars + 4 * i, wi * c, c);
-    if (!d) continue;
+    int32_t dgt = sd[i * nwin + wi];
+    if (!dgt) continue;
+    long d = dgt < 0 ? -dgt : dgt;
     const u64 *b = bases + 16 * i;
     Fp2 x2, y2;
     memcpy(x2.c0, b, 32);
@@ -1197,6 +1246,13 @@ static void g2_window_sum(const u64 *bases, const u64 *scalars, long n,
     memcpy(y2.c0, b + 8, 32);
     memcpy(y2.c1, b + 12, 32);
     if (fp2_is_zero(x2) && fp2_is_zero(y2)) continue;
+    if (dgt < 0) {  // -(y0 + y1 u) component-wise
+      u64 t[4];
+      neg_y(t, y2.c0);
+      memcpy(y2.c0, t, 32);
+      neg_y(t, y2.c1);
+      memcpy(y2.c1, t, 32);
+    }
     g2_add_mixed(buckets[d], buckets[d], x2, y2);
   }
   G2Jac run, wsum;
@@ -1241,10 +1297,15 @@ extern "C" {
 void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out_xy) {
   int nwin = (254 + c - 1) / c;
+  // signed recoding needs the top window to absorb the carry (Fr < 2^254)
+  while ((long)nwin * c < 255) ++nwin;
+  int32_t *sd = new int32_t[(size_t)n * nwin];
+  for (long i = 0; i < n; ++i) signed_digits(scalars + 4 * i, c, nwin, sd + (size_t)i * nwin);
   G1Jac *wins = new G1Jac[nwin];
   run_window_sums(nwin, n_threads, wins, [&](int wi, G1Jac *o) {
-    g1_window_sum(bases_xy, scalars, n, c, wi, o);
+    g1_window_sum(bases_xy, sd, n, c, nwin, wi, o);
   });
+  delete[] sd;
   G1Jac acc;
   memset(&acc, 0, sizeof(acc));
   for (int wi = nwin - 1; wi >= 0; --wi) {
@@ -1278,10 +1339,14 @@ void g1_msm_pippenger(const u64 *bases_xy, const u64 *scalars, long n,
 void g2_msm_pippenger_mt(const u64 *bases, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out) {
   int nwin = (254 + c - 1) / c;
+  while ((long)nwin * c < 255) ++nwin;
+  int32_t *sd = new int32_t[(size_t)n * nwin];
+  for (long i = 0; i < n; ++i) signed_digits(scalars + 4 * i, c, nwin, sd + (size_t)i * nwin);
   G2Jac *wins = new G2Jac[nwin];
   run_window_sums(nwin, n_threads, wins, [&](int wi, G2Jac *o) {
-    g2_window_sum(bases, scalars, n, c, wi, o);
+    g2_window_sum(bases, sd, n, c, nwin, wi, o);
   });
+  delete[] sd;
   G2Jac acc;
   memset(&acc, 0, sizeof(acc));
   for (int wi = nwin - 1; wi >= 0; --wi) {
