@@ -136,10 +136,16 @@ def _u64x4_to_int_arr(a: np.ndarray) -> list:
 
 
 def _pick_window(n: int) -> int:
-    """Pippenger window: ~log2(n) - 5 balances the n-add batch-affine
-    bucket fill against the 2^(c+1) reduction adds per window (empirical
-    sweep at n=2^19 on this host: c=13 3.49s, c=15 3.34s, c=16 3.52s)."""
-    return max(4, min(16, n.bit_length() - 5))
+    """Pippenger window: ~log2(n) - 4 with SIGNED digits — the signed
+    recoding halves the bucket count at a given c, so the sweet spot
+    sits one window wider than the unsigned sweep (n=2^19: unsigned
+    c=13 3.49s, c=15 3.34s, c=16 3.52s) — same bucket count and
+    chunk-conflict rate as unsigned c-1, one fewer window of fill adds.
+    At full size (2^23) signed c=16 regressed the prove 125.6->138.7 s
+    purely from doubled batch-affine conflicts; the raised clamp lets
+    the big domains reach c=17 while the bench shape keeps its
+    measured-best c=15 (signed sweep at 2^19: c=15 6.3s, c=16 7.6s)."""
+    return max(4, min(17, n.bit_length() - 5))
 
 
 def _n_threads() -> int:
