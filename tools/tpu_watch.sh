@@ -3,7 +3,7 @@
 # first healthy probe of each window.  Run in the background for the
 # whole round: windows have been ~30 min and unannounced.
 cd "$(dirname "$0")/.."
-LOG=docs/logs/tpu_watch_r4.log
+LOG=docs/logs/tpu_watch_r5.log
 while true; do
   if python -c "from zkp2p_tpu.utils.jaxcfg import tpu_probe_ok; import sys; sys.exit(0 if tpu_probe_ok() else 1)" 2>/dev/null; then
     echo "$(date +%H:%M:%S) tunnel UP -> firing session" >> "$LOG"

@@ -73,6 +73,19 @@ def proof_to_calldata(proof: Proof, signals: Sequence[int]) -> Tuple:
     return a, b, c, tuple(int(s) for s in signals)
 
 
+def proof_from_calldata(a: Sequence, b: Sequence, c: Sequence) -> Proof:
+    """Inverse of `proof_to_calldata`: reassemble a Proof from the EVM
+    calldata layout (`Ramp.onRamp(uint[2] a, uint[2][2] b, uint[2] c, ...)`,
+    `Verifier.sol:360`), undoing the pi_b c1/c0 flip.  This is how the
+    chain-side pinned vectors (`test/ramp.test.js:193-196`) map back to
+    curve points."""
+    return Proof(
+        a=_parse_g1(a),
+        b=(Fq2(int(b[0][1]), int(b[0][0])), Fq2(int(b[1][1]), int(b[1][0]))),
+        c=_parse_g1(c),
+    )
+
+
 def vkey_to_json(vk: VerifyingKey) -> Dict:
     """snarkjs verification_key.json (the embedded `app/src/helpers/vkey.ts`
     shape; `vk_alphabeta_12` is omitted — snarkjs recomputes pairings from
