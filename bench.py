@@ -330,8 +330,11 @@ def main():
     try:
         with open(os.path.join(CACHE, "armed_flags.json")) as f:
             flags = json.load(f)
-        for k, v in flags.items():
-            if isinstance(k, str) and k.startswith("ZKP2P_"):
+        # whitelist: only the two knobs the A/B session is allowed to arm —
+        # a stale/corrupt cache file must not steer unrelated prover config
+        for k in ("ZKP2P_MSM_AFFINE", "ZKP2P_MSM_H"):
+            if k in flags:
+                v = flags[k]
                 # booleans normalise to the "1"/"0" the prover checks
                 os.environ.setdefault(k, {True: "1", False: "0"}.get(v, str(v)))
         log(f"armed flags applied: {[f'{k}={os.environ[k]}' for k in ('ZKP2P_MSM_AFFINE', 'ZKP2P_MSM_H') if k in os.environ]}")

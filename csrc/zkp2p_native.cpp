@@ -14,6 +14,7 @@
 #include <cstdint>
 #include <array>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <utility>
 #include <cstdlib>
@@ -805,22 +806,30 @@ void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
   // Twiddles depend only on (m, root): cache them across calls — the
   // ladder runs 6 NTTs per prove and the sequential m/2-mul rebuild was
   // ~5% of its time.  Guarded: ladder threads call fr_ntt concurrently.
+  // Capacity-capped (each entry is 16*m bytes, ~128 MB per root at
+  // m=2^23): a long-lived service proving across domain sizes must not
+  // accumulate unbounded twiddle tables.  shared_ptr keeps an evicted
+  // table alive for any thread still mid-butterfly on it.
   static std::mutex tw_mu;
-  static std::map<std::array<u64, 5>, u64 *> tw_cache;
-  u64 *tw;
+  static std::map<std::array<u64, 5>, std::shared_ptr<u64[]>> tw_cache;
+  std::shared_ptr<u64[]> tw_hold;
   {
     std::lock_guard<std::mutex> lk(tw_mu);
     std::array<u64, 5> key = {(u64)m, root_std[0], root_std[1], root_std[2], root_std[3]};
     auto it = tw_cache.find(key);
     if (it != tw_cache.end()) {
-      tw = it->second;
+      tw_hold = it->second;
     } else {
-      tw = new u64[(size_t)(half_m > 0 ? half_m : 1) * 4];
-      memcpy(tw, ONE_R, 32);
-      for (long j = 1; j < half_m; ++j) fr_mul(tw + 4 * j, tw + 4 * (j - 1), root_m);
-      tw_cache[key] = tw;
+      tw_hold = std::shared_ptr<u64[]>(new u64[(size_t)(half_m > 0 ? half_m : 1) * 4]);
+      memcpy(tw_hold.get(), ONE_R, 32);
+      for (long j = 1; j < half_m; ++j) fr_mul(tw_hold.get() + 4 * j, tw_hold.get() + 4 * (j - 1), root_m);
+      // evict smallest-m entries first (cheapest to rebuild) until at
+      // most 8 tables besides the one being inserted remain
+      while (tw_cache.size() >= 8) tw_cache.erase(tw_cache.begin());
+      tw_cache[key] = tw_hold;
     }
   }
+  u64 *tw = tw_hold.get();
   for (long len = 2; len <= m; len <<= 1) {
     long half = len >> 1;
     long stride = m / len;

@@ -25,6 +25,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from ..formats.proof_json import dump
 from ..utils.trace import trace
 
 
@@ -89,6 +90,15 @@ class ProvingService:
     # claims, so a request is never reprocessed after completion.
 
     def _try_claim(self, base_path: str) -> bool:
+        # Terminal outputs are re-checked at CLAIM time, not just at scan
+        # time: a peer may have completed this request (proof emitted,
+        # claim released) between our scan and our dequeue — re-claiming
+        # it would duplicate the prove and double-count `done`.  A
+        # microscopic emit-between-check-and-claim window remains
+        # (at-least-once, never wrong: terminal writes are atomic and any
+        # duplicate proof still verifies).
+        if os.path.exists(base_path + ".proof.json") or os.path.exists(base_path + ".error.json"):
+            return False
         claim = base_path + ".claim"
         try:
             fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -122,7 +132,7 @@ class ProvingService:
     def process_dir(self, spool: str) -> Dict[str, int]:
         """One spool sweep; returns counters. Files: <name>.req.json in,
         <name>.proof.json / <name>.error.json out."""
-        from ..formats.proof_json import dump, proof_to_json, public_to_json
+        from ..formats.proof_json import proof_to_json, public_to_json
         from ..prover.groth16_tpu import prove_tpu_batch
         from ..snark.groth16 import verify
 
@@ -224,17 +234,32 @@ class ProvingService:
             if batch is None:
                 break
             try:
-                # heartbeat: refresh the batch's claims right before the
-                # prove so their age is bounded by ONE batch's prove
-                # time, not queue depth (stale_claim_s must exceed that)
-                for req in batch:
-                    try:
-                        os.utime(req.path + ".claim", None)
-                    except OSError:
-                        pass
-                with trace("service/prove", n=len(batch)):
-                    prove = self.prover_fn or prove_tpu_batch
-                    proofs = prove(self.dpk, [r.witness for r in batch])
+                # heartbeat: refresh the batch's claims periodically WHILE
+                # the prove runs, so claim age stays bounded by the refresh
+                # interval — not by one batch's prove time (a batch of
+                # full-size proves can exceed stale_claim_s and a peer
+                # would take over in-flight work)
+                stop_hb = threading.Event()
+
+                def _heartbeat(reqs=batch):
+                    while True:
+                        for req in reqs:
+                            try:
+                                os.utime(req.path + ".claim", None)
+                            except OSError:
+                                pass
+                        if stop_hb.wait(max(self.stale_claim_s / 3.0, 0.05)):
+                            return
+
+                hb = threading.Thread(target=_heartbeat, daemon=True)
+                hb.start()
+                try:
+                    with trace("service/prove", n=len(batch)):
+                        prove = self.prover_fn or prove_tpu_batch
+                        proofs = prove(self.dpk, [r.witness for r in batch])
+                finally:
+                    stop_hb.set()
+                    hb.join()
                 # verify a sample from every batch before emitting
                 sample_pub = self.public_fn(batch[0].witness)
                 if not verify(self.vk, proofs[0], sample_pub):
@@ -258,12 +283,13 @@ class ProvingService:
 
     @classmethod
     def _emit_error(cls, req: Request, state: str, exc: Exception) -> None:
-        with open(req.path + ".error.json", "w") as f:
-            json.dump(
-                {"state": state, "error": str(exc), "trace": traceback.format_exc(limit=3), "ts": time.time()},
-                f,
-                indent=1,
-            )
+        # atomic (temp+rename) like every other terminal artifact: a crash
+        # or racing peer mid-write must never leave a torn .error.json that
+        # the sweep's existence check treats as final
+        dump(
+            {"state": state, "error": str(exc), "trace": traceback.format_exc(limit=3), "ts": time.time()},
+            req.path + ".error.json",
+        )
         cls._release_claim(req.path)
 
     # ------------------------------------------------------------- daemon
