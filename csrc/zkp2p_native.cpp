@@ -744,6 +744,546 @@ static void fr_inv_mont(u64 out[4], const u64 a[4]) {
   fr_pow(out, a, e);
 }
 
+// ----------------------------------------------- AVX-512 IFMA field core
+//
+// 8-wide Montgomery arithmetic in a 5x52-bit limb representation
+// (R = 2^260), the layout vpmadd52luq/vpmadd52huq are built for.  This
+// is the single-core SIMD answer to rapidsnark's x86-64 asm field layer
+// (SURVEY.md §2.2): the driver box exposes exactly one core, so lane
+// parallelism is the only parallel axis the native tier has.
+//
+// Domain bookkeeping ("carrier trick"): a value stored as y = x·2^256
+// (the scalar tier's mont256 form) times a constant stored as c·2^260
+// (mont260) under mont260 multiplication yields (y·c·2^260)·2^-260 =
+// (x·c)·2^256 — i.e. data can stay in the scalar tier's Montgomery form
+// through the whole vector pipeline as long as every CONSTANT table
+// (twiddles, coset powers) is prepared in mont260 form.  No conversion
+// passes over the data, ever.
+//
+// Lazy reduction: all vector values live in [0, 2p).  mont260 output is
+// < p + a·b/2^260 < 2p for inputs < 2p because 4p < 2^260; add/sub
+// conditionally fold by 2p.  Full reduction happens only at unpack.
+
+#if defined(__AVX512IFMA__)
+#include <immintrin.h>
+#define ZKP2P_HAVE_IFMA 1
+
+static const u64 M52 = (1ULL << 52) - 1;
+
+// Per-field constant pack for the 52-bit core (Fr for NTT, Fq later for
+// the MSM lambda lanes).
+struct Ifma52Field {
+  u64 p52[5];      // modulus
+  u64 p2_52[5];    // 2p
+  u64 comp2p[5];   // 2^260 - 2p  (complement used for the cond-subtract)
+  u64 pinv52;      // -p^-1 mod 2^52
+  u64 r260sq[5];   // 2^520 mod p (std -> mont260 via one mont260 mul)
+  u64 c256[5];     // 2^256 mod p (mont260 -> mont256 carrier)
+  u64 c264[5];     // 2^264 mod p (mont256 -> mont260 carrier)
+};
+
+static void limbs4_to_52(u64 out[5], const u64 a[4]) {
+  out[0] = a[0] & M52;
+  out[1] = ((a[0] >> 52) | (a[1] << 12)) & M52;
+  out[2] = ((a[1] >> 40) | (a[2] << 24)) & M52;
+  out[3] = ((a[2] >> 28) | (a[3] << 36)) & M52;
+  out[4] = a[3] >> 16;
+}
+
+static void limbs52_to_4(u64 out[4], const u64 t[5]) {
+  out[0] = t[0] | (t[1] << 52);
+  out[1] = (t[1] >> 12) | (t[2] << 40);
+  out[2] = (t[2] >> 24) | (t[3] << 28);
+  out[3] = (t[3] >> 36) | (t[4] << 16);
+}
+
+// 1-lane 52-limb mont260 multiply (u128 scalar): table building only.
+static void mont52_mul_scalar(u64 out[5], const u64 a[5], const u64 b[5],
+                              const Ifma52Field &F) {
+  u128 t[6] = {0, 0, 0, 0, 0, 0};
+  for (int i = 0; i < 5; ++i) {
+    u64 bi = b[i];
+    for (int j = 0; j < 5; ++j) {
+      u128 prod = (u128)a[j] * bi;
+      t[j] += (u64)prod & M52;
+      t[j + 1] += (u64)(prod >> 52);
+    }
+    u64 mi = ((u64)t[0] * F.pinv52) & M52;
+    for (int j = 0; j < 5; ++j) {
+      u128 prod = (u128)mi * F.p52[j];
+      t[j] += (u64)prod & M52;
+      t[j + 1] += (u64)(prod >> 52);
+    }
+    t[1] += (u64)(t[0] >> 52);
+    for (int j = 0; j < 5; ++j) t[j] = t[j + 1];
+    t[5] = 0;
+  }
+  u64 c = 0;
+  for (int j = 0; j < 5; ++j) {
+    u128 s = t[j] + c;
+    out[j] = (u64)s & M52;
+    c = (u64)(s >> 52);
+  }
+}
+
+// Build the constant pack from 4x64 modulus + -p^-1 mod 2^64.
+static void ifma52_init(Ifma52Field &F, const u64 p4[4], u64 pinv64,
+                        void (*add_modp)(u64 *, const u64 *, const u64 *)) {
+  limbs4_to_52(F.p52, p4);
+  F.pinv52 = pinv64 & M52;
+  // 2p as a raw 255-bit value (p < 2^254, so the shift cannot overflow)
+  u64 two_p[4];
+  u64 carry = 0;
+  for (int i = 0; i < 4; ++i) {
+    two_p[i] = (p4[i] << 1) | carry;
+    carry = p4[i] >> 63;
+  }
+  limbs4_to_52(F.p2_52, two_p);
+  // comp2p = 2^260 - 2p = (~2p + 1) over 5x52 limbs (mod 2^260)
+  u64 c2 = 1;
+  for (int j = 0; j < 5; ++j) {
+    u64 s = ((~F.p2_52[j]) & M52) + c2;
+    F.comp2p[j] = s & M52;
+    c2 = s >> 52;
+  }
+  // 2^520 mod p by 520 reducing doublings of 1, snapshotting the
+  // carrier-conversion constants 2^256 and 2^264 on the way up
+  u64 x[4] = {1, 0, 0, 0};
+  for (int i = 0; i < 520; ++i) {
+    add_modp(x, x, x);
+    if (i == 255) limbs4_to_52(F.c256, x);
+    if (i == 263) limbs4_to_52(F.c264, x);
+  }
+  limbs4_to_52(F.r260sq, x);
+}
+
+// add thunks with the reducing signature ifma52_init expects
+static void fr_add_thunk(u64 *o, const u64 *a, const u64 *b) { fr_add(o, a, b); }
+static void fp_add_thunk(u64 *o, const u64 *a, const u64 *b) { add_mod(o, a, b); }
+
+static Ifma52Field &fr52_field() {
+  static Ifma52Field F;
+  static bool init = false;
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lk(mu);
+  if (!init) {
+    ifma52_init(F, R_MOD, RINV, fr_add_thunk);
+    init = true;
+  }
+  return F;
+}
+
+static Ifma52Field &fq52_field() {
+  static Ifma52Field F;
+  static bool init = false;
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lk(mu);
+  if (!init) {
+    ifma52_init(F, P, PINV, fp_add_thunk);
+    init = true;
+  }
+  return F;
+}
+
+static bool ifma_enabled() {
+  static int cached = -1;
+  if (cached < 0) {
+    const char *e = getenv("ZKP2P_NATIVE_IFMA");
+    bool off = e && e[0] == '0';
+    cached = (!off && __builtin_cpu_supports("avx512ifma")) ? 1 : 0;
+  }
+  return cached == 1;
+}
+
+// ---- vector kernel: out = a*b*2^-260, lanes independent, in/out < 2p.
+// Accumulator headroom: each 64-bit lane absorbs <= 4 madd52 terms plus
+// one sub-2^12 carry per outer iteration (5 iterations -> < 25·2^52 <
+// 2^57), far under 2^64.
+static inline void mont52_mul8(__m512i out[5], const __m512i a[5],
+                               const __m512i b[5], const __m512i p[5],
+                               const __m512i pinv) {
+  const __m512i z = _mm512_setzero_si512();
+  __m512i t0 = z, t1 = z, t2 = z, t3 = z, t4 = z, t5 = z;
+  for (int i = 0; i < 5; ++i) {
+    const __m512i bi = b[i];
+    t0 = _mm512_madd52lo_epu64(t0, a[0], bi);
+    t1 = _mm512_madd52lo_epu64(t1, a[1], bi);
+    t2 = _mm512_madd52lo_epu64(t2, a[2], bi);
+    t3 = _mm512_madd52lo_epu64(t3, a[3], bi);
+    t4 = _mm512_madd52lo_epu64(t4, a[4], bi);
+    t1 = _mm512_madd52hi_epu64(t1, a[0], bi);
+    t2 = _mm512_madd52hi_epu64(t2, a[1], bi);
+    t3 = _mm512_madd52hi_epu64(t3, a[2], bi);
+    t4 = _mm512_madd52hi_epu64(t4, a[3], bi);
+    t5 = _mm512_madd52hi_epu64(t5, a[4], bi);
+    const __m512i mi = _mm512_madd52lo_epu64(z, t0, pinv);
+    t0 = _mm512_madd52lo_epu64(t0, mi, p[0]);
+    t1 = _mm512_add_epi64(t1, _mm512_srli_epi64(t0, 52));
+    t1 = _mm512_madd52lo_epu64(t1, mi, p[1]);
+    t2 = _mm512_madd52lo_epu64(t2, mi, p[2]);
+    t3 = _mm512_madd52lo_epu64(t3, mi, p[3]);
+    t4 = _mm512_madd52lo_epu64(t4, mi, p[4]);
+    t1 = _mm512_madd52hi_epu64(t1, mi, p[0]);
+    t2 = _mm512_madd52hi_epu64(t2, mi, p[1]);
+    t3 = _mm512_madd52hi_epu64(t3, mi, p[2]);
+    t4 = _mm512_madd52hi_epu64(t4, mi, p[3]);
+    t5 = _mm512_madd52hi_epu64(t5, mi, p[4]);
+    t0 = t1; t1 = t2; t2 = t3; t3 = t4; t4 = t5; t5 = z;
+  }
+  // carry-normalize to 52-bit limbs
+  const __m512i m52 = _mm512_set1_epi64((long long)M52);
+  __m512i c;
+  out[0] = _mm512_and_si512(t0, m52);           c = _mm512_srli_epi64(t0, 52);
+  t1 = _mm512_add_epi64(t1, c);
+  out[1] = _mm512_and_si512(t1, m52);           c = _mm512_srli_epi64(t1, 52);
+  t2 = _mm512_add_epi64(t2, c);
+  out[2] = _mm512_and_si512(t2, m52);           c = _mm512_srli_epi64(t2, 52);
+  t3 = _mm512_add_epi64(t3, c);
+  out[3] = _mm512_and_si512(t3, m52);           c = _mm512_srli_epi64(t3, 52);
+  t4 = _mm512_add_epi64(t4, c);
+  out[4] = t4;  // < 2^52 (result < 2p < 2^255)
+}
+
+// conditional fold by 2p: in < 4p (limbs normalized), out < 2p.
+static inline void cond_sub_2p8(__m512i v[5], const __m512i comp2p[5]) {
+  const __m512i m52 = _mm512_set1_epi64((long long)M52);
+  __m512i u[5], c = _mm512_setzero_si512();
+  for (int j = 0; j < 5; ++j) {
+    __m512i s = _mm512_add_epi64(_mm512_add_epi64(v[j], comp2p[j]), c);
+    u[j] = _mm512_and_si512(s, m52);
+    c = _mm512_srli_epi64(s, 52);
+  }
+  // carry-out of the top limb <=> v >= 2p <=> keep the subtracted value
+  __mmask8 ge = _mm512_cmpneq_epu64_mask(c, _mm512_setzero_si512());
+  for (int j = 0; j < 5; ++j) v[j] = _mm512_mask_blend_epi64(ge, v[j], u[j]);
+}
+
+// u' = u + t (mod lazy 2p); limbs of u,t are 52-bit normalized.
+static inline void add_lazy8(__m512i out[5], const __m512i u[5],
+                             const __m512i t[5], const __m512i comp2p[5]) {
+  const __m512i m52 = _mm512_set1_epi64((long long)M52);
+  __m512i c = _mm512_setzero_si512();
+  for (int j = 0; j < 5; ++j) {
+    __m512i s = _mm512_add_epi64(_mm512_add_epi64(u[j], t[j]), c);
+    out[j] = _mm512_and_si512(s, m52);
+    c = _mm512_srli_epi64(s, 52);
+  }
+  cond_sub_2p8(out, comp2p);
+}
+
+// v' = u - t + 2p (mod lazy 2p).
+static inline void sub_lazy8(__m512i out[5], const __m512i u[5],
+                             const __m512i t[5], const __m512i p2[5],
+                             const __m512i comp2p[5]) {
+  const __m512i m52 = _mm512_set1_epi64((long long)M52);
+  // u + 2p + (~t + 1) over 52-bit limbs, mod 2^260
+  __m512i c = _mm512_set1_epi64(1);
+  for (int j = 0; j < 5; ++j) {
+    __m512i nt = _mm512_andnot_si512(t[j], m52);  // M52 - t[j]
+    __m512i s = _mm512_add_epi64(_mm512_add_epi64(u[j], p2[j]),
+                                 _mm512_add_epi64(nt, c));
+    out[j] = _mm512_and_si512(s, m52);
+    c = _mm512_srli_epi64(s, 52);
+  }
+  cond_sub_2p8(out, comp2p);
+}
+
+// -------- per-stage twiddle tables (mont260, SoA planes, contiguous j)
+//
+// For each radix-2 stage len >= 16 the vector path wants tw[j] for
+// contiguous j in 0..half-1.  Tables are cached per (m, root) like the
+// scalar twiddle cache, same 8-entry cap, shared_ptr for in-flight
+// safety.  Layout: stages concatenated, each stage stored as 5 planes
+// of `half` u64.
+struct IfmaTwiddles {
+  std::shared_ptr<u64[]> buf;
+  // offsets[s] = start of stage (len = 16 << s) in buf, in u64s
+  std::vector<size_t> offsets;
+};
+
+static IfmaTwiddles ifma_stage_twiddles(long m, const u64 root_std[4]) {
+  static std::mutex mu;
+  static std::map<std::array<u64, 5>, IfmaTwiddles> cache;
+  std::lock_guard<std::mutex> lk(mu);
+  std::array<u64, 5> key = {(u64)m, root_std[0], root_std[1], root_std[2], root_std[3]};
+  auto it = cache.find(key);
+  if (it != cache.end()) return it->second;
+
+  Ifma52Field &F = fr52_field();
+  IfmaTwiddles T;
+  size_t total = 0;
+  for (long len = 16; len <= m; len <<= 1) total += (size_t)(len >> 1) * 5;
+  T.buf = std::shared_ptr<u64[]>(new u64[total]);
+  // root in mont260: pack then one mont260 mul by 2^520
+  u64 root52[5], root260[5];
+  limbs4_to_52(root52, root_std);
+  mont52_mul_scalar(root260, root52, F.r260sq, F);
+  u64 one260[5];  // 2^260 mod p = mont260(1): 1*2^520*2^-260
+  u64 one52[5] = {1, 0, 0, 0, 0};
+  mont52_mul_scalar(one260, one52, F.r260sq, F);
+  size_t off = 0;
+  for (long len = 16; len <= m; len <<= 1) {
+    long half = len >> 1;
+    // wlen = root^(m/len) in mont260 (square root260 down the chain)
+    u64 wlen[5];
+    memcpy(wlen, root260, 40);
+    for (long s = m / len; s > 1; s >>= 1) mont52_mul_scalar(wlen, wlen, wlen, F);
+    T.offsets.push_back(off);
+    u64 cur[5];
+    memcpy(cur, one260, 40);
+    u64 *planes = T.buf.get() + off;
+    for (long j = 0; j < half; ++j) {
+      for (int k = 0; k < 5; ++k) planes[(size_t)k * half + j] = cur[k];
+      mont52_mul_scalar(cur, cur, wlen, F);
+    }
+    off += (size_t)half * 5;
+  }
+  while (cache.size() >= 8) cache.erase(cache.begin());
+  cache[key] = T;
+  return T;
+}
+
+// Vector stages of the radix-2 NTT: data already bit-reversed and with
+// the len<16 stages applied (scalar); values in mont256 u64x4.  Packs
+// to 52-bit SoA, runs len>=16 stages 8 butterflies at a time, unpacks
+// with full reduction mod r.
+static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
+  Ifma52Field &F = fr52_field();
+  IfmaTwiddles T = ifma_stage_twiddles(m, root_std);
+  // SoA planes
+  u64 *soa = new u64[(size_t)m * 5];
+  for (long i = 0; i < m; ++i) {
+    u64 t[5];
+    limbs4_to_52(t, data + 4 * i);
+    for (int k = 0; k < 5; ++k) soa[(size_t)k * m + i] = t[k];
+  }
+  __m512i p[5], p2[5], comp2p[5];
+  for (int k = 0; k < 5; ++k) {
+    p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+    p2[k] = _mm512_set1_epi64((long long)F.p2_52[k]);
+    comp2p[k] = _mm512_set1_epi64((long long)F.comp2p[k]);
+  }
+  const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+  int stage = 0;
+  for (long len = 16; len <= m; len <<= 1, ++stage) {
+    const long half = len >> 1;
+    const u64 *twp = T.buf.get() + T.offsets[stage];
+    for (long i0 = 0; i0 < m; i0 += len) {
+      for (long j = 0; j < half; j += 8) {
+        __m512i u[5], v[5], tw[5], t[5], un[5], vn[5];
+        for (int k = 0; k < 5; ++k) {
+          u[k] = _mm512_loadu_si512(soa + (size_t)k * m + i0 + j);
+          v[k] = _mm512_loadu_si512(soa + (size_t)k * m + i0 + j + half);
+          tw[k] = _mm512_loadu_si512(twp + (size_t)k * half + j);
+        }
+        mont52_mul8(t, v, tw, p, pinv);
+        add_lazy8(un, u, t, comp2p);
+        sub_lazy8(vn, u, t, p2, comp2p);
+        for (int k = 0; k < 5; ++k) {
+          _mm512_storeu_si512(soa + (size_t)k * m + i0 + j, un[k]);
+          _mm512_storeu_si512(soa + (size_t)k * m + i0 + j + half, vn[k]);
+        }
+      }
+    }
+  }
+  // unpack + full reduction to [0, r)
+  for (long i = 0; i < m; ++i) {
+    u64 t[5], o[4];
+    for (int k = 0; k < 5; ++k) t[k] = soa[(size_t)k * m + i];
+    limbs52_to_4(o, t);
+    while (geq(o, R_MOD)) sub_nored(o, o, R_MOD);
+    memcpy(data + 4 * i, o, 32);
+  }
+  delete[] soa;
+}
+
+// Vectorized batch-affine chunk apply over Fq (the MSM hot loop): given
+// the per-add arrays of one scheduled chunk (all Montgomery-256), run
+// the whole inversion-and-apply pipeline 8 lanes at a time:
+//   - lane-strided prefix products (lane l owns j ≡ l mod 8),
+//   - ONE scalar field inversion for the 8 lane totals,
+//   - vector suffix walk producing 1/den[j],
+//   - lambda / x3 / y3 evaluation, all 8-wide mont260 with the lazy
+//     [0,2p) domain, carriers converted 256<->260 at the edges.
+// x3a/y3a come back fully reduced (< p) so the caller's memcmp-based
+// bucket equality checks keep working.
+static void g1_chunk_apply_ifma(const u64 (*x1a)[4], const u64 (*y1a)[4],
+                                const u64 (*x2a)[4], const u64 (*y2a)[4],
+                                const unsigned char *dbl, long m,
+                                u64 (*x3a)[4], u64 (*y3a)[4]) {
+  Ifma52Field &F = fq52_field();
+  const long nblk = (m + 7) / 8, N = nblk * 8;
+  // SoA scratch: den,num,x1,y1,x2,y2,prod,x3,y3 = 9 arrays x 5 planes x N
+  u64 *buf = new u64[(size_t)9 * 5 * N];
+  u64 *d52 = buf, *n52 = buf + (size_t)5 * N, *x152 = buf + (size_t)10 * N,
+      *y152 = buf + (size_t)15 * N, *x252 = buf + (size_t)20 * N,
+      *y252 = buf + (size_t)25 * N, *pr52 = buf + (size_t)30 * N,
+      *x352 = buf + (size_t)35 * N, *y352 = buf + (size_t)40 * N;
+  u64 one52[5] = {1, 0, 0, 0, 0}, one260[5];
+  mont52_mul_scalar(one260, one52, F.r260sq, F);
+  auto pack_arr = [&](const u64 (*src)[4], u64 *dst, const u64 *pad) {
+    for (long j = 0; j < N; ++j) {
+      u64 t[5];
+      if (j < m) {
+        limbs4_to_52(t, src[j]);
+      } else {
+        memcpy(t, pad, 40);
+      }
+      for (int k = 0; k < 5; ++k) dst[(size_t)k * N + j] = t[k];
+    }
+  };
+  static const u64 Z5[5] = {0, 0, 0, 0, 0};
+  pack_arr(x1a, x152, Z5);
+  pack_arr(y1a, y152, Z5);
+  // x2/y2 pad with x1-ish zeros; den derives below and pads to the
+  // Montgomery-256 ONE so padded lanes are no-ops in the product chains
+  pack_arr(x2a, x252, Z5);
+  pack_arr(y2a, y252, Z5);
+
+  __m512i p[5], p2[5], comp2p[5], c264v[5], c256v[5];
+  for (int k = 0; k < 5; ++k) {
+    p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+    p2[k] = _mm512_set1_epi64((long long)F.p2_52[k]);
+    comp2p[k] = _mm512_set1_epi64((long long)F.comp2p[k]);
+    c264v[k] = _mm512_set1_epi64((long long)F.c264[k]);
+    c256v[k] = _mm512_set1_epi64((long long)F.c256[k]);
+  }
+  const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+  // carrier 256 -> 260 for the coordinate arrays, then derive num/den
+  // IN VECTOR FORM: chord lanes are (y2-y1, x2-x1); the rare doubling
+  // lanes (3x1^2, 2y1) blend in per-block only when flagged.
+  for (long t = 0; t < nblk; ++t) {
+    u64 *arrs[4] = {x152, y152, x252, y252};
+    __m512i conv[4][5];
+    for (int a = 0; a < 4; ++a) {
+      __m512i v[5];
+      for (int k = 0; k < 5; ++k)
+        v[k] = _mm512_loadu_si512(arrs[a] + (size_t)k * N + t * 8);
+      mont52_mul8(conv[a], v, c264v, p, pinv);
+      for (int k = 0; k < 5; ++k)
+        _mm512_storeu_si512(arrs[a] + (size_t)k * N + t * 8, conv[a][k]);
+    }
+    __m512i denv[5], numv[5];
+    sub_lazy8(denv, conv[2], conv[0], p2, comp2p);  // x2 - x1
+    sub_lazy8(numv, conv[3], conv[1], p2, comp2p);  // y2 - y1
+    unsigned char dm = 0;
+    for (int l = 0; l < 8 && t * 8 + l < m; ++l)
+      if (dbl[t * 8 + l]) dm |= (unsigned char)(1u << l);
+    if (dm) {
+      __m512i x1sq[5], numd[5], dend[5];
+      mont52_mul8(x1sq, conv[0], conv[0], p, pinv);
+      add_lazy8(numd, x1sq, x1sq, comp2p);
+      add_lazy8(numd, numd, x1sq, comp2p);           // 3 x1^2
+      add_lazy8(dend, conv[1], conv[1], comp2p);     // 2 y1
+      const __mmask8 k = (__mmask8)dm;
+      for (int q = 0; q < 5; ++q) {
+        denv[q] = _mm512_mask_blend_epi64(k, denv[q], dend[q]);
+        numv[q] = _mm512_mask_blend_epi64(k, numv[q], numd[q]);
+      }
+    }
+    // padded lanes: force den to the mont260 ONE (no-op in chains)
+    if (t == nblk - 1 && m < N) {
+      __mmask8 padk = (__mmask8)(0xFFu << (8 - (N - m)));
+      for (int q = 0; q < 5; ++q)
+        denv[q] = _mm512_mask_blend_epi64(
+            padk, denv[q], _mm512_set1_epi64((long long)one260[q]));
+    }
+    for (int k2 = 0; k2 < 5; ++k2) {
+      _mm512_storeu_si512(d52 + (size_t)k2 * N + t * 8, denv[k2]);
+      _mm512_storeu_si512(n52 + (size_t)k2 * N + t * 8, numv[k2]);
+    }
+  }
+  // phase A: lane-strided prefix products
+  __m512i run[5];
+  for (int k = 0; k < 5; ++k) run[k] = _mm512_set1_epi64((long long)one260[k]);
+  for (long t = 0; t < nblk; ++t) {
+    __m512i dv[5];
+    for (int k = 0; k < 5; ++k) {
+      _mm512_storeu_si512(pr52 + (size_t)k * N + t * 8, run[k]);
+      dv[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
+    }
+    mont52_mul8(run, run, dv, p, pinv);
+  }
+  // ONE inversion for the 8 lane totals (scalar mont256)
+  u64 tl8[5][8];
+  for (int k = 0; k < 5; ++k) _mm512_storeu_si512(tl8[k], run[k]);
+  u64 T4[8][4];
+  for (int l = 0; l < 8; ++l) {
+    u64 t52[5], t256[5];
+    for (int k = 0; k < 5; ++k) t52[k] = tl8[k][l];
+    mont52_mul_scalar(t256, t52, F.c256, F);  // carrier 260 -> 256
+    limbs52_to_4(T4[l], t256);
+    while (geq(T4[l], P)) sub_nored(T4[l], T4[l], P);
+  }
+  u64 pre[8][4], G[4], Ginv[4], suf[4], Tinv[8][4];
+  memcpy(pre[0], ONE_MONT, 32);
+  for (int l = 1; l < 8; ++l) mont_mul(pre[l], pre[l - 1], T4[l - 1]);
+  mont_mul(G, pre[7], T4[7]);
+  mont_inv(Ginv, G);
+  memcpy(suf, Ginv, 32);
+  for (int l = 7; l >= 0; --l) {
+    mont_mul(Tinv[l], suf, pre[l]);
+    mont_mul(suf, suf, T4[l]);
+  }
+  __m512i inv_run[5];
+  {
+    u64 ir8[5][8];
+    for (int l = 0; l < 8; ++l) {
+      u64 t52[5], t260[5];
+      limbs4_to_52(t52, Tinv[l]);
+      mont52_mul_scalar(t260, t52, F.c264, F);  // carrier 256 -> 260
+      for (int k = 0; k < 5; ++k) ir8[k][l] = t260[k];
+    }
+    for (int k = 0; k < 5; ++k) inv_run[k] = _mm512_loadu_si512(ir8[k]);
+  }
+  // phase B: backward suffix walk + apply
+  for (long t = nblk - 1; t >= 0; --t) {
+    __m512i prv[5], dv[5], nv[5], x1v[5], y1v[5], x2v[5];
+    for (int k = 0; k < 5; ++k) {
+      prv[k] = _mm512_loadu_si512(pr52 + (size_t)k * N + t * 8);
+      dv[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
+      nv[k] = _mm512_loadu_si512(n52 + (size_t)k * N + t * 8);
+      x1v[k] = _mm512_loadu_si512(x152 + (size_t)k * N + t * 8);
+      y1v[k] = _mm512_loadu_si512(y152 + (size_t)k * N + t * 8);
+      x2v[k] = _mm512_loadu_si512(x252 + (size_t)k * N + t * 8);
+    }
+    __m512i dinv[5], lam[5], lam2[5], x3[5], tt[5], yy[5], y3[5];
+    mont52_mul8(dinv, inv_run, prv, p, pinv);
+    mont52_mul8(inv_run, inv_run, dv, p, pinv);
+    mont52_mul8(lam, nv, dinv, p, pinv);
+    mont52_mul8(lam2, lam, lam, p, pinv);
+    sub_lazy8(x3, lam2, x1v, p2, comp2p);
+    sub_lazy8(x3, x3, x2v, p2, comp2p);
+    sub_lazy8(tt, x1v, x3, p2, comp2p);
+    mont52_mul8(yy, lam, tt, p, pinv);
+    sub_lazy8(y3, yy, y1v, p2, comp2p);
+    mont52_mul8(x3, x3, c256v, p, pinv);  // carrier back to 256
+    mont52_mul8(y3, y3, c256v, p, pinv);
+    for (int k = 0; k < 5; ++k) {
+      _mm512_storeu_si512(x352 + (size_t)k * N + t * 8, x3[k]);
+      _mm512_storeu_si512(y352 + (size_t)k * N + t * 8, y3[k]);
+    }
+  }
+  // unpack, fully reduced
+  for (long j = 0; j < m; ++j) {
+    u64 t[5], o[4];
+    for (int k = 0; k < 5; ++k) t[k] = x352[(size_t)k * N + j];
+    limbs52_to_4(o, t);
+    while (geq(o, P)) sub_nored(o, o, P);
+    memcpy(x3a[j], o, 32);
+    for (int k = 0; k < 5; ++k) t[k] = y352[(size_t)k * N + j];
+    limbs52_to_4(o, t);
+    while (geq(o, P)) sub_nored(o, o, P);
+    memcpy(y3a[j], o, 32);
+  }
+  delete[] buf;
+}
+
+#else
+#define ZKP2P_HAVE_IFMA 0
+static bool ifma_enabled() { return false; }
+#endif  // __AVX512IFMA__
+
 extern "C" {
 
 // Batch std <-> Montgomery over r.
@@ -785,10 +1325,9 @@ void fr_matvec(const u64 *coeff, const unsigned *wire, const unsigned *row,
 // w^-1); scale_std: standard-form factor applied to every output (1 for
 // forward, m^-1 for inverse).  Twiddles are a precomputed m/2 table so
 // each butterfly costs one fr_mul.
-void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
-  int log_m = 0;
-  while ((1L << log_m) < m) ++log_m;
-  // bit-reversal permutation (32-byte element swaps)
+// bit-reversal permutation (32-byte element swaps) — shared by the
+// scalar and IFMA NTT entry points so the permutation can never diverge.
+static void fr_bitrev(u64 *data, long m) {
   for (long i = 1, j = 0; i < m; ++i) {
     long bit = m >> 1;
     for (; j & bit; bit >>= 1) j ^= bit;
@@ -800,6 +1339,22 @@ void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
       memcpy(data + 4 * j, tmp, 32);
     }
   }
+}
+
+// scale_std != 1 epilogue — shared for the same reason.
+static void fr_apply_scale(u64 *data, long m, const u64 *scale_std) {
+  static const u64 ONE_STD[4] = {1, 0, 0, 0};
+  if (memcmp(scale_std, ONE_STD, 32) != 0) {
+    u64 scale_m[4];
+    fr_mul(scale_m, scale_std, R2R);
+    for (long i = 0; i < m; ++i) fr_mul(data + 4 * i, data + 4 * i, scale_m);
+  }
+}
+
+void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
+  int log_m = 0;
+  while ((1L << log_m) < m) ++log_m;
+  fr_bitrev(data, m);
   u64 root_m[4];
   fr_mul(root_m, root_std, R2R);
   long half_m = m / 2;
@@ -853,12 +1408,104 @@ void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
       }
     }
   }
-  static const u64 ONE_STD[4] = {1, 0, 0, 0};
-  if (memcmp(scale_std, ONE_STD, 32) != 0) {
-    u64 scale_m[4];
-    fr_mul(scale_m, scale_std, R2R);
-    for (long i = 0; i < m; ++i) fr_mul(data + 4 * i, data + 4 * i, scale_m);
+  fr_apply_scale(data, m, scale_std);
+}
+
+// 1 when the AVX-512 IFMA fast paths are compiled in, the CPU has the
+// instructions, and ZKP2P_NATIVE_IFMA != 0.
+int zkp2p_ifma_available(void) { return ifma_enabled() ? 1 : 0; }
+
+// Differential-test hook for the 8-wide kernel: c[i] = a[i]*b[i] mod r,
+// standard form in/out, driven through pack -> mont260 vector multiply
+// -> unpack (the exact pipeline the NTT stages use).  Falls back to the
+// scalar path when IFMA is unavailable so tests can always call it.
+void fr52_mul_std_batch(const u64 *a, const u64 *b, u64 *c, long n) {
+#if ZKP2P_HAVE_IFMA
+  if (ifma_enabled()) {
+    Ifma52Field &F = fr52_field();
+    __m512i p[5];
+    for (int k = 0; k < 5; ++k) p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+    const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+    // r260sq lanes: one mont260 mul maps std a -> a·2^260 (mont260)
+    __m512i rsq[5];
+    for (int k = 0; k < 5; ++k) rsq[k] = _mm512_set1_epi64((long long)F.r260sq[k]);
+    long i = 0;
+    for (; i + 8 <= n; i += 8) {
+      u64 av[5][8], bv[5][8];
+      for (int l = 0; l < 8; ++l) {
+        u64 t[5];
+        limbs4_to_52(t, a + 4 * (i + l));
+        for (int k = 0; k < 5; ++k) av[k][l] = t[k];
+        limbs4_to_52(t, b + 4 * (i + l));
+        for (int k = 0; k < 5; ++k) bv[k][l] = t[k];
+      }
+      __m512i A[5], B[5], Bm[5], C[5];
+      for (int k = 0; k < 5; ++k) {
+        A[k] = _mm512_loadu_si512(av[k]);
+        B[k] = _mm512_loadu_si512(bv[k]);
+      }
+      mont52_mul8(Bm, B, rsq, p, pinv);  // b_std -> b·2^260
+      mont52_mul8(C, A, Bm, p, pinv);    // (a_std)(b·2^260)·2^-260 = ab std
+      u64 cv[5][8];
+      for (int k = 0; k < 5; ++k) _mm512_storeu_si512(cv[k], C[k]);
+      for (int l = 0; l < 8; ++l) {
+        u64 t[5], o[4];
+        for (int k = 0; k < 5; ++k) t[k] = cv[k][l];
+        limbs52_to_4(o, t);
+        while (geq(o, R_MOD)) sub_nored(o, o, R_MOD);
+        memcpy(c + 4 * (i + l), o, 32);
+      }
+    }
+    for (; i < n; ++i) fr_mul_std(a + 4 * i, b + 4 * i, c + 4 * i);
+    return;
   }
+#endif
+  for (long i = 0; i < n; ++i) fr_mul_std(a + 4 * i, b + 4 * i, c + 4 * i);
+}
+
+// Drop-in fr_ntt with the len>=16 stages vectorized 8-wide (IFMA).
+// Identical contract: data Montgomery, natural order in/out, root_std /
+// scale_std standard form.
+void fr_ntt_ifma(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
+#if ZKP2P_HAVE_IFMA
+  if (ifma_enabled() && m >= 64) {
+    fr_bitrev(data, m);
+    // scalar stages len = 2, 4, 8 (15% of the work; small-j twiddles
+    // computed directly: wlen = root^(m/len) via mont squarings)
+    u64 root_m[4];
+    fr_mul(root_m, root_std, R2R);
+    for (long len = 2; len <= 8 && len <= m; len <<= 1) {
+      u64 wlen[4];
+      memcpy(wlen, root_m, 32);
+      for (long s = m / len; s > 1; s >>= 1) fr_mul(wlen, wlen, wlen);
+      long half = len >> 1;
+      for (long i0 = 0; i0 < m; i0 += len) {
+        u64 tw[4];
+        memcpy(tw, ONE_R, 32);
+        for (long j = 0; j < half; ++j) {
+          u64 *u = data + 4 * (i0 + j);
+          u64 *v = data + 4 * (i0 + j + half);
+          u64 t[4];
+          if (j == 0) {
+            memcpy(t, v, 32);
+          } else {
+            fr_mul(t, v, tw);
+          }
+          u64 usave[4];
+          memcpy(usave, u, 32);
+          fr_add(u, usave, t);
+          fr_sub(v, usave, t);
+          if (j + 1 < half) fr_mul(tw, tw, wlen);
+        }
+      }
+    }
+    // vector stages len >= 16
+    fr_ntt_ifma_stages(data, m, root_std);
+    fr_apply_scale(data, m, scale_std);
+    return;
+  }
+#endif
+  fr_ntt(data, m, root_std, scale_std);
 }
 
 // The H-polynomial coset ladder (prove_tpu's h_evals, native):
@@ -892,10 +1539,10 @@ void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
   for (long j = 1; j < m; ++j) fr_mul(gpow + 4 * j, gpow + 4 * (j - 1), gm);
   u64 *vecs[3] = {a, b, c};
   auto ladder_one = [&](u64 *v) {
-    fr_ntt(v, m, winv_std, ONE_STD);  // unscaled iNTT: evals -> m·coeffs
+    fr_ntt_ifma(v, m, winv_std, ONE_STD);  // unscaled iNTT: evals -> m·coeffs
     // coset shift + deferred 1/m scale in one pass: v[j] *= (1/m)·g^j
     for (long j = 0; j < m; ++j) fr_mul(v + 4 * j, v + 4 * j, gpow + 4 * j);
-    fr_ntt(v, m, w_std, ONE_STD);  // forward: coefficients -> coset evals
+    fr_ntt_ifma(v, m, w_std, ONE_STD);  // forward: coefficients -> coset evals
   };
   // The three polynomial ladders are independent: thread them when the
   // host has cores to spare (same env-driven knob as the MSM pool).
@@ -1097,6 +1744,17 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
   u64 (*den)[4] = new u64[B][4];
   u64 (*num)[4] = new u64[B][4];   // lambda numerator
   u64 (*prod)[4] = new u64[B][4];  // batch-inverse prefix products
+  // coordinate stashes (bucket state at schedule time + incoming point);
+  // num/den derive from these AFTER scheduling — vectorized when IFMA
+  // is up, per-j in the scalar fallback — so the schedule loop itself
+  // does no field ops at all
+  u64 (*x1a)[4] = new u64[B][4];
+  u64 (*y1a)[4] = new u64[B][4];
+  u64 (*x2a)[4] = new u64[B][4];
+  u64 (*y2a)[4] = new u64[B][4];
+  u64 (*x3a)[4] = new u64[B][4];
+  u64 (*y3a)[4] = new u64[B][4];
+  unsigned char *dbl = new unsigned char[B];
 
   int chunk_id = 0;
   while (!cur.empty()) {
@@ -1125,22 +1783,19 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
         }
         if (memcmp(bk[b].x, px, 32) == 0) {
           if (memcmp(bk[b].y, py, 32) == 0) {
-            // doubling: lambda = 3x^2 / 2y
-            u64 x2[4], t[4];
-            mont_sqr(x2, px);
-            add_mod(t, x2, x2);
-            add_mod(num[m], t, x2);
-            add_mod(den[m], py, py);
+            dbl[m] = 1;  // doubling: lambda = 3x^2 / 2y (derived later)
           } else {
             // p + (-p): bucket becomes empty
             memset(&bk[b], 0, sizeof(AffPt));
             continue;
           }
         } else {
-          // chord: lambda = (y2 - y1) / (x2 - x1), 1 = bucket, 2 = point
-          sub_mod(num[m], py, bk[b].y);
-          sub_mod(den[m], px, bk[b].x);
+          dbl[m] = 0;  // chord: lambda = (y2 - y1) / (x2 - x1)
         }
+        memcpy(x1a[m], bk[b].x, 32);
+        memcpy(y1a[m], bk[b].y, 32);
+        memcpy(x2a[m], px, 32);
+        memcpy(y2a[m], py, 32);
         add_bkt[m] = b;
         add_pt[m] = i;
         ++m;
@@ -1151,32 +1806,55 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
         if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
         continue;
       }
-      // batch inversion of den[0..m): prefix products + one inversion
-      u64 run[4];
-      memcpy(run, ONE_MONT, 32);
-      for (long j = 0; j < m; ++j) {
-        memcpy(prod[j], run, 32);  // product of dens before j
-        mont_mul(run, run, den[j]);
-      }
-      u64 inv_all[4];
-      mont_inv(inv_all, run);
-      for (long j = m - 1; j >= 0; --j) {
-        u64 dinv[4];
-        mont_mul(dinv, inv_all, prod[j]);      // 1/den[j]
-        mont_mul(inv_all, inv_all, den[j]);    // strip den[j]
-        long b = add_bkt[j];
-        const u64 *px = bases_xy + 8 * add_pt[j];
-        u64 lam[4], lam2[4], x3[4], y3[4], t[4];
-        mont_mul(lam, num[j], dinv);
-        mont_sqr(lam2, lam);
-        // x3 = lam^2 - x1 - x2 ; y3 = lam (x1 - x3) - y1
-        sub_mod(x3, lam2, bk[b].x);
-        sub_mod(x3, x3, px);
-        sub_mod(t, bk[b].x, x3);
-        mont_mul(t, lam, t);
-        sub_mod(y3, t, bk[b].y);
-        memcpy(bk[b].x, x3, 32);
-        memcpy(bk[b].y, y3, 32);
+#if ZKP2P_HAVE_IFMA
+      if (ifma_enabled() && m >= 48) {
+        // 8-lane inversion + apply, one scalar inversion per chunk
+        g1_chunk_apply_ifma(x1a, y1a, x2a, y2a, dbl, m, x3a, y3a);
+        for (long j = 0; j < m; ++j) {
+          memcpy(bk[add_bkt[j]].x, x3a[j], 32);
+          memcpy(bk[add_bkt[j]].y, y3a[j], 32);
+        }
+      } else
+#endif
+      {
+        // batch inversion of den[0..m): prefix products + one inversion
+        // (num/den derived here from the schedule stashes)
+        u64 run[4];
+        memcpy(run, ONE_MONT, 32);
+        for (long j = 0; j < m; ++j) {
+          if (dbl[j]) {
+            u64 xsq[4], t[4];
+            mont_sqr(xsq, x1a[j]);
+            add_mod(t, xsq, xsq);
+            add_mod(num[j], t, xsq);
+            add_mod(den[j], y1a[j], y1a[j]);
+          } else {
+            sub_mod(num[j], y2a[j], y1a[j]);
+            sub_mod(den[j], x2a[j], x1a[j]);
+          }
+          memcpy(prod[j], run, 32);  // product of dens before j
+          mont_mul(run, run, den[j]);
+        }
+        u64 inv_all[4];
+        mont_inv(inv_all, run);
+        for (long j = m - 1; j >= 0; --j) {
+          u64 dinv[4];
+          mont_mul(dinv, inv_all, prod[j]);      // 1/den[j]
+          mont_mul(inv_all, inv_all, den[j]);    // strip den[j]
+          long b = add_bkt[j];
+          const u64 *px = bases_xy + 8 * add_pt[j];
+          u64 lam[4], lam2[4], x3[4], y3[4], t[4];
+          mont_mul(lam, num[j], dinv);
+          mont_sqr(lam2, lam);
+          // x3 = lam^2 - x1 - x2 ; y3 = lam (x1 - x3) - y1
+          sub_mod(x3, lam2, bk[b].x);
+          sub_mod(x3, x3, px);
+          sub_mod(t, bk[b].x, x3);
+          mont_mul(t, lam, t);
+          sub_mod(y3, t, bk[b].y);
+          memcpy(bk[b].x, x3, 32);
+          memcpy(bk[b].y, y3, 32);
+        }
       }
       // Concentrated digits (witness scalars are mostly bits: window 0
       // sees thousands of digit-1 points) defer most of every chunk —
@@ -1215,6 +1893,13 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
       delete[] den;
       delete[] num;
       delete[] prod;
+      delete[] x1a;
+      delete[] y1a;
+      delete[] x2a;
+      delete[] y2a;
+      delete[] x3a;
+      delete[] y3a;
+      delete[] dbl;
       *out = wsum;
       return;
     }
@@ -1236,6 +1921,13 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
   delete[] den;
   delete[] num;
   delete[] prod;
+  delete[] x1a;
+  delete[] y1a;
+  delete[] x2a;
+  delete[] y2a;
+  delete[] x3a;
+  delete[] y3a;
+  delete[] dbl;
   *out = wsum;
 }
 

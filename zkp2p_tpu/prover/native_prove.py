@@ -145,6 +145,13 @@ def _pick_window(n: int) -> int:
     purely from doubled batch-affine conflicts; the raised clamp lets
     the big domains reach c=17 while the bench shape keeps its
     measured-best c=15 (signed sweep at 2^19: c=15 6.3s, c=16 7.6s)."""
+    if _lib() is not None and _lib().zkp2p_ifma_available():
+        # IFMA regime: the vectorized batch-affine fill costs ~3x less
+        # per add than the scalar one, so the fill/reduction optimum
+        # shifts to a smaller window (reduction cost scales with 2^c,
+        # fill with ceil(254/c); measured sweep at n=2^19: c=14 beats
+        # c=17 once the fill is 8-wide).
+        return max(4, min(14, n.bit_length() - 5))
     return max(4, min(17, n.bit_length() - 5))
 
 
