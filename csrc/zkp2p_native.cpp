@@ -1107,14 +1107,15 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
 //     [0,2p) domain, carriers converted 256<->260 at the edges.
 // x3a/y3a come back fully reduced (< p) so the caller's memcmp-based
 // bucket equality checks keep working.
+// Caller-provided SoA scratch: 9 arrays x 5 planes x (chunk cap rounded
+// to 8) u64 — hoisted out of the per-chunk hot loop by g1_window_sum.
 static void g1_chunk_apply_ifma(const u64 (*x1a)[4], const u64 (*y1a)[4],
                                 const u64 (*x2a)[4], const u64 (*y2a)[4],
                                 const unsigned char *dbl, long m,
-                                u64 (*x3a)[4], u64 (*y3a)[4]) {
+                                u64 (*x3a)[4], u64 (*y3a)[4], u64 *buf) {
   Ifma52Field &F = fq52_field();
   const long nblk = (m + 7) / 8, N = nblk * 8;
-  // SoA scratch: den,num,x1,y1,x2,y2,prod,x3,y3 = 9 arrays x 5 planes x N
-  u64 *buf = new u64[(size_t)9 * 5 * N];
+  // SoA scratch layout: den,num,x1,y1,x2,y2,prod,x3,y3
   u64 *d52 = buf, *n52 = buf + (size_t)5 * N, *x152 = buf + (size_t)10 * N,
       *y152 = buf + (size_t)15 * N, *x252 = buf + (size_t)20 * N,
       *y252 = buf + (size_t)25 * N, *pr52 = buf + (size_t)30 * N,
@@ -1276,7 +1277,6 @@ static void g1_chunk_apply_ifma(const u64 (*x1a)[4], const u64 (*y1a)[4],
     while (geq(o, P)) sub_nored(o, o, P);
     memcpy(y3a[j], o, 32);
   }
-  delete[] buf;
 }
 
 #else
@@ -1755,6 +1755,10 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
   u64 (*x3a)[4] = new u64[B][4];
   u64 (*y3a)[4] = new u64[B][4];
   unsigned char *dbl = new unsigned char[B];
+#if ZKP2P_HAVE_IFMA
+  // chunk-apply SoA scratch, hoisted out of the per-chunk loop
+  u64 *ifma_scratch = new u64[(size_t)9 * 5 * ((B + 7) / 8 * 8)];
+#endif
 
   int chunk_id = 0;
   while (!cur.empty()) {
@@ -1809,7 +1813,7 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
 #if ZKP2P_HAVE_IFMA
       if (ifma_enabled() && m >= 48) {
         // 8-lane inversion + apply, one scalar inversion per chunk
-        g1_chunk_apply_ifma(x1a, y1a, x2a, y2a, dbl, m, x3a, y3a);
+        g1_chunk_apply_ifma(x1a, y1a, x2a, y2a, dbl, m, x3a, y3a, ifma_scratch);
         for (long j = 0; j < m; ++j) {
           memcpy(bk[add_bkt[j]].x, x3a[j], 32);
           memcpy(bk[add_bkt[j]].y, y3a[j], 32);
@@ -1900,6 +1904,9 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
       delete[] x3a;
       delete[] y3a;
       delete[] dbl;
+#if ZKP2P_HAVE_IFMA
+      delete[] ifma_scratch;
+#endif
       *out = wsum;
       return;
     }
@@ -1928,6 +1935,9 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
   delete[] x3a;
   delete[] y3a;
   delete[] dbl;
+#if ZKP2P_HAVE_IFMA
+  delete[] ifma_scratch;
+#endif
   *out = wsum;
 }
 
@@ -2032,6 +2042,102 @@ void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
 void g1_msm_pippenger(const u64 *bases_xy, const u64 *scalars, long n,
                       int c, u64 *out_xy) {
   g1_msm_pippenger_mt(bases_xy, scalars, n, c, 1, out_xy);
+}
+
+// Scale n affine STANDARD-form G1 points by ONE shared standard-form Fr
+// scalar: out[i] = k * P[i].  The phase-2 ceremony hot loop (every
+// contribution rescales the whole C and H query by 1/delta') — NAF of
+// the shared scalar computed once, Jacobian double-add per point, one
+// batched inversion for the final affine normalization.  (0,0) holes
+// pass through.
+void g1_scale_batch(const u64 *bases_xy, long n, const u64 *scalar, u64 *out_xy) {
+  // width-2 NAF (digits -1/0/1), LSB first
+  int naf[260];
+  int nbits = 0;
+  {
+    u64 s[5] = {scalar[0], scalar[1], scalar[2], scalar[3], 0};
+    auto is_zero = [&]() {
+      for (int i = 0; i < 5; ++i)
+        if (s[i]) return false;
+      return true;
+    };
+    auto shr1 = [&]() {
+      for (int i = 0; i < 4; ++i) s[i] = (s[i] >> 1) | (s[i + 1] << 63);
+      s[4] >>= 1;
+    };
+    while (!is_zero() && nbits < 260) {
+      if (s[0] & 1) {
+        if ((s[0] & 3) == 3) {
+          naf[nbits] = -1;  // d = -1, s += 1
+          u128 c = 1;
+          for (int i = 0; i < 5 && c; ++i) {
+            u128 t = (u128)s[i] + c;
+            s[i] = (u64)t;
+            c = t >> 64;
+          }
+        } else {
+          naf[nbits] = 1;  // d = 1, s -= 1
+          s[0] -= 1;
+        }
+      } else {
+        naf[nbits] = 0;
+      }
+      shr1();
+      ++nbits;
+    }
+  }
+  G1Jac *accs = new G1Jac[n > 0 ? n : 1];
+  for (long i = 0; i < n; ++i) {
+    const u64 *bx = bases_xy + 8 * i;
+    const u64 *by = bx + 4;
+    if (is_zero4(bx) && is_zero4(by)) {
+      memset(&accs[i], 0, sizeof(G1Jac));
+      continue;
+    }
+    u64 mx[4], my[4], nmy[4];
+    mont_mul(mx, bx, R2P);
+    mont_mul(my, by, R2P);
+    sub_nored(nmy, P, my);
+    G1Jac acc;
+    memset(&acc, 0, sizeof(acc));
+    for (int b = nbits - 1; b >= 0; --b) {
+      jac_double(acc, acc);
+      if (naf[b] == 1) {
+        jac_add_mixed(acc, acc, mx, my);
+      } else if (naf[b] == -1) {
+        jac_add_mixed(acc, acc, mx, nmy);
+      }
+    }
+    accs[i] = acc;
+  }
+  // batched affine normalization: one inversion for all nonzero Zs
+  u64 *pref = new u64[(size_t)(n > 0 ? n : 1) * 4];
+  u64 run[4];
+  memcpy(run, ONE_MONT, 32);
+  for (long i = 0; i < n; ++i) {
+    memcpy(pref + 4 * i, run, 32);
+    if (!is_zero4(accs[i].Z)) mont_mul(run, run, accs[i].Z);
+  }
+  u64 inv_all[4];
+  mont_inv(inv_all, run);
+  for (long i = n - 1; i >= 0; --i) {
+    u64 *o = out_xy + 8 * i;
+    if (is_zero4(accs[i].Z)) {
+      memset(o, 0, 64);
+      continue;
+    }
+    u64 zi[4], zi2[4], zi3[4], mx[4], my[4];
+    mont_mul(zi, inv_all, pref + 4 * i);
+    mont_mul(inv_all, inv_all, accs[i].Z);
+    mont_sqr(zi2, zi);
+    mont_mul(zi3, zi2, zi);
+    mont_mul(mx, accs[i].X, zi2);
+    mont_mul(my, accs[i].Y, zi3);
+    fp_from_mont(mx, o, 1);
+    fp_from_mont(my, o + 4, 1);
+  }
+  delete[] pref;
+  delete[] accs;
 }
 
 // Variable-base Pippenger MSM over G2.  bases: n x 16 u64 affine

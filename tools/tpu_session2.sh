@@ -43,4 +43,7 @@ phase bench3 1800 env BENCH_TPU_BUDGET=1700 python -u bench.py
 phase msm_w8 900 python -u tools/msm_hwbench.py --n 131072 --window 8 --signed --skip-adds
 # single-proof latency (batch=1): the north-star p50 metric
 phase bench_lat 1200 env BENCH_TPU_BUDGET=1100 BENCH_BATCH=1 python -u bench.py
+# batch sweep 32/64 (BASELINE.json configs[3]): amortization curve
+phase bench_b32 1200 env BENCH_TPU_BUDGET=1100 BENCH_BATCH=32 python -u bench.py
+phase bench_b64 1500 env BENCH_TPU_BUDGET=1400 BENCH_BATCH=64 python -u bench.py
 echo "== session2 done $(date +%H:%M:%S)" >> "$OUT/session.log"

@@ -108,6 +108,90 @@ def _g2_parse(b: bytes) -> G2Point:
 
 
 @dataclass
+class Contribution:
+    """One phase-2 MPC contribution record (section 10), the shape
+    snarkjs `zkey contribute`/`beacon` appends
+    (`dizkus-scripts/3_gen_both_zkeys.sh:18-65`): the post-contribution
+    delta, a BGM17 proof-of-knowledge of the applied delta', and the
+    running transcript hash.  kind 0 = interactive, 1 = beacon (beacon
+    params stored so verifiers can re-derive delta' deterministically)."""
+
+    delta_after: G1Point
+    pok_g1_s: G1Point
+    pok_g1_sx: G1Point
+    pok_g2_spx: G2Point
+    transcript: bytes  # 64
+    kind: int = 0
+    name: str = ""
+    beacon_hash: bytes = b""
+    beacon_iter_exp: int = 0
+
+
+@dataclass
+class MpcParams:
+    cs_hash: bytes  # 64-byte circuit digest
+    contributions: List[Contribution]
+
+
+def _mpc_to_bytes(mpc: MpcParams) -> bytes:
+    out = bytearray()
+    out += mpc.cs_hash.ljust(64, b"\x00")[:64]
+    out += struct.pack("<I", len(mpc.contributions))
+    for c in mpc.contributions:
+        out += _g1_bytes(c.delta_after) + _g1_bytes(c.pok_g1_s) + _g1_bytes(c.pok_g1_sx)
+        out += _g2_bytes(c.pok_g2_spx)
+        out += c.transcript.ljust(64, b"\x00")[:64]
+        name_b = c.name.encode()
+        out += struct.pack("<II", c.kind, len(name_b)) + name_b
+        if c.kind == 1:
+            out += c.beacon_hash.ljust(64, b"\x00")[:64] + struct.pack("<I", c.beacon_iter_exp)
+    return bytes(out)
+
+
+def _mpc_from_bytes(buf: bytes) -> Optional[MpcParams]:
+    """Parse OUR section-10 layout.  snarkjs's own record encoding
+    differs (TLV-parameterized); a zkey produced by stock snarkjs with
+    contributions will not match — in that case return None so the key
+    still imports (contribution records become opaque, exactly the
+    pre-ceremony behavior), rather than desyncing into garbage."""
+    try:
+        if len(buf) < 68:
+            return MpcParams(cs_hash=buf.ljust(64, b"\x00")[:64], contributions=[])
+        cs_hash = buf[:64]
+        (n,) = struct.unpack_from("<I", buf, 64)
+        if n > 10_000:  # sanity: no real ceremony has this many rounds
+            return None
+        o = 68
+        contribs = []
+        for _ in range(n):
+            if o + 384 + 8 > len(buf):
+                return None
+            delta_after = _g1_parse(buf[o : o + 64]); o += 64
+            g1_s = _g1_parse(buf[o : o + 64]); o += 64
+            g1_sx = _g1_parse(buf[o : o + 64]); o += 64
+            g2_spx = _g2_parse(buf[o : o + 128]); o += 128
+            transcript = buf[o : o + 64]; o += 64
+            kind, name_len = struct.unpack_from("<II", buf, o); o += 8
+            if kind not in (0, 1) or o + name_len > len(buf):
+                return None
+            name = buf[o : o + name_len].decode(); o += name_len
+            beacon_hash, beacon_iter = b"", 0
+            if kind == 1:
+                if o + 68 > len(buf):
+                    return None
+                beacon_hash = buf[o : o + 64]; o += 64
+                (beacon_iter,) = struct.unpack_from("<I", buf, o); o += 4
+            contribs.append(
+                Contribution(delta_after, g1_s, g1_sx, g2_spx, transcript, kind, name, beacon_hash, beacon_iter)
+            )
+        if o != len(buf):
+            return None  # trailing bytes: not our layout
+        return MpcParams(cs_hash=cs_hash, contributions=contribs)
+    except Exception:  # noqa: BLE001 — foreign/corrupt section -> opaque
+        return None
+
+
+@dataclass
 class ZkeyData:
     n_vars: int
     n_public: int
@@ -126,6 +210,7 @@ class ZkeyData:
     b2_query: List[G2Point]
     c_query: List[Optional[G1Point]]  # None for wires 0..n_public
     h_query: List[G1Point]
+    mpc: Optional[MpcParams] = None
 
     def to_proving_key(self) -> ProvingKey:
         return ProvingKey(
@@ -166,6 +251,37 @@ class ZkeyData:
 
 
 # ------------------------------------------------------------------ write
+
+
+def write_zkey_data(path: str, z: ZkeyData) -> None:
+    """Serialize a ZkeyData verbatim (coeff order preserved) — the path
+    the ceremony ops use so a contributed key round-trips exactly."""
+    sections: List[Tuple[int, bytes]] = []
+    sections.append((1, struct.pack("<I", 1)))
+    hdr = struct.pack("<I", N8) + P.to_bytes(N8, "little")
+    hdr += struct.pack("<I", N8) + R.to_bytes(N8, "little")
+    hdr += struct.pack("<III", z.n_vars, z.n_public, z.domain_size)
+    hdr += _g1_bytes(z.alpha_1) + _g1_bytes(z.beta_1) + _g2_bytes(z.beta_2)
+    hdr += _g2_bytes(z.gamma_2) + _g1_bytes(z.delta_1) + _g2_bytes(z.delta_2)
+    sections.append((2, hdr))
+    sections.append((3, b"".join(_g1_bytes(p) for p in z.ic)))
+    coeffs = bytearray()
+    for m, row, wire, value in z.coeffs:
+        coeffs += struct.pack("<III", m, row, wire) + _fr_to_m(value)
+    sections.append((4, struct.pack("<I", len(z.coeffs)) + bytes(coeffs)))
+    sections.append((5, b"".join(_g1_bytes(p) for p in z.a_query)))
+    sections.append((6, b"".join(_g1_bytes(p) for p in z.b1_query)))
+    sections.append((7, b"".join(_g2_bytes(p) for p in z.b2_query)))
+    sections.append((8, b"".join(_g1_bytes(p) for p in z.c_query[z.n_public + 1 :])))
+    sections.append((9, b"".join(_g1_bytes(p) for p in z.h_query)))
+    mpc = z.mpc or MpcParams(cs_hash=b"\x00" * 64, contributions=[])
+    sections.append((10, _mpc_to_bytes(mpc)))
+    with open(path, "wb") as f:
+        f.write(ZKEY_MAGIC)
+        f.write(struct.pack("<II", 1, len(sections)))
+        for stype, payload in sections:
+            f.write(struct.pack("<IQ", stype, len(payload)))
+            f.write(payload)
 
 
 def write_zkey(path: str, pk: ProvingKey, vk: VerifyingKey, qap_rows) -> None:
@@ -303,6 +419,7 @@ def read_zkey(path_or_chunks) -> ZkeyData:
     c_priv = [_g1_parse(sections[8][i * 64 : (i + 1) * 64]) for i in range(n_priv)]
     c_query: List[Optional[G1Point]] = [None] * (n_public + 1) + c_priv
     h_query = [_g1_parse(sections[9][i * 64 : (i + 1) * 64]) for i in range(domain_size)]
+    mpc = _mpc_from_bytes(sections[10]) if 10 in sections else None
 
     return ZkeyData(
         n_vars=n_vars,
@@ -321,4 +438,5 @@ def read_zkey(path_or_chunks) -> ZkeyData:
         b2_query=b2_query,
         c_query=c_query,
         h_query=h_query,
+        mpc=mpc,
     )

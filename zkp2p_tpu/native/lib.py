@@ -105,6 +105,64 @@ def g1_fixed_base_batch(base: Tuple[int, int], scalars: Sequence[int]) -> Option
     return res
 
 
+def _pack_affine(points: Sequence) -> np.ndarray:
+    """Affine (x, y) int tuples (None = infinity -> all-zero hole) to the
+    (n, 8) u64 layout every g1 native entry point consumes — ONE shared
+    encoder so the infinity convention cannot drift between callers."""
+    n = len(points)
+    bases = np.zeros((n, 8), dtype=np.uint64)
+    for i, p in enumerate(points):
+        if p is None:
+            continue
+        bases[i, :4] = _int_to_u64x4(p[0])
+        bases[i, 4:] = _int_to_u64x4(p[1])
+    return bases
+
+
+def g1_scale_batch(points: Sequence, scalar: int) -> Optional[List]:
+    """out[i] = scalar * points[i] over G1 (shared scalar — the ceremony
+    delta-rescale); None if the native lib is unavailable.  Points are
+    affine (x, y) int tuples with None = infinity, same out."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(points)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.g1_scale_batch.argtypes = [u64p, ctypes.c_long, u64p, u64p]
+    bases = _pack_affine(points)
+    sc = _int_to_u64x4(int(scalar))
+    out = np.zeros((n, 8), dtype=np.uint64)
+    lib.g1_scale_batch(bases.ctypes.data_as(u64p), n, sc.ctypes.data_as(u64p), out.ctypes.data_as(u64p))
+    res = []
+    for i in range(n):
+        x = _u64x4_to_int(out[i, :4])
+        y = _u64x4_to_int(out[i, 4:])
+        res.append(None if x == 0 and y == 0 else (x, y))
+    return res
+
+
+def g1_msm(points: Sequence, scalars: Sequence[int]) -> Optional[object]:
+    """Native variable-base MSM, std-form affine tuples in/out ("sentinel
+    False" when the lib is unavailable so callers can distinguish the
+    infinity result None from no-lib)."""
+    lib = get_lib()
+    if lib is None or not points:
+        return False if lib is None else None
+    n = len(points)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.fp_to_mont.argtypes = [u64p, u64p, ctypes.c_int]
+    lib.g1_msm_pippenger.argtypes = [u64p, u64p, ctypes.c_long, ctypes.c_int, u64p]
+    bases = _pack_affine(points)
+    bm = np.zeros_like(bases)
+    lib.fp_to_mont(bases.ctypes.data_as(u64p), bm.ctypes.data_as(u64p), 2 * n)
+    sc = _scalars_to_u64([int(s) for s in scalars])
+    out = np.zeros(8, dtype=np.uint64)
+    c = max(4, min(16, n.bit_length() - 5))
+    lib.g1_msm_pippenger(bm.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, c, out.ctypes.data_as(u64p))
+    x, y = _u64x4_to_int(out[:4]), _u64x4_to_int(out[4:])
+    return None if x == 0 and y == 0 else (x, y)
+
+
 def _scalars_to_u64(scalars: Sequence[int]) -> np.ndarray:
     """(n, 4) u64 little-endian — via one bytes join, not a Python limb
     loop (to_bytes is C-speed; this path handles millions of scalars)."""

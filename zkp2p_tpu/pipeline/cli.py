@@ -104,6 +104,12 @@ def cmd_setup(args):
     _log(f"setup done in {time.time()-t0:.0f}s -> {args.build_dir}/")
 
 
+def _infer_widths(args) -> bool:
+    """zkey width inference is on unless --no-infer-widths was passed
+    (one knob, consumed by every subcommand that imports a zkey)."""
+    return not getattr(args, "no_infer_widths", False)
+
+
 def _load_zkey(args):
     """The key material always travels as a snarkjs-format .zkey (never
     pickle): --zkey overrides (monolithic path or glob of b..k chunks),
@@ -230,7 +236,7 @@ def cmd_prove(args):
         w = read_wtns(args.wtns)
         if len(w) != zk.n_vars:
             raise SystemExit(f"witness has {len(w)} wires, zkey expects {zk.n_vars}")
-        dpk = device_pk_from_zkey(zk)
+        dpk = device_pk_from_zkey(zk, infer_widths=_infer_widths(args))
         pub = w[1 : zk.n_public + 1]
         t0 = time.time()
         proof = prove_fn(dpk, w)
@@ -243,7 +249,7 @@ def cmd_prove(args):
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
     zk = _load_zkey(args)
     _check_zkey_matches(zk, cs)
-    dpk = device_pk_from_zkey(zk)
+    dpk = device_pk_from_zkey(zk, infer_widths=_infer_widths(args))
     w, pub = _witness_for(args, cs, meta)
     t0 = time.time()
     proof = prove_fn(dpk, w)
@@ -265,6 +271,28 @@ def cmd_verify(args):
     sys.exit(0 if ok else 1)
 
 
+def cmd_ceremony(args):
+    """Phase-2 MPC ops over zkeys (`dizkus-scripts/3_gen_both_zkeys.sh`)."""
+    from ..snark import ceremony
+
+    if args.op == "contribute":
+        ceremony.contribute(args.zkey_in, args.zkey_out, args.entropy.encode(), name=args.name)
+        print(f"contributed -> {args.zkey_out}")
+    elif args.op == "beacon":
+        if not args.beacon_hash:
+            print("beacon requires --beacon-hash", file=sys.stderr)
+            sys.exit(2)
+        ceremony.beacon(args.zkey_in, args.zkey_out, bytes.fromhex(args.beacon_hash),
+                        iter_exp=args.iter_exp, name=args.name or "final beacon")
+        print(f"beacon applied -> {args.zkey_out}")
+    else:
+        ok, log = ceremony.verify_chain(args.zkey_in, args.zkey_out)
+        for line in log:
+            print(line)
+        print("ZKEY OK" if ok else "ZKEY INVALID")
+        sys.exit(0 if ok else 1)
+
+
 def cmd_batch(args):
     """Prove every input in a directory as one vmapped batch —
     circuit-generic: .eml files for the email circuits, .json
@@ -282,7 +310,7 @@ def cmd_batch(args):
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
     zk = _load_zkey(args)
     _check_zkey_matches(zk, cs)
-    dpk = device_pk_from_zkey(zk)
+    dpk = device_pk_from_zkey(zk, infer_widths=_infer_widths(args))
     # Per-circuit input type: email circuits consume .eml, the rest .json
     # ({"message": ...}) — one glob per circuit so a stray file of the
     # other type can't crash the batch or collide on output basenames.
@@ -321,7 +349,7 @@ def cmd_service(args):
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
     zk = _load_zkey(args)
     _check_zkey_matches(zk, cs)
-    dpk = device_pk_from_zkey(zk)
+    dpk = device_pk_from_zkey(zk, infer_widths=_infer_widths(args))
     vk = vkey_from_json(load(os.path.join(args.build_dir, "verification_key.json")))
     params, lay = meta
     prover_fn = None
@@ -390,7 +418,7 @@ def cmd_serve(args):
         cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
         zk = _load_zkey(args)
         _check_zkey_matches(zk, cs)
-        prover = ProverBundle(cs=cs, dpk=device_pk_from_zkey(zk), params=meta[0], layout=meta[1])
+        prover = ProverBundle(cs=cs, dpk=device_pk_from_zkey(zk, infer_widths=_infer_widths(args)), params=meta[0], layout=meta[1])
         _log("prover bundle loaded")
     app = OnrampApp(ramp, usdc, prover, eml_spool=args.eml_spool)
     srv = serve(app, port=args.port)
@@ -421,6 +449,7 @@ def main(argv=None):
     s.add_argument("--demo", action="store_true", help="use the synthetic signed email")
     s.add_argument("--message", help="message (sha256 circuit)")
     s.add_argument("--zkey", help="zkey path or chunk glob (default: BUILD_DIR/circuit_final.zkey)")
+    s.add_argument("--no-infer-widths", action="store_true", help="disable the zkey bit-constraint width inference (use when the circuit contains x*(x-1)=y rows)")
     s.add_argument("--zkey-store", help="artifact-store dir to pull the chunked zkey from")
     s.add_argument("--wtns", help="externally generated witness.wtns (drop-in prover parity)")
     s.add_argument("--prover", choices=["tpu", "native"], default="tpu",
@@ -442,6 +471,7 @@ def main(argv=None):
     s.add_argument("--poll", type=float, default=1.0)
     s.add_argument("--max-sweeps", type=int, default=None)
     s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.add_argument("--no-infer-widths", action="store_true", help="disable the zkey bit-constraint width inference")
     s.add_argument("--prover", choices=["tpu", "native"], default="tpu",
                    help="tpu: vmapped XLA batch; native: C++ runtime, sequential")
     s.add_argument("--prefetch", type=int, default=1, help="ready-batch queue depth")
@@ -452,9 +482,20 @@ def main(argv=None):
     s.add_argument("--max-amount", type=int, default=10_000_000)
     s.add_argument("--with-prover", action="store_true", help="load the zkey so /api/onramp proves")
     s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.add_argument("--no-infer-widths", action="store_true", help="disable the zkey bit-constraint width inference")
     s.add_argument("--demo", action="store_true", help="deploy the escrow with the synthetic test-key limbs")
     s.add_argument("--eml-spool", help="directory server-side .eml paths are restricted to")
     s.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser("ceremony", help="phase-2 zkey MPC: contribute / beacon / verify")
+    s.add_argument("op", choices=["contribute", "beacon", "verify"])
+    s.add_argument("zkey_in", help="input zkey (for verify: the trusted initial zkey)")
+    s.add_argument("zkey_out", help="output zkey (for verify: the final zkey to check)")
+    s.add_argument("--entropy", default="", help="contributor entropy string (contribute)")
+    s.add_argument("--name", default="", help="contributor name recorded in the transcript")
+    s.add_argument("--beacon-hash", default="", help="public beacon value, hex (beacon)")
+    s.add_argument("--iter-exp", type=int, default=10, help="beacon hash iterations = 2^n (beacon)")
+    s.set_defaults(fn=cmd_ceremony)
 
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")
     s.add_argument("--indir", required=True)
@@ -462,6 +503,7 @@ def main(argv=None):
     s.add_argument("--prover", choices=["tpu", "native"], default="tpu",
                    help="tpu: vmapped XLA batch; native: C++ runtime, sequential")
     s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.add_argument("--no-infer-widths", action="store_true", help="disable the zkey bit-constraint width inference")
     s.add_argument("--message", help=argparse.SUPPRESS)
     s.add_argument("--order-id", type=int, default=1)
     s.add_argument("--claim-id", type=int, default=0)

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import secrets
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -159,6 +159,12 @@ class DeviceProvingKey:
     beta_2: G2Point
     delta_1: G1Point
     delta_2: G2Point
+    # Wires whose narrow classing came from zkey bit-pattern INFERENCE
+    # (not ConstraintSystem width tags): packed int64 ids as bytes —
+    # hashable, so it rides the pytree aux tuple and survives
+    # flatten/unflatten (a rebuilt key keeps its prove-time width
+    # guard).  None for cs-built keys.
+    inferred_narrow_wires: Optional[bytes] = None
 
 
 _DPK_ARRAY_FIELDS = (
@@ -167,7 +173,7 @@ _DPK_ARRAY_FIELDS = (
     "b_sel", "c_sel",
     "a_nsel", "a_wsel", "b_nsel", "b_wsel", "c_nsel", "c_wsel",
 )
-_DPK_META_FIELDS = ("n_public", "n_wires", "log_m", "alpha_1", "beta_1", "beta_2", "delta_1", "delta_2")
+_DPK_META_FIELDS = ("n_public", "n_wires", "log_m", "alpha_1", "beta_1", "beta_2", "delta_1", "delta_2", "inferred_narrow_wires")
 
 
 def _dpk_flatten(d: "DeviceProvingKey"):
@@ -244,14 +250,94 @@ def device_pk(pk: ProvingKey, cs: ConstraintSystem) -> DeviceProvingKey:
     )
 
 
-def device_pk_from_zkey(zk) -> DeviceProvingKey:
+def infer_zkey_widths(zk) -> np.ndarray:
+    """Recover the narrow width class from an imported zkey's coeff
+    section by detecting circom's bit-constraint rows x·(x-1)=0
+    (circomlib Num2Bits emits A={x:1}, B={x:1, one:-1}, C=0; also
+    matched with A/B swapped).  The zkey stores no C matrix, so the
+    pattern is NOT conclusive — x·(x-1)=y matches identically — which
+    is why every prove on an inferred-width key runs the witness-bound
+    validator (`_check_inferred_widths`): a witness that breaks an
+    inferred bound raises instead of silently dropping digit planes.
+
+    Recovers the ~10x witness-MSM cut for ceremony keys (the production
+    import path) that dev-setup keys get from ConstraintSystem width
+    tags."""
+    from collections import defaultdict
+
+    a_rows: Dict[int, Dict[int, int]] = defaultdict(dict)
+    b_rows: Dict[int, Dict[int, int]] = defaultdict(dict)
+    for mat, row, wire, v in zk.coeffs:
+        (a_rows if mat == 0 else b_rows)[row][wire] = v
+    widths = np.full(zk.n_vars, 254, dtype=np.int32)
+    widths[0] = 1  # constant-one wire
+    for r in set(a_rows) | set(b_rows):
+        A, B = a_rows.get(r, {}), b_rows.get(r, {})
+        for X, Y in ((A, B), (B, A)):
+            if len(X) == 1 and len(Y) == 2 and 0 in Y:
+                ((w, xv),) = X.items()
+                if w != 0 and xv == 1 and Y.get(w) == 1 and Y[0] == R - 1:
+                    widths[w] = 1
+    return widths
+
+
+def _check_inferred_widths(
+    dpk: DeviceProvingKey,
+    witness: Sequence[int],
+    w_std: Optional[np.ndarray] = None,
+) -> None:
+    """Host-side guard for inferred-width keys: every wire classed
+    narrow must actually fit the narrow digit planes.  No-op for keys
+    built from a ConstraintSystem, whose `check_witness`/`check_widths`
+    already enforce the tagged bounds.
+
+    `w_std`: optional (n_wires, 4) u64 standard-form limb view of the
+    witness (prove_native already builds one) — the check vectorizes
+    over it instead of looping Python bigints."""
+    blob = dpk.inferred_narrow_wires
+    if not blob:
+        return
+    wires = np.frombuffer(blob, dtype=np.int64)
+    bound = 1 << (4 * NARROW_PLANES - 1)
+    if w_std is None:
+        # build a limb view of just the narrow wires (to_bytes is
+        # C-speed; a pure-Python bigint comparison loop over ~90% of a
+        # venmo key's wires costs seconds per witness at batch=64)
+        from ..native.lib import _scalars_to_u64
+
+        w_std = _scalars_to_u64([witness[j] % R for j in wires])
+        wires_idx = np.arange(len(wires))
+    else:
+        wires_idx = wires
+    vals = np.asarray(w_std)[wires_idx]
+    bad = (vals[:, 1:].any(axis=1)) | (vals[:, 0] >= bound)
+    if not bad.any():
+        return
+    i = int(wires[int(np.flatnonzero(bad)[0])])
+    raise ValueError(
+        f"wire {i}: witness value exceeds the width bound inferred "
+        f"from the zkey's bit-constraint pattern — the circuit uses "
+        f"x*(x-1)=y somewhere; re-import with infer_widths=False"
+    )
+
+
+def device_pk_from_zkey(zk, infer_widths: bool = True) -> DeviceProvingKey:
     """snarkjs zkey (formats.zkey.ZkeyData) -> device arrays: the
     ceremony-key import path (`app/src/helpers/zkp.ts:13` chunk flow).
     The zkey coeff section already contains the public binding rows, so
-    the QAP rows come from the file, not from a ConstraintSystem — and
-    carries no width metadata, so every wire rides the wide class."""
+    the QAP rows come from the file, not from a ConstraintSystem.  Width
+    metadata is recovered from the bit-constraint pattern by default
+    (`infer_zkey_widths`), guarded at prove time."""
     a_rows, b_rows = zk.qap_row_arrays()
-    return device_pk_from_rows(zk.to_proving_key(), a_rows, b_rows, zk.domain_size, zk.n_vars)
+    widths = infer_zkey_widths(zk) if infer_widths else None
+    dpk = device_pk_from_rows(
+        zk.to_proving_key(), a_rows, b_rows, zk.domain_size, zk.n_vars, widths=widths
+    )
+    if widths is not None:
+        dpk.inferred_narrow_wires = (
+            np.flatnonzero(widths <= NARROW_WIDTH).astype(np.int64).tobytes()
+        )
+    return dpk
 
 
 def _prune_sel(flags: Sequence[bool]) -> np.ndarray:
@@ -598,6 +684,7 @@ def prove_tpu(
         r = 1 + secrets.randbelow(R - 1)
     if s is None:
         s = 1 + secrets.randbelow(R - 1)
+    _check_inferred_widths(dpk, witness)
     acc = _prove_device(dpk, witness_to_device(witness))
     a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (acc[0], acc[1], acc[3], acc[4]))
     b2 = g2_jac_to_host(acc[2])[0]
@@ -724,6 +811,8 @@ def prove_tpu_sharded(
 def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -> List[Proof]:
     """vmap the full device pipeline over a batch of witnesses (the
     batch=64 configuration in BASELINE.json)."""
+    for wit in witnesses:
+        _check_inferred_widths(dpk, wit)
     w = jnp.stack([witness_to_device(wit) for wit in witnesses])
     accs = _prove_device(dpk, w, batched=True)
     a, b1, c, hq = (g1_jac_to_host(accs[i]) for i in (0, 1, 3, 4))

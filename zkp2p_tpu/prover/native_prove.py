@@ -27,7 +27,7 @@ from ..field.bn254 import R, fr_domain_root
 from ..field.tower import Fq2
 from ..native.lib import _scalars_to_u64, get_lib
 from ..snark.groth16 import Proof, coset_gen
-from .groth16_tpu import DeviceProvingKey, _assemble
+from .groth16_tpu import DeviceProvingKey, _assemble, _check_inferred_widths
 
 _u64p = ctypes.POINTER(ctypes.c_uint64)
 _u32p = ctypes.POINTER(ctypes.c_uint32)
@@ -135,7 +135,7 @@ def _u64x4_to_int_arr(a: np.ndarray) -> list:
     return [int.from_bytes(a[i].tobytes(), "little") for i in range(a.shape[0])]
 
 
-def _pick_window(n: int) -> int:
+def _pick_window(n: int, g2: bool = False) -> int:
     """Pippenger window: ~log2(n) - 4 with SIGNED digits — the signed
     recoding halves the bucket count at a given c, so the sweet spot
     sits one window wider than the unsigned sweep (n=2^19: unsigned
@@ -145,12 +145,13 @@ def _pick_window(n: int) -> int:
     purely from doubled batch-affine conflicts; the raised clamp lets
     the big domains reach c=17 while the bench shape keeps its
     measured-best c=15 (signed sweep at 2^19: c=15 6.3s, c=16 7.6s)."""
-    if _lib() is not None and _lib().zkp2p_ifma_available():
-        # IFMA regime: the vectorized batch-affine fill costs ~3x less
-        # per add than the scalar one, so the fill/reduction optimum
-        # shifts to a smaller window (reduction cost scales with 2^c,
-        # fill with ceil(254/c); measured sweep at n=2^19: c=14 beats
-        # c=17 once the fill is 8-wide).
+    if not g2 and _lib() is not None and _lib().zkp2p_ifma_available():
+        # IFMA regime (G1 only — the vector chunk apply has no Fq2
+        # counterpart yet): the vectorized batch-affine fill costs ~3x
+        # less per add than the scalar one, so the fill/reduction
+        # optimum shifts to a smaller window (reduction cost scales
+        # with 2^c, fill with ceil(254/c); measured sweep at n=2^19:
+        # c=14 beats c=17 once the fill is 8-wide).
         return max(4, min(14, n.bit_length() - 5))
     return max(4, min(17, n.bit_length() - 5))
 
@@ -193,6 +194,8 @@ def prove_native(
     with trace("native/witness_convert"):
         w_std = np.ascontiguousarray(_scalars_to_u64([w % R for w in witness]))
         n_wires = w_std.shape[0]
+        # inferred-width guard, vectorized over the limb view
+        _check_inferred_widths(dpk, witness, w_std=w_std)
         w_mont = np.zeros_like(w_std)
         lib.fr_to_mont_batch(_p(w_std), _p(w_mont), n_wires)
 
@@ -260,7 +263,7 @@ def prove_native(
             n = min(b.shape[0], scalars.shape[0])
             sc = np.ascontiguousarray(scalars[:n])
             out = np.zeros(16, dtype=np.uint64)
-            lib.g2_msm_pippenger_mt(_p(b), _p(sc), n, _pick_window(n), threads, _p(out))
+            lib.g2_msm_pippenger_mt(_p(b), _p(sc), n, _pick_window(n, g2=True), threads, _p(out))
         xc0, xc1, yc0, yc1 = _u64x4_to_int_arr(out.reshape(4, 4))
         if xc0 == xc1 == yc0 == yc1 == 0:
             return None

@@ -1,0 +1,383 @@
+"""Groth16 phase-2 ceremony operations: contribute / beacon / verify.
+
+The reference's trust model rests on the phase-2 MPC its scripts drive
+with snarkjs (`dizkus-scripts/3_gen_both_zkeys.sh:18-65`: two
+`zkey contribute` rounds + a `zkey beacon` + `zkey verify`;
+`circuit/server-scripts/generate_keys_phase2_groth16.sh:55-61`).  This
+module re-builds those operations natively over our zkey format
+(`formats/zkey.py`), with the BGM17 update/proof scheme snarkjs uses:
+
+  contribute:  pick delta'; delta1 *= delta', delta2 *= delta',
+               c_query[i] *= 1/delta', h_query[i] *= 1/delta'; publish a
+               proof of knowledge (s·G1, delta'·s·G1, delta'·SP) where
+               SP = hash-to-G2 of the running transcript challenge.
+  beacon:      same update with delta' derived from a public beacon
+               value by 2^iter_exp iterated hashes — verifiers re-derive
+               it, so the final contribution is unriggable.
+  verify:      per-contribution pairing checks (the PoK ratio test and
+               deltaAfter = delta'·deltaBefore), delta1/delta2
+               consistency, exact re-derivation of beacon deltas, and a
+               random-linear-combination pairing check that the C and H
+               queries of the final key are the initial ones scaled by
+               the accumulated 1/delta' — using the identity
+               e(C_i/d, d·D2) = e(C_i, D2).
+
+Hashes are blake2b-512 (snarkjs's choice for ceremony transcripts).
+Byte-level parity with snarkjs section-10 records is NOT claimed (no
+snarkjs in this environment to diff against); the formats round-trip
+through our own reader and the cryptographic checks are equivalent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import replace
+from typing import List, Optional, Tuple
+
+from ..curve.host import (
+    G1_GENERATOR,
+    G2_GENERATOR,
+    G1Point,
+    G2Point,
+    TWIST_B,
+    g1_is_on_curve,
+    g1_mul,
+    g1_neg,
+    g2_is_on_curve,
+    g2_mul,
+)
+from ..field.bn254 import P, R
+from ..field.tower import Fq2
+from ..formats.zkey import Contribution, MpcParams, ZkeyData, read_zkey, write_zkey_data
+from ..pairing.pairing import pairing_product_is_one
+
+# ------------------------------------------------------------- hash-to-G2
+
+# G2 twist cofactor: the sextic twist E'(Fp2) used for BN254 G2 has
+# order h2*r with h2 = 2p - r (NOT the (p^2+1-t2)/r of E(Fp2) itself —
+# that is the other twist order and leaves points outside the
+# r-torsion).  Multiplying a curve point by h2 lands it in the subgroup
+# the pairing is defined on; validated by the subgroup assertion in
+# hash_to_g2 and the cofactor probe in tests/test_ceremony.py.
+G2_COFACTOR = 2 * P - R
+
+
+def _fq_sqrt(a: int) -> Optional[int]:
+    """Square root in Fq (p ≡ 3 mod 4): a^((p+1)/4), validated."""
+    r_ = pow(a, (P + 1) // 4, P)
+    return r_ if r_ * r_ % P == a % P else None
+
+
+def _fq2_sqrt(a: Fq2) -> Optional[Fq2]:
+    """Square root in Fq2 = Fq[u]/(u^2+1) via the norm trick."""
+    if a.c0 == 0 and a.c1 == 0:
+        return Fq2(0, 0)
+    norm = (a.c0 * a.c0 + a.c1 * a.c1) % P
+    alpha = _fq_sqrt(norm)
+    if alpha is None:
+        return None
+    inv2 = pow(2, P - 2, P)
+    lam = (a.c0 + alpha) * inv2 % P
+    x0 = _fq_sqrt(lam)
+    if x0 is None:
+        lam = (a.c0 - alpha) * inv2 % P
+        x0 = _fq_sqrt(lam)
+        if x0 is None:
+            return None
+    x1 = a.c1 * inv2 % P * pow(x0, P - 2, P) % P
+    cand = Fq2(x0, x1)
+    return cand if cand * cand == a else None
+
+
+def hash_to_g2(seed: bytes) -> G2Point:
+    """Deterministic try-and-increment map to the r-torsion of the twist
+    (the SP point of the BGM17 proof of knowledge)."""
+    ctr = 0
+    while True:
+        h = hashlib.blake2b(seed + ctr.to_bytes(4, "little"), digest_size=64).digest()
+        x = Fq2(int.from_bytes(h[:32], "little") % P, int.from_bytes(h[32:], "little") % P)
+        y2 = x * x * x + TWIST_B
+        y = _fq2_sqrt(y2)
+        ctr += 1
+        if y is None:
+            continue
+        pt = (x, y)
+        assert g2_is_on_curve(pt)
+        pt = g2_mul(pt, G2_COFACTOR)
+        if pt is not None:  # cofactor clearing can hit infinity; retry
+            assert g2_mul(pt, R) is None, "cofactor clearing left the r-torsion"
+            return pt
+
+
+# ------------------------------------------------------------- transcript
+
+
+def _challenge(mpc: MpcParams, upto: int) -> bytes:
+    """The challenge a contributor at position `upto` signs into its SP
+    point: circuit hash chained through every prior transcript."""
+    h = hashlib.blake2b(digest_size=64)
+    h.update(mpc.cs_hash)
+    for c in mpc.contributions[:upto]:
+        h.update(c.transcript)
+    return h.digest()
+
+
+def _g1_raw(pt: G1Point) -> bytes:
+    if pt is None:
+        return b"\x00" * 64
+    return pt[0].to_bytes(32, "little") + pt[1].to_bytes(32, "little")
+
+
+def _g2_raw(pt: G2Point) -> bytes:
+    if pt is None:
+        return b"\x00" * 128
+    x, y = pt
+    return b"".join(v.to_bytes(32, "little") for v in (x.c0, x.c1, y.c0, y.c1))
+
+
+def _scale_points(points, k: int):
+    """k * P_i for a shared k: native batch (NAF once + batched affine
+    normalization, csrc g1_scale_batch) when available — the op runs
+    over every C and H query point, ~1.5M for the flagship key — else
+    the Python Jacobian path."""
+    from ..native.lib import g1_scale_batch
+
+    res = g1_scale_batch(list(points), k)
+    if res is not None:
+        return res
+    return [None if p is None else g1_mul(p, k) for p in points]
+
+
+def _msm_points(points, scalars):
+    """Random-combination MSM for verify_chain: native Pippenger when
+    available, Python fallback otherwise."""
+    from ..curve.host import g1_add
+    from ..native.lib import g1_msm
+
+    res = g1_msm(list(points), list(scalars))
+    if res is not False:
+        return res
+    acc = None
+    for p, s in zip(points, scalars):
+        acc = g1_add(acc, g1_mul(p, s))
+    return acc
+
+
+def _scale_queries(z: ZkeyData, delta_prime: int) -> ZkeyData:
+    """Apply a contribution's delta' to the key material."""
+    dinv = pow(delta_prime, R - 2, R)
+    return replace(
+        z,
+        delta_1=g1_mul(z.delta_1, delta_prime),
+        delta_2=g2_mul(z.delta_2, delta_prime),
+        c_query=_scale_points(z.c_query, dinv),  # None holes pass through
+        h_query=_scale_points(z.h_query, dinv),
+    )
+
+
+def _append_contribution(z: ZkeyData, delta_prime: int, kind: int, name: str,
+                         beacon_hash: bytes = b"", beacon_iter_exp: int = 0) -> ZkeyData:
+    mpc = z.mpc or MpcParams(cs_hash=b"\x00" * 64, contributions=[])
+    challenge = _challenge(mpc, len(mpc.contributions))
+    sp = hash_to_g2(challenge)
+    s = 1 + secrets.randbelow(R - 1)
+    g1_s = g1_mul(G1_GENERATOR, s)
+    g1_sx = g1_mul(G1_GENERATOR, s * delta_prime % R)
+    g2_spx = g2_mul(sp, delta_prime)
+    z2 = _scale_queries(z, delta_prime)
+    transcript = hashlib.blake2b(
+        challenge + _g1_raw(z2.delta_1) + _g1_raw(g1_s) + _g1_raw(g1_sx) + _g2_raw(g2_spx),
+        digest_size=64,
+    ).digest()
+    contrib = Contribution(
+        delta_after=z2.delta_1,
+        pok_g1_s=g1_s,
+        pok_g1_sx=g1_sx,
+        pok_g2_spx=g2_spx,
+        transcript=transcript,
+        kind=kind,
+        name=name,
+        beacon_hash=beacon_hash,
+        beacon_iter_exp=beacon_iter_exp,
+    )
+    return replace(z2, mpc=MpcParams(mpc.cs_hash, mpc.contributions + [contrib]))
+
+
+# ------------------------------------------------------------ public ops
+
+
+def circuit_hash(z: ZkeyData) -> bytes:
+    """64-byte digest binding the phase-2 transcript to the circuit: the
+    non-delta key material (everything a contribution must not touch)."""
+    h = hashlib.blake2b(digest_size=64)
+    h.update(_g1_raw(z.alpha_1) + _g1_raw(z.beta_1) + _g2_raw(z.beta_2) + _g2_raw(z.gamma_2))
+    for pt in z.ic + z.a_query + z.b1_query:
+        h.update(_g1_raw(pt))
+    for pt2 in z.b2_query:
+        h.update(_g2_raw(pt2))
+    for m, row, wire, value in z.coeffs:
+        h.update(m.to_bytes(1, "little") + row.to_bytes(4, "little") + wire.to_bytes(4, "little") + value.to_bytes(32, "little"))
+    return h.digest()
+
+
+def contribute(zkey_in: str, zkey_out: str, entropy: bytes, name: str = "") -> ZkeyData:
+    """`snarkjs zkey contribute` equivalent: one interactive phase-2
+    contribution with delta' drawn from caller entropy + fresh CSPRNG."""
+    z = read_zkey(zkey_in)
+    if z.mpc is None or z.mpc.cs_hash == b"\x00" * 64:
+        z = replace(z, mpc=MpcParams(cs_hash=circuit_hash(z), contributions=(z.mpc.contributions if z.mpc else [])))
+    seed = hashlib.blake2b(entropy + secrets.token_bytes(32), digest_size=64).digest()
+    delta_prime = 1 + int.from_bytes(seed, "little") % (R - 1)
+    z2 = _append_contribution(z, delta_prime, kind=0, name=name)
+    write_zkey_data(zkey_out, z2)
+    return z2
+
+
+# Beacon iteration ceiling: snarkjs caps numIterationsExp at 63; anything
+# past ~32 is already months of hashing, and verify_chain re-derives the
+# chain from FILE-CONTROLLED bytes — an uncapped exponent is a DoS knob.
+MAX_BEACON_ITER_EXP = 32
+
+
+def beacon_delta(beacon_hash: bytes, iter_exp: int) -> int:
+    """The deterministic beacon delta': 2^iter_exp iterated blake2b over
+    the public beacon value, reduced into Fr* (re-derived by verifiers)."""
+    if not 0 <= iter_exp <= MAX_BEACON_ITER_EXP:
+        raise ValueError(f"beacon iter_exp {iter_exp} outside [0, {MAX_BEACON_ITER_EXP}]")
+    h = beacon_hash
+    for _ in range(1 << iter_exp):
+        h = hashlib.blake2b(h, digest_size=64).digest()
+    return 1 + int.from_bytes(h, "little") % (R - 1)
+
+
+def beacon(zkey_in: str, zkey_out: str, beacon_hash: bytes, iter_exp: int = 10,
+           name: str = "final beacon") -> ZkeyData:
+    """`snarkjs zkey beacon` equivalent: the closing contribution whose
+    delta' anyone can re-derive from the public beacon value."""
+    z = read_zkey(zkey_in)
+    if z.mpc is None or z.mpc.cs_hash == b"\x00" * 64:
+        z = replace(z, mpc=MpcParams(cs_hash=circuit_hash(z), contributions=(z.mpc.contributions if z.mpc else [])))
+    # normalize to the 64-byte stored form FIRST: verifiers re-derive
+    # delta' from the stored bytes, so derivation must use them too
+    beacon_hash = beacon_hash.ljust(64, b"\x00")[:64]
+    delta_prime = beacon_delta(beacon_hash, iter_exp)
+    z2 = _append_contribution(z, delta_prime, kind=1, name=name,
+                              beacon_hash=beacon_hash, beacon_iter_exp=iter_exp)
+    write_zkey_data(zkey_out, z2)
+    return z2
+
+
+def verify_chain(zkey_initial: str, zkey_final: str) -> Tuple[bool, List[str]]:
+    """`snarkjs zkey verify` equivalent against a trusted initial key
+    (the post-setup, zero-contribution zkey).  Returns (ok, log)."""
+    zi = read_zkey(zkey_initial)
+    zf = read_zkey(zkey_final)
+    log: List[str] = []
+
+    def fail(msg: str) -> Tuple[bool, List[str]]:
+        log.append(f"FAIL: {msg}")
+        return False, log
+
+    # 1. the contribution-invariant material must be untouched
+    if circuit_hash(zi) != circuit_hash(zf):
+        return fail("circuit material (alpha/beta/gamma/IC/A/B/coeffs) differs")
+    mpc = zf.mpc
+    if mpc is None:
+        return fail("final zkey has no MPC section")
+    if mpc.cs_hash != circuit_hash(zi):
+        return fail("cs_hash does not bind to the initial circuit")
+    log.append(f"circuit hash bound; {len(mpc.contributions)} contribution(s)")
+
+    # point validation BEFORE any pairing work: off-curve or
+    # out-of-subgroup points make the Miller loop a value an attacker
+    # can search over (invalid-curve / small-subgroup attacks on the
+    # PoK checks).  G1 has cofactor 1 so on-curve == in-subgroup; G2
+    # needs the explicit r-torsion check.
+    def g1_ok(pt) -> bool:
+        return pt is not None and g1_is_on_curve(pt)
+
+    def g2_ok(pt) -> bool:
+        return pt is not None and g2_is_on_curve(pt) and g2_mul(pt, R) is None
+
+    for i, c in enumerate(mpc.contributions):
+        if not (g1_ok(c.delta_after) and g1_ok(c.pok_g1_s) and g1_ok(c.pok_g1_sx)):
+            return fail(f"contribution {i}: G1 point off-curve/infinity")
+        if not g2_ok(c.pok_g2_spx):
+            return fail(f"contribution {i}: g2_spx off-curve or outside the r-torsion")
+        if c.kind == 1 and not 0 <= c.beacon_iter_exp <= MAX_BEACON_ITER_EXP:
+            return fail(f"contribution {i}: beacon iter_exp {c.beacon_iter_exp} over cap")
+    if not (g1_ok(zf.delta_1) and g2_ok(zf.delta_2)):
+        return fail("final delta off-curve or outside the subgroup")
+
+    # 2. walk the delta chain with the PoK pairing checks
+    delta_before = zi.delta_1
+    for i, c in enumerate(mpc.contributions):
+        challenge = _challenge(mpc, i)
+        sp = hash_to_g2(challenge)
+        # PoK ratio: e(g1_sx, SP) == e(g1_s, g2_spx)  (same delta' on both)
+        if not pairing_product_is_one([(c.pok_g1_sx, sp), (g1_neg(c.pok_g1_s), c.pok_g2_spx)]):
+            return fail(f"contribution {i}: proof of knowledge rejected")
+        # delta update: e(deltaAfter, SP) == e(deltaBefore, g2_spx)
+        if not pairing_product_is_one([(c.delta_after, sp), (g1_neg(delta_before), c.pok_g2_spx)]):
+            return fail(f"contribution {i}: deltaAfter != delta'*deltaBefore")
+        expect_transcript = hashlib.blake2b(
+            challenge + _g1_raw(c.delta_after) + _g1_raw(c.pok_g1_s) + _g1_raw(c.pok_g1_sx) + _g2_raw(c.pok_g2_spx),
+            digest_size=64,
+        ).digest()
+        if expect_transcript != c.transcript:
+            return fail(f"contribution {i}: transcript hash mismatch")
+        if c.kind == 1:
+            dp = beacon_delta(c.beacon_hash, c.beacon_iter_exp)
+            if g1_mul(delta_before, dp) != c.delta_after:
+                return fail(f"contribution {i}: beacon delta does not re-derive")
+            log.append(f"contribution {i}: beacon re-derived (iter_exp={c.beacon_iter_exp})")
+        else:
+            log.append(f"contribution {i}: PoK + delta link verified")
+        delta_before = c.delta_after
+
+    if delta_before != zf.delta_1:
+        return fail("final delta1 is not the chain head")
+    # delta1 (G1) and delta2 (G2) must carry the same scalar:
+    # e(delta1, G2) == e(G1, delta2)
+    if not pairing_product_is_one([(zf.delta_1, G2_GENERATOR), (g1_neg(G1_GENERATOR), zf.delta_2)]):
+        return fail("final delta1/delta2 inconsistent")
+    log.append("delta chain closed; delta1/delta2 consistent")
+
+    # 3. query scaling: for random rho, e(sum rho_i C_i^f, delta2^f) must
+    # equal e(sum rho_i C_i^0, delta2^0) — the delta' factors cancel.
+    def combo(points_f, points_i, tag: str) -> bool:
+        # a length mismatch is itself a forgery vector (circuit_hash does
+        # not bind domain_size): zip() must never silently truncate
+        if len(points_f) != len(points_i):
+            return False
+        pts_f, pts_i, rhos = [], [], []
+        for a, b in zip(points_f, points_i):
+            if a is None and b is None:
+                continue
+            if (a is None) != (b is None):
+                return False
+            if not g1_ok(a):
+                return False
+            pts_f.append(a)
+            pts_i.append(b)
+            rhos.append(secrets.randbelow(1 << 127))
+        if not pts_f:
+            log.append(f"{tag} query empty on both sides")
+            return True
+        pf = _msm_points(pts_f, rhos)
+        pi_ = _msm_points(pts_i, rhos)
+        if (pf is None) != (pi_ is None):
+            return False  # one-sided infinity: scalings cannot match
+        if pf is None:
+            return True  # both infinity under the same rhos
+        ok = pairing_product_is_one([(pf, zf.delta_2), (g1_neg(pi_), zi.delta_2)])
+        if ok:
+            log.append(f"{tag} query scaling verified (randomized)")
+        return ok
+
+    if not combo(zf.c_query, zi.c_query, "C"):
+        return fail("C query not a consistent delta-scaling of the initial key")
+    if not combo(zf.h_query, zi.h_query, "H"):
+        return fail("H query not a consistent delta-scaling of the initial key")
+    return True, log
