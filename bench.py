@@ -331,28 +331,27 @@ def main():
     # ((2^w - 2) adds) is witness-independent, so vmap leaves it
     # unbatched and it amortises over the proof batch; at batch>=8 the
     # halved accumulate work (32 digit planes instead of 64) wins.
-    # Must be set before the first zkp2p_tpu.prover import.
-    os.environ.setdefault("ZKP2P_MSM_WINDOW", "8")
+    # Must be set before the first zkp2p_tpu.prover import — applied
+    # through the config loader below so provenance says
+    # "bench-default", not "env".
     # Hardware-gated tiers (batch-affine accumulate / bucket h MSM) are
     # OFF by default until an on-chip A/B passes.  The tunnel-window
     # session (tools/affine_hw_check.py via the watcher) records the
     # winners in .bench_cache/armed_flags.json, so a later driver bench
     # inherits validated arming without a human in the loop.  Explicit
     # env always wins; the re-exec fallback clears everything.
-    try:
-        with open(os.path.join(CACHE, "armed_flags.json")) as f:
-            flags = json.load(f)
-        # whitelist: only the two knobs the A/B session is allowed to arm —
-        # a stale/corrupt cache file must not steer unrelated prover config
-        for k in ("ZKP2P_MSM_AFFINE", "ZKP2P_MSM_H"):
-            if k in flags:
-                v = flags[k]
-                # booleans normalise to the "1"/"0" the prover checks
-                os.environ.setdefault(k, {True: "1", False: "0"}.get(v, str(v)))
-        log(f"armed flags applied: {[f'{k}={os.environ[k]}' for k in ('ZKP2P_MSM_AFFINE', 'ZKP2P_MSM_H') if k in os.environ]}")
-    except Exception as e:  # noqa: BLE001 — arming is best-effort, never fatal
-        if not isinstance(e, FileNotFoundError):
-            log(f"armed flags ignored: {e}")
+    # (the typed-config loader owns the armable-knob whitelist, parsing
+    # and provenance; apply_env writes the resolved view back so the
+    # prover's import-time constants and any child process see it)
+    from zkp2p_tpu.utils.config import load_config
+
+    cfg = load_config(armed_flags_path=os.path.join(CACHE, "armed_flags.json"), log=log)
+    if cfg.provenance["msm_window"] == "default":
+        os.environ["ZKP2P_MSM_WINDOW"] = "8"
+        cfg = load_config(armed_flags_path=os.path.join(CACHE, "armed_flags.json"), log=log)
+        cfg.provenance["msm_window"] = "bench-default"
+    cfg.apply_env()
+    log(f"config: {cfg.describe()}")
     from zkp2p_tpu.prover.groth16_tpu import prove_tpu_batch
     from zkp2p_tpu.snark.groth16 import verify
     from zkp2p_tpu.utils.trace import dump_trace, trace

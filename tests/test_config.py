@@ -1,0 +1,126 @@
+"""The typed prover config (SURVEY.md §5: one config, env as override).
+
+Pins the resolution order (default -> armed flags -> env), provenance
+labeling, the armable-knob whitelist, and — via a source scan — that
+every ZKP2P_* variable read anywhere in the tree is registered in the
+config's knob table (no knob may bypass the single source of truth)."""
+
+import json
+import os
+import re
+
+from zkp2p_tpu.utils.config import ARMABLE, KNOBS, ProverConfig, load_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_defaults():
+    cfg = load_config(environ={})
+    assert cfg.msm_window == 4
+    assert cfg.msm_signed is True
+    assert cfg.msm_h == "windowed"
+    assert cfg.native_ifma is True
+    assert all(v == "default" for v in cfg.provenance.values())
+
+
+def test_env_overrides_every_knob():
+    env = {
+        "ZKP2P_MSM_WINDOW": "8",
+        "ZKP2P_MSM_SIGNED": "0",
+        "ZKP2P_MSM_UNIFIED": "1",
+        "ZKP2P_MSM_AFFINE": "1",
+        "ZKP2P_MSM_H": "bucket",
+        "ZKP2P_FIELD_CONV": "limb_major",
+        "ZKP2P_FIELD_MUL": "pallas",
+        "ZKP2P_CURVE_KERNEL": "xla",
+        "ZKP2P_NATIVE_IFMA": "0",
+        "ZKP2P_NATIVE_THREADS": "7",
+        "ZKP2P_NO_CACHE": "1",
+    }
+    cfg = load_config(environ=env)
+    assert cfg.msm_window == 8 and cfg.msm_signed is False
+    assert cfg.msm_unified == "1" and cfg.msm_affine == "1" and cfg.msm_h == "bucket"
+    assert cfg.field_conv == "limb_major" and cfg.field_mul == "pallas" and cfg.curve_kernel == "xla"
+    assert cfg.native_ifma is False and cfg.native_threads == 7 and cfg.no_cache is True
+    assert all(v == "env" for v in cfg.provenance.values())
+
+
+def test_reader_matched_parsers():
+    """Parsers must reproduce the semantics of the actual readers: the
+    C runtime disables IFMA only on a leading '0' ('true' stays ON),
+    and an empty thread count is shell-style unset, not 1 thread."""
+    cfg = load_config(environ={"ZKP2P_NATIVE_IFMA": "true"})
+    assert cfg.native_ifma is True
+    assert load_config(environ={"ZKP2P_NATIVE_IFMA": "0"}).native_ifma is False
+    assert load_config(environ={"ZKP2P_NATIVE_THREADS": ""}).native_threads is None
+    assert load_config(environ={"ZKP2P_NATIVE_THREADS": "junk"}).native_threads == 1
+
+
+def test_armed_flags_whitelist_and_precedence(tmp_path):
+    p = tmp_path / "armed_flags.json"
+    p.write_text(json.dumps({
+        "ZKP2P_MSM_AFFINE": True,
+        "ZKP2P_MSM_H": "bucket",
+        "ZKP2P_MSM_WINDOW": "16",   # NOT armable: must be ignored
+        "ZKP2P_NATIVE_IFMA": "0",   # NOT armable: must be ignored
+    }))
+    msgs = []
+    cfg = load_config(environ={}, armed_flags_path=str(p), log=msgs.append)
+    assert cfg.msm_affine == "1" and cfg.provenance["msm_affine"] == "armed"
+    assert cfg.msm_h == "bucket" and cfg.provenance["msm_h"] == "armed"
+    assert cfg.msm_window == 4 and cfg.provenance["msm_window"] == "default"
+    assert cfg.native_ifma is True
+    assert sum("non-armable" in m for m in msgs) == 2
+    # explicit env beats armed
+    cfg2 = load_config(environ={"ZKP2P_MSM_H": "windowed"}, armed_flags_path=str(p))
+    assert cfg2.msm_h == "windowed" and cfg2.provenance["msm_h"] == "env"
+
+
+def test_corrupt_armed_flags_never_fatal(tmp_path):
+    p = tmp_path / "armed_flags.json"
+    p.write_text("{not json")
+    cfg = load_config(environ={}, armed_flags_path=str(p))
+    assert cfg == ProverConfig(provenance=cfg.provenance)
+
+
+def test_apply_env_roundtrip():
+    cfg = load_config(environ={"ZKP2P_MSM_H": "bucket", "ZKP2P_NATIVE_THREADS": "3"})
+    env: dict = {}
+    cfg.apply_env(env)
+    assert env["ZKP2P_MSM_H"] == "bucket"
+    assert env["ZKP2P_MSM_SIGNED"] == "1"
+    assert env["ZKP2P_NATIVE_THREADS"] == "3"
+    # a second load from the exported env reproduces the config
+    cfg2 = load_config(environ=env)
+    assert cfg2 == cfg
+
+
+def test_every_zkp2p_env_read_is_registered():
+    """Scan the tree for ZKP2P_* reads: each must be a registered knob
+    (or an explicitly test-scoped variable), so no code path can grow a
+    config knob outside the typed config again."""
+    registered = {var for var, _p, _d in KNOBS.values()}
+    allowed_extra = {
+        "ZKP2P_RUN_SLOW",   # test-tier gate, read only by the suite
+        "ZKP2P_",           # prefix literals in scanners/docs
+        "ZKP2P_HAVE_IFMA",  # C compile-time macro, not an env knob
+    }
+    found = set()
+    scan_roots = ["zkp2p_tpu", "csrc", "bench.py", "__graft_entry__.py", "tools"]
+    for root in scan_roots:
+        path = os.path.join(REPO, root)
+        files = []
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            for dirpath, _dirs, names in os.walk(path):
+                files += [os.path.join(dirpath, n) for n in names if n.endswith((".py", ".cpp", ".sh"))]
+        for f in files:
+            if f.endswith("config.py"):
+                continue
+            with open(f, errors="ignore") as fh:
+                found |= set(re.findall(r"ZKP2P_[A-Z_]*", fh.read()))
+    unregistered = found - registered - allowed_extra
+    assert not unregistered, f"env reads outside the typed config: {sorted(unregistered)}"
+    # and the armable whitelist refers to real knobs
+    assert set(ARMABLE) <= set(KNOBS)

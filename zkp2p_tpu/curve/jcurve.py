@@ -46,7 +46,9 @@ import os
 # per point add into one VMEM-resident kernel; measured on a v5e chip
 # (r4): 17.7 M G1 add_mixed/s vs 0.65 M for the XLA path (27x), MSM
 # 0.150 M pts/s vs 0.009 (16.7x) — see docs/ROOFLINE.md.
-CURVE_IMPL = os.environ.get("ZKP2P_CURVE_KERNEL", "auto")
+from ..utils.config import load_config as _load_config
+
+CURVE_IMPL = _load_config().curve_kernel
 
 
 class JCurve:

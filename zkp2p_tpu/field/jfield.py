@@ -103,14 +103,16 @@ def _conv_onehot(n: int, m: int) -> jnp.ndarray:
 # only 16/128 lanes on every elementwise op while limb-major (16, B)
 # fills them.  Flip at runtime (e.g. ZKP2P_FIELD_CONV=limb_major) to A/B
 # on hardware; both are bit-exact and differentially tested.
-CONV_LAYOUT = os.environ.get("ZKP2P_FIELD_CONV", "matmul")
+from ..utils.config import load_config as _load_config
+
+CONV_LAYOUT = _load_config().field_conv
 
 # Field-mul implementation selector: "auto" (default — the fused pallas
 # kernel on a real TPU backend, the XLA path elsewhere), "xla", or
 # "pallas" (force; runs interpret-mode off-TPU — tests only).  Measured
 # on a v5e chip (r4): 136.5 M muls/s fused vs 14.3 M XLA (7.9x) — see
 # docs/ROOFLINE.md.
-FIELD_MUL_IMPL = os.environ.get("ZKP2P_FIELD_MUL", "auto")
+FIELD_MUL_IMPL = _load_config().field_mul
 
 
 def field_mul_impl() -> str:

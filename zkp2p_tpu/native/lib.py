@@ -149,6 +149,8 @@ def g1_msm(points: Sequence, scalars: Sequence[int]) -> Optional[object]:
     if lib is None or not points:
         return False if lib is None else None
     n = len(points)
+    if len(scalars) != n:
+        raise ValueError(f"g1_msm: {n} points but {len(scalars)} scalars")
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.fp_to_mont.argtypes = [u64p, u64p, ctypes.c_int]
     lib.g1_msm_pippenger.argtypes = [u64p, u64p, ctypes.c_long, ctypes.c_int, u64p]
@@ -157,7 +159,11 @@ def g1_msm(points: Sequence, scalars: Sequence[int]) -> Optional[object]:
     lib.fp_to_mont(bases.ctypes.data_as(u64p), bm.ctypes.data_as(u64p), 2 * n)
     sc = _scalars_to_u64([int(s) for s in scalars])
     out = np.zeros(8, dtype=np.uint64)
-    c = max(4, min(16, n.bit_length() - 5))
+    # the ONE window policy (IFMA-aware clamp included) lives in
+    # native_prove; late import avoids the module cycle
+    from ..prover.native_prove import _pick_window
+
+    c = _pick_window(n)
     lib.g1_msm_pippenger(bm.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, c, out.ctypes.data_as(u64p))
     x, y = _u64x4_to_int(out[:4]), _u64x4_to_int(out[4:])
     return None if x == 0 and y == 0 else (x, y)

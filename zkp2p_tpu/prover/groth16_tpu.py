@@ -56,41 +56,31 @@ from ..ops.msm import (
 )
 from ..ops.ntt import coset_shift, intt, ntt
 
-# Window width for the prover MSMs: 4-bit digits -> ~78 point-adds per
-# base instead of the 256 of the bit-plane formulation (VERDICT r1 #3).
-# w=8 halves the accumulate work (32 digit planes) at the price of a
-# 254-add per-chunk table — worth it once the table amortises over a
-# vmapped proof batch (table cost is per-chunk, not per-witness), so
-# the batch bench arms it via ZKP2P_MSM_WINDOW=8.  Must divide 16.
-import os as _os
+# All tier knobs resolve through the ONE typed config (utils.config:
+# default -> armed_flags -> env, with provenance); the module constants
+# below are its import-time snapshot — jit identities depend on them,
+# so they are process-lifetime like the config itself.
+#
+# MSM_WINDOW: 4-bit digits -> ~78 point-adds per base instead of the 256
+#   of the bit-plane formulation (VERDICT r1 #3); w=8 halves accumulate
+#   work at the price of a 254-add per-chunk table, worth it vmapped.
+# MSM_SIGNED: signed digit recoding (default on) — the per-chunk
+#   multiples table halves because a negative digit is (x, -y) for free.
+# MSM_UNIFIED ("auto" = on for a real TPU backend): pad the a/b1/c/h
+#   MSM inputs to one common base count so all four share ONE compiled
+#   executable (each cold TPU MSM compile measured ~2 min).
+# MSM_AFFINE: batch-affine accumulate tier (ops.msm_affine) — hardware-
+#   gated until the on-chip A/B proves it.
+# MSM_H: "windowed" or "bucket" (ops.msm_bucket sorted-prefix
+#   Pippenger) — hardware-gated like MSM_AFFINE.
+from ..utils.config import load_config as _load_config
 
-MSM_WINDOW = int(_os.environ.get("ZKP2P_MSM_WINDOW", "4"))
-# Signed digit recoding (default on): the per-chunk multiples table
-# halves to 2^(w-1) entries because a negative digit is (x, -y) for
-# free — strictly less work at every batch size (ops.msm.
-# msm_windowed_signed).  The sharded/dryrun path keeps unsigned planes
-# (its XLA:CPU compile budget is tuned around the existing graphs).
-MSM_SIGNED = _os.environ.get("ZKP2P_MSM_SIGNED", "1") == "1"
-# Unified G1 MSM shape ("auto" = on for a real TPU backend): pad the
-# a/b1/c/h MSM inputs to one common base count so all four share ONE
-# compiled executable instead of four — on a cold driver box each TPU
-# MSM compile measured ~2 min, and the masked-lane work the padding adds
-# is small once adds/pt is low (w=8 signed: ~+33% G1 element-adds =
-# ~+0.1 s/proof at measured kernel rates).  The G2 MSM keeps its own
-# (minimal) size: its planes come from the unpadded b_sel gather, so
-# the padding never touches the 3x-cost Fq2 path.
-MSM_UNIFIED = _os.environ.get("ZKP2P_MSM_UNIFIED", "auto")
-# Batch-affine accumulate tier (ops.msm_affine, docs/NEXT.md lever 1):
-# affine accumulators + one batched inversion per chunk step instead of
-# Jacobian adds — ~1.45x fewer field muls on the wide/h MSMs.  "0" until
-# proven on hardware (Mosaic lowering has twice accepted interpret-mode
-# semantics it could not run); "auto" arms it on a real TPU backend.
-MSM_AFFINE = _os.environ.get("ZKP2P_MSM_AFFINE", "0")
-# h-MSM formulation (docs/NEXT.md lever 2): "windowed" (the signed
-# digit-plane accumulate above) or "bucket" (ops.msm_bucket sorted-
-# prefix Pippenger buckets at w=16 — no multiples table, ~34 affine
-# adds/pt, batch-independent).  Hardware-gated like MSM_AFFINE.
-MSM_H = _os.environ.get("ZKP2P_MSM_H", "windowed")
+_CFG = _load_config()
+MSM_WINDOW = _CFG.msm_window
+MSM_SIGNED = _CFG.msm_signed
+MSM_UNIFIED = _CFG.msm_unified
+MSM_AFFINE = _CFG.msm_affine
+MSM_H = _CFG.msm_h
 H_BUCKET_WINDOW = 16
 
 

@@ -157,18 +157,16 @@ def _pick_window(n: int, g2: bool = False) -> int:
 
 
 def _n_threads() -> int:
-    """MSM worker threads: ZKP2P_NATIVE_THREADS, else the core count —
-    the parallel axis is per-window (rapidsnark's split); on the 1-core
-    build host this resolves to 1 and the code path stays sequential."""
+    """MSM worker threads: the typed config's native_threads
+    (ZKP2P_NATIVE_THREADS), else the core count — the parallel axis is
+    per-window (rapidsnark's split); on the 1-core build host this
+    resolves to 1 and the code path stays sequential."""
     import os
 
-    v = os.environ.get("ZKP2P_NATIVE_THREADS")
-    if v:
-        try:
-            return max(1, int(v))
-        except ValueError:  # malformed value degrades to sequential,
-            return 1  # matching the C++ side's atoi behavior
-    return max(1, os.cpu_count() or 1)
+    from ..utils.config import load_config
+
+    v = load_config().native_threads
+    return v if v else max(1, os.cpu_count() or 1)
 
 
 def prove_native(

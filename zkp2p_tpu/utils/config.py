@@ -1,0 +1,160 @@
+"""One typed configuration for the prover stack (SURVEY.md §5).
+
+Every tuning knob the prover/bench/service read lives HERE, as a frozen
+dataclass with per-field provenance — not as ad-hoc `os.environ` reads
+scattered across modules (VERDICT r4 weak #7: nine+ ZKP2P_*/BENCH_*
+vars steering the tiers, plus a side-file the bench trusted blindly).
+
+Resolution order per knob:
+
+  1. built-in default (the committed, tested configuration),
+  2. `.bench_cache/armed_flags.json` — hardware-A/B-validated winners a
+     tunnel-window session recorded (only the two MSM-tier knobs may be
+     armed this way; anything else in the file is ignored and logged),
+  3. explicit environment variable — always wins (operator intent).
+
+`provenance` records which layer produced each value, so a bench record
+or bug report can say "msm_h=bucket (armed)" instead of guessing.
+
+The environment remains the TRANSPORT (child processes, the C runtime's
+getenv, jit-time module constants) — `apply_env()` writes the resolved
+config back so every consumer, Python or C++, sees one consistent view.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+# knob -> (env var, parser, default) — THE registry; the test asserts
+# every ZKP2P_* read in the tree maps through it.  Parsers REPRODUCE the
+# semantics of the reader each knob steers (they predate this module and
+# other consumers — notably the C runtime — still read the env):
+_BOOL = lambda s: s == "1"  # noqa: E731 — readers compare == "1"
+
+
+def _not_zero(s: str) -> bool:
+    # the C runtime's rule for ZKP2P_NATIVE_IFMA: off ONLY when the
+    # value starts with '0' (csrc ifma_enabled) — "true"/"yes" stay on
+    return not s.startswith("0")
+
+
+def _opt_int(s: str) -> Optional[int]:
+    if not s:
+        return None  # empty string = unset (shell-style), not 1 thread
+    try:
+        return max(1, int(s))
+    except ValueError:
+        # malformed degrades to sequential — matching the C++ runtime's
+        # atoi() on the same variable, so Python- and C-side threading
+        # agree
+        return 1
+
+
+KNOBS: Dict[str, Tuple[str, object, object]] = {
+    # device (XLA/Pallas) prover MSM tiers — see prover.groth16_tpu
+    "msm_window": ("ZKP2P_MSM_WINDOW", int, 4),
+    "msm_signed": ("ZKP2P_MSM_SIGNED", _BOOL, True),
+    "msm_unified": ("ZKP2P_MSM_UNIFIED", str, "auto"),
+    "msm_affine": ("ZKP2P_MSM_AFFINE", str, "0"),
+    "msm_h": ("ZKP2P_MSM_H", str, "windowed"),
+    # device field/curve kernel selection — see field.jfield, curve.jcurve
+    "field_conv": ("ZKP2P_FIELD_CONV", str, "matmul"),
+    "field_mul": ("ZKP2P_FIELD_MUL", str, "auto"),
+    "curve_kernel": ("ZKP2P_CURVE_KERNEL", str, "auto"),
+    # native (C++) runtime
+    "native_ifma": ("ZKP2P_NATIVE_IFMA", _not_zero, True),
+    "native_threads": ("ZKP2P_NATIVE_THREADS", _opt_int, None),
+    # compilation-cache opt-out (read by tests/conftest.py at process
+    # start as well — the env var is authoritative there by necessity)
+    "no_cache": ("ZKP2P_NO_CACHE", _BOOL, False),
+}
+
+# The ONLY knobs a hardware-session side-file may arm (bench.py's
+# whitelist, promoted here so there is a single list).
+ARMABLE = ("msm_affine", "msm_h")
+_ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
+
+
+@dataclass(frozen=True)
+class ProverConfig:
+    msm_window: int = 4
+    msm_signed: bool = True
+    msm_unified: str = "auto"
+    msm_affine: str = "0"
+    msm_h: str = "windowed"
+    field_conv: str = "matmul"
+    field_mul: str = "auto"
+    curve_kernel: str = "auto"
+    native_ifma: bool = True
+    native_threads: Optional[int] = None
+    no_cache: bool = False
+    # knob -> "default" | "armed" | "env"
+    provenance: Dict[str, str] = field(default_factory=dict, compare=False)
+
+    def describe(self) -> str:
+        return " ".join(
+            f"{k}={getattr(self, k)}({self.provenance.get(k, 'default')})" for k in KNOBS
+        )
+
+    def apply_env(self, environ=None) -> None:
+        """Write the resolved values back into the environment so child
+        processes, import-time module constants, and the C runtime's
+        getenv() all see the same configuration."""
+        env = os.environ if environ is None else environ
+        for attr, (var, _parse, _default) in KNOBS.items():
+            v = getattr(self, attr)
+            if v is None:
+                env.pop(var, None)
+            elif isinstance(v, bool):
+                env[var] = "1" if v else "0"
+            else:
+                env[var] = str(v)
+
+
+# the registry and the dataclass must never drift: a retuned default in
+# one place only is an import-time error, not a silent divergence
+for _attr, (_var, _parse, _default) in KNOBS.items():
+    assert ProverConfig.__dataclass_fields__[_attr].default == _default, (
+        f"default drift for {_attr}: KNOBS says {_default!r}, "
+        f"ProverConfig says {ProverConfig.__dataclass_fields__[_attr].default!r}"
+    )
+
+
+def load_config(
+    environ=None,
+    armed_flags_path: Optional[str] = None,
+    log=None,
+) -> ProverConfig:
+    """Resolve the full configuration (default -> armed -> env)."""
+    env = os.environ if environ is None else environ
+    values: Dict[str, object] = {k: default for k, (_v, _p, default) in KNOBS.items()}
+    prov: Dict[str, str] = {k: "default" for k in KNOBS}
+
+    if armed_flags_path and os.path.exists(armed_flags_path):
+        try:
+            with open(armed_flags_path) as f:
+                flags = json.load(f)
+        except Exception as e:  # noqa: BLE001 — arming is best-effort
+            flags = {}
+            if log:
+                log(f"armed flags unreadable: {e}")
+        for var, raw in flags.items():
+            if var not in _ARMABLE_ENV:
+                if log:
+                    log(f"armed flags: ignoring non-armable key {var!r}")
+                continue
+            for attr, (v, parse, _d) in KNOBS.items():
+                if v == var:
+                    values[attr] = parse(str({True: "1", False: "0"}.get(raw, raw)))
+                    prov[attr] = "armed"
+
+    for attr, (var, parse, _default) in KNOBS.items():
+        raw = env.get(var)
+        if raw is not None:
+            values[attr] = parse(raw)
+            prov[attr] = "env"
+
+    return ProverConfig(provenance=prov, **values)
