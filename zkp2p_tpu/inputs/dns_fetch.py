@@ -1,0 +1,96 @@
+"""DKIM key fetch over DNS/DoH, with registry fallback.
+
+The reference resolves `selector._domainkey.domain TXT` at run time —
+DNS-over-HTTPS in the browser, node `dns.resolve` locally
+(`app/src/helpers/dkim/tools.js:261-283`) — and keeps hardcoded values
+for offline use (`tools.js:284-286`).  This is that seam made explicit:
+
+  fetch_dkim_modulus(domain, selector, resolver=..., registry=...)
+
+`resolver` is any callable `qname -> list of TXT strings` — the
+injectable boundary (tests use a mock; production can plug a DoH
+client).  The default `doh_resolver` speaks RFC 8484-adjacent JSON
+(Google/Cloudflare `?name=...&type=TXT` shape) through urllib; in the
+zero-egress build environment it simply raises and the registry answers,
+which is exactly the reference's offline path.
+
+TXT parsing follows RFC 6376 §3.6.1: semicolon-separated tags, `p=` the
+base64 SPKI (whitespace/quote tolerant, the `tools.js` normalization),
+`k=rsa` (default) the only supported key type here.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import urllib.parse
+import urllib.request
+from typing import Callable, List, Optional
+
+from .dkim import KeyRegistry
+from .known_keys import _modulus_from_spki_b64, default_registry
+
+Resolver = Callable[[str], List[str]]
+
+DOH_ENDPOINT = "https://dns.google/resolve"  # ?name=<qname>&type=TXT
+
+
+def doh_resolver(qname: str, endpoint: str = DOH_ENDPOINT, timeout: float = 5.0) -> List[str]:
+    """TXT lookup over DNS-over-HTTPS (JSON API shape).  Raises on any
+    network/parse failure — callers fall back to the registry."""
+    url = f"{endpoint}?name={urllib.parse.quote(qname)}&type=TXT"
+    req = urllib.request.Request(url, headers={"accept": "application/dns-json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = json.loads(resp.read().decode())
+    answers = body.get("Answer") or []
+    return [a.get("data", "") for a in answers if a.get("type") == 16]
+
+
+def parse_dkim_txt(txt: str) -> Optional[int]:
+    """One TXT record -> RSA modulus, or None if it is not a usable
+    DKIM1 rsa key record.  Mirrors the tools.js normalization: strip
+    whitespace and quote characters (TXT strings arrive chunked and
+    quoted), then tag-parse."""
+    cleaned = re.sub(r"\s+", "", txt).replace('"', "")
+    tags = {}
+    for part in cleaned.split(";"):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            tags[k.strip().lower()] = v.strip()
+    if tags.get("v", "DKIM1") != "DKIM1":
+        return None
+    if tags.get("k", "rsa") != "rsa":
+        return None
+    p = tags.get("p")
+    if not p:  # empty p= means a revoked key (RFC 6376 §3.6.1)
+        return None
+    try:
+        return _modulus_from_spki_b64(p)
+    except Exception:  # noqa: BLE001 — malformed SPKI == unusable record
+        return None
+
+
+def fetch_dkim_modulus(
+    domain: str,
+    selector: str,
+    resolver: Optional[Resolver] = None,
+    registry: Optional[KeyRegistry] = None,
+    min_bits: int = 1024,
+) -> Optional[int]:
+    """The DNS-with-registry-fallback key lookup (`getPublicKey`,
+    tools.js:261-283): try the resolver; on failure or no usable record,
+    answer from the registry.  A resolved key shorter than `min_bits`
+    is rejected (the reference's minBitLength gate)."""
+    qname = f"{selector}._domainkey.{domain}"
+    res = resolver if resolver is not None else doh_resolver
+    try:
+        for txt in res(qname):
+            mod = parse_dkim_txt(txt)
+            if mod is not None:
+                if mod.bit_length() < min_bits:
+                    continue  # too-short key: keep looking / fall back
+                return mod
+    except Exception:  # noqa: BLE001 — resolver failure -> offline path
+        pass
+    reg = registry if registry is not None else default_registry()
+    return reg.get(domain, selector)
