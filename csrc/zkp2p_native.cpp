@@ -781,6 +781,7 @@ struct Ifma52Field {
   u64 r260sq[5];   // 2^520 mod p (std -> mont260 via one mont260 mul)
   u64 c256[5];     // 2^256 mod p (mont260 -> mont256 carrier)
   u64 c264[5];     // 2^264 mod p (mont256 -> mont260 carrier)
+  u64 compp[5];    // 2^260 - p (complement for the canonical fold)
 };
 
 static void limbs4_to_52(u64 out[5], const u64 a[4]) {
@@ -845,6 +846,13 @@ static void ifma52_init(Ifma52Field &F, const u64 p4[4], u64 pinv64,
   for (int j = 0; j < 5; ++j) {
     u64 s = ((~F.p2_52[j]) & M52) + c2;
     F.comp2p[j] = s & M52;
+    c2 = s >> 52;
+  }
+  // compp = 2^260 - p (canonical fold: subtract p when >= p)
+  c2 = 1;
+  for (int j = 0; j < 5; ++j) {
+    u64 s = ((~F.p52[j]) & M52) + c2;
+    F.compp[j] = s & M52;
     c2 = s >> 52;
   }
   // 2^520 mod p by 520 reducing doublings of 1, snapshotting the
@@ -945,16 +953,16 @@ static inline void mont52_mul8(__m512i out[5], const __m512i a[5],
   out[4] = t4;  // < 2^52 (result < 2p < 2^255)
 }
 
-// conditional fold by 2p: in < 4p (limbs normalized), out < 2p.
-static inline void cond_sub_2p8(__m512i v[5], const __m512i comp2p[5]) {
+// conditional fold by an arbitrary complement (2^260 - M): subtract M
+// when v >= M.  Used with comp2p (lazy fold) and compp (canonical fold).
+static inline void cond_sub_c8(__m512i v[5], const __m512i comp[5]) {
   const __m512i m52 = _mm512_set1_epi64((long long)M52);
   __m512i u[5], c = _mm512_setzero_si512();
   for (int j = 0; j < 5; ++j) {
-    __m512i s = _mm512_add_epi64(_mm512_add_epi64(v[j], comp2p[j]), c);
+    __m512i s = _mm512_add_epi64(_mm512_add_epi64(v[j], comp[j]), c);
     u[j] = _mm512_and_si512(s, m52);
     c = _mm512_srli_epi64(s, 52);
   }
-  // carry-out of the top limb <=> v >= 2p <=> keep the subtracted value
   __mmask8 ge = _mm512_cmpneq_epu64_mask(c, _mm512_setzero_si512());
   for (int j = 0; j < 5; ++j) v[j] = _mm512_mask_blend_epi64(ge, v[j], u[j]);
 }
@@ -969,7 +977,7 @@ static inline void add_lazy8(__m512i out[5], const __m512i u[5],
     out[j] = _mm512_and_si512(s, m52);
     c = _mm512_srli_epi64(s, 52);
   }
-  cond_sub_2p8(out, comp2p);
+  cond_sub_c8(out, comp2p);
 }
 
 // v' = u - t + 2p (mod lazy 2p).
@@ -986,7 +994,7 @@ static inline void sub_lazy8(__m512i out[5], const __m512i u[5],
     out[j] = _mm512_and_si512(s, m52);
     c = _mm512_srli_epi64(s, 52);
   }
-  cond_sub_2p8(out, comp2p);
+  cond_sub_c8(out, comp2p);
 }
 
 // -------- per-stage twiddle tables (mont260, SoA planes, contiguous j)
@@ -1278,6 +1286,447 @@ static void g1_chunk_apply_ifma(const u64 (*x1a)[4], const u64 (*y1a)[4],
     while (geq(o, P)) sub_nored(o, o, P);
     memcpy(y3a[j], o, 32);
   }
+}
+
+// -------- persistent 52-limb mont260 MSM storage (G1)
+//
+// Bases and buckets live in 5x52-limb mont260 form for the WHOLE MSM:
+// the chunk apply loses its six carrier-conversion vector muls per
+// block and all per-add limb-shift packing — conversion happens once
+// per MSM (bases, vectorized) and once per bucket at reduction time.
+// Components are kept CANONICAL (< p) so memcmp equality (doubling /
+// cancellation detection) still works.
+
+struct Aff52 {
+  u64 x[5], y[5];  // canonical mont260; all-zero = infinity/empty
+};
+
+static void fold52_canonical(u64 v[5], const Ifma52Field &F);
+
+// y -> p - y over canonical 52-limb components (the signed-digit negation).
+static inline void neg52(u64 out[5], const u64 y[5], const Ifma52Field &F) {
+  bool z = true;
+  for (int j = 0; j < 5 && z; ++j) z = y[j] == 0;
+  if (z) {
+    memset(out, 0, 40);
+    return;
+  }
+  u64 borrow = 0;
+  for (int j = 0; j < 5; ++j) {
+    u64 yb = y[j] + borrow;  // <= 2^52, no overflow
+    if (F.p52[j] >= yb) {
+      out[j] = F.p52[j] - yb;
+      borrow = 0;
+    } else {
+      out[j] = (F.p52[j] + (1ULL << 52)) - yb;
+      borrow = 1;
+    }
+  }
+}
+
+// mont256 affine pairs -> canonical mont260 Aff52, 8 points per step.
+static void g1_bases_to_52(const u64 *bases_xy, long n, Aff52 *out) {
+  Ifma52Field &F = fq52_field();
+  __m512i p[5], c264v[5], comppv[5];
+  for (int k = 0; k < 5; ++k) {
+    p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+    c264v[k] = _mm512_set1_epi64((long long)F.c264[k]);
+    comppv[k] = _mm512_set1_epi64((long long)F.compp[k]);
+  }
+  const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+  long i = 0;
+  for (; i + 8 <= n; i += 8) {
+    u64 xv[5][8], yv[5][8];
+    for (int l = 0; l < 8; ++l) {
+      u64 t[5];
+      limbs4_to_52(t, bases_xy + 8 * (i + l));
+      for (int k = 0; k < 5; ++k) xv[k][l] = t[k];
+      limbs4_to_52(t, bases_xy + 8 * (i + l) + 4);
+      for (int k = 0; k < 5; ++k) yv[k][l] = t[k];
+    }
+    __m512i X[5], Y[5];
+    for (int k = 0; k < 5; ++k) {
+      X[k] = _mm512_loadu_si512(xv[k]);
+      Y[k] = _mm512_loadu_si512(yv[k]);
+    }
+    __m512i Xm[5], Ym[5];
+    mont52_mul8(Xm, X, c264v, p, pinv);
+    cond_sub_c8(Xm, comppv);
+    mont52_mul8(Ym, Y, c264v, p, pinv);
+    cond_sub_c8(Ym, comppv);
+    u64 ox[5][8], oy[5][8];
+    for (int k = 0; k < 5; ++k) {
+      _mm512_storeu_si512(ox[k], Xm[k]);
+      _mm512_storeu_si512(oy[k], Ym[k]);
+    }
+    for (int l = 0; l < 8; ++l) {
+      for (int k = 0; k < 5; ++k) {
+        out[i + l].x[k] = ox[k][l];
+        out[i + l].y[k] = oy[k][l];
+      }
+    }
+  }
+  for (; i < n; ++i) {
+    u64 t[5], m260[5];
+    limbs4_to_52(t, bases_xy + 8 * i);
+    mont52_mul_scalar(m260, t, F.c264, F);
+    fold52_canonical(m260, F);
+    memcpy(out[i].x, m260, 40);
+    limbs4_to_52(t, bases_xy + 8 * i + 4);
+    mont52_mul_scalar(m260, t, F.c264, F);
+    fold52_canonical(m260, F);
+    memcpy(out[i].y, m260, 40);
+  }
+}
+
+// canonical fold of a < 2p 52-limb value (scalar path).
+static void fold52_canonical(u64 v[5], const Ifma52Field &F) {
+  bool ge = true;
+  for (int j = 4; j >= 0; --j) {
+    if (v[j] != F.p52[j]) {
+      ge = v[j] > F.p52[j];
+      break;
+    }
+  }
+  if (!ge) return;
+  u64 borrow = 0;
+  for (int j = 0; j < 5; ++j) {
+    u64 pb = F.p52[j] + borrow;
+    if (v[j] >= pb) {
+      v[j] -= pb;
+      borrow = 0;
+    } else {
+      v[j] = (v[j] + (1ULL << 52)) - pb;
+      borrow = 1;
+    }
+  }
+}
+
+// canonical mont260 component -> canonical mont256 u64x4.
+static void limb52_to_mont256(const u64 a[5], u64 out[4], const Ifma52Field &F) {
+  u64 t[5];
+  mont52_mul_scalar(t, a, F.c256, F);
+  limbs52_to_4(out, t);
+  while (geq(out, P)) sub_nored(out, out, P);
+}
+
+// The 52-native chunk apply: same pipeline as g1_chunk_apply_ifma but
+// with NO carrier conversions and NO limb-shift packing — stashes are
+// already 5-limb mont260 canonical.  Outputs canonical.
+// buf: 8 x 5 x roundup8(m) u64 scratch (den,num,x1,y1,x2,prod,x3,y3 —
+// y2 is loaded per block straight from its AoS stash).
+static void g1_chunk_apply_52(const u64 (*x1a)[5], const u64 (*y1a)[5],
+                              const u64 (*x2a)[5], const u64 (*y2a)[5],
+                              const unsigned char *dbl, long m,
+                              u64 (*x3a)[5], u64 (*y3a)[5], u64 *buf) {
+  Ifma52Field &F = fq52_field();
+  const long nblk = (m + 7) / 8, N = nblk * 8;
+  u64 *d52 = buf, *n52 = buf + (size_t)5 * N, *x152 = buf + (size_t)10 * N,
+      *y152 = buf + (size_t)15 * N, *x252 = buf + (size_t)20 * N,
+      *pr52 = buf + (size_t)25 * N, *x352 = buf + (size_t)30 * N,
+      *y352 = buf + (size_t)35 * N;
+  u64 one52[5] = {1, 0, 0, 0, 0}, one260[5];
+  mont52_mul_scalar(one260, one52, F.r260sq, F);
+  // transpose AoS -> SoA (pure copies)
+  auto pack5 = [&](const u64 (*src)[5], u64 *dst) {
+    for (long j = 0; j < N; ++j) {
+      const u64 *s = j < m ? src[j] : one52;  // pad value irrelevant except den
+      for (int k = 0; k < 5; ++k) dst[(size_t)k * N + j] = j < m ? s[k] : 0;
+    }
+  };
+  pack5(x1a, x152);
+  pack5(y1a, y152);
+  pack5(x2a, x252);
+  // y2 goes straight into the num derivation below (no plane kept)
+
+  __m512i p[5], p2[5], comp2p[5], comppv[5];
+  for (int k = 0; k < 5; ++k) {
+    p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+    p2[k] = _mm512_set1_epi64((long long)F.p2_52[k]);
+    comp2p[k] = _mm512_set1_epi64((long long)F.comp2p[k]);
+    comppv[k] = _mm512_set1_epi64((long long)F.compp[k]);
+  }
+  const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+  for (long t = 0; t < nblk; ++t) {
+    __m512i x1v[5], y1v[5], x2v[5], y2v[5];
+    for (int k = 0; k < 5; ++k) {
+      x1v[k] = _mm512_loadu_si512(x152 + (size_t)k * N + t * 8);
+      y1v[k] = _mm512_loadu_si512(y152 + (size_t)k * N + t * 8);
+      x2v[k] = _mm512_loadu_si512(x252 + (size_t)k * N + t * 8);
+    }
+    {
+      u64 y2v8[5][8];
+      for (int l = 0; l < 8; ++l) {
+        long j = t * 8 + l;
+        for (int k = 0; k < 5; ++k) y2v8[k][l] = j < m ? y2a[j][k] : 0;
+      }
+      for (int k = 0; k < 5; ++k) y2v[k] = _mm512_loadu_si512(y2v8[k]);
+    }
+    __m512i denv[5], numv[5];
+    sub_lazy8(denv, x2v, x1v, p2, comp2p);
+    sub_lazy8(numv, y2v, y1v, p2, comp2p);
+    unsigned char dm = 0;
+    for (int l = 0; l < 8 && t * 8 + l < m; ++l)
+      if (dbl[t * 8 + l]) dm |= (unsigned char)(1u << l);
+    if (dm) {
+      __m512i x1sq[5], numd[5], dend[5];
+      mont52_mul8(x1sq, x1v, x1v, p, pinv);
+      add_lazy8(numd, x1sq, x1sq, comp2p);
+      add_lazy8(numd, numd, x1sq, comp2p);
+      add_lazy8(dend, y1v, y1v, comp2p);
+      const __mmask8 kk = (__mmask8)dm;
+      for (int q = 0; q < 5; ++q) {
+        denv[q] = _mm512_mask_blend_epi64(kk, denv[q], dend[q]);
+        numv[q] = _mm512_mask_blend_epi64(kk, numv[q], numd[q]);
+      }
+    }
+    if (t == nblk - 1 && m < N) {
+      __mmask8 padk = (__mmask8)(0xFFu << (m & 7));
+      for (int q = 0; q < 5; ++q)
+        denv[q] = _mm512_mask_blend_epi64(
+            padk, denv[q], _mm512_set1_epi64((long long)one260[q]));
+    }
+    for (int k = 0; k < 5; ++k) {
+      _mm512_storeu_si512(d52 + (size_t)k * N + t * 8, denv[k]);
+      _mm512_storeu_si512(n52 + (size_t)k * N + t * 8, numv[k]);
+    }
+  }
+  // phase A: lane-strided prefix products
+  __m512i run[5];
+  for (int k = 0; k < 5; ++k) run[k] = _mm512_set1_epi64((long long)one260[k]);
+  for (long t = 0; t < nblk; ++t) {
+    __m512i dv[5];
+    for (int k = 0; k < 5; ++k) {
+      _mm512_storeu_si512(pr52 + (size_t)k * N + t * 8, run[k]);
+      dv[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
+    }
+    mont52_mul8(run, run, dv, p, pinv);
+  }
+  u64 tl8[5][8];
+  for (int k = 0; k < 5; ++k) _mm512_storeu_si512(tl8[k], run[k]);
+  u64 T4[8][4];
+  for (int l = 0; l < 8; ++l) {
+    u64 t52[5];
+    for (int k = 0; k < 5; ++k) t52[k] = tl8[k][l];
+    limb52_to_mont256(t52, T4[l], F);
+  }
+  u64 pre8[8][4], G[4], Ginv[4], suf[4], Tinv[8][4];
+  memcpy(pre8[0], ONE_MONT, 32);
+  for (int l = 1; l < 8; ++l) mont_mul(pre8[l], pre8[l - 1], T4[l - 1]);
+  mont_mul(G, pre8[7], T4[7]);
+  mont_inv(Ginv, G);
+  memcpy(suf, Ginv, 32);
+  for (int l = 7; l >= 0; --l) {
+    mont_mul(Tinv[l], suf, pre8[l]);
+    mont_mul(suf, suf, T4[l]);
+  }
+  __m512i inv_run[5];
+  {
+    u64 ir8[5][8];
+    for (int l = 0; l < 8; ++l) {
+      u64 t52[5], t260[5];
+      limbs4_to_52(t52, Tinv[l]);
+      mont52_mul_scalar(t260, t52, F.c264, F);
+      for (int k = 0; k < 5; ++k) ir8[k][l] = t260[k];
+    }
+    for (int k = 0; k < 5; ++k) inv_run[k] = _mm512_loadu_si512(ir8[k]);
+  }
+  // phase B backwards
+  for (long t = nblk - 1; t >= 0; --t) {
+    __m512i prv[5], dv[5], nv[5], x1v[5], y1v[5], x2v[5];
+    for (int k = 0; k < 5; ++k) {
+      prv[k] = _mm512_loadu_si512(pr52 + (size_t)k * N + t * 8);
+      dv[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
+      nv[k] = _mm512_loadu_si512(n52 + (size_t)k * N + t * 8);
+      x1v[k] = _mm512_loadu_si512(x152 + (size_t)k * N + t * 8);
+      y1v[k] = _mm512_loadu_si512(y152 + (size_t)k * N + t * 8);
+      x2v[k] = _mm512_loadu_si512(x252 + (size_t)k * N + t * 8);
+    }
+    __m512i dinv[5], lam[5], lam2[5], x3[5], tt[5], yy[5], y3[5];
+    mont52_mul8(dinv, inv_run, prv, p, pinv);
+    mont52_mul8(inv_run, inv_run, dv, p, pinv);
+    mont52_mul8(lam, nv, dinv, p, pinv);
+    mont52_mul8(lam2, lam, lam, p, pinv);
+    sub_lazy8(x3, lam2, x1v, p2, comp2p);
+    sub_lazy8(x3, x3, x2v, p2, comp2p);
+    sub_lazy8(tt, x1v, x3, p2, comp2p);
+    mont52_mul8(yy, lam, tt, p, pinv);
+    sub_lazy8(y3, yy, y1v, p2, comp2p);
+    // canonical fold for the memcmp-equality contract
+    cond_sub_c8(x3, comppv);
+    cond_sub_c8(y3, comppv);
+    for (int k = 0; k < 5; ++k) {
+      _mm512_storeu_si512(x352 + (size_t)k * N + t * 8, x3[k]);
+      _mm512_storeu_si512(y352 + (size_t)k * N + t * 8, y3[k]);
+    }
+  }
+  for (long j = 0; j < m; ++j) {
+    for (int k = 0; k < 5; ++k) {
+      x3a[j][k] = x352[(size_t)k * N + j];
+      y3a[j][k] = y352[(size_t)k * N + j];
+    }
+  }
+}
+
+static inline bool aff52_is_zero(const u64 a[5]) {
+  return !(a[0] | a[1] | a[2] | a[3] | a[4]);
+}
+
+// defined later in this file (shared with the non-IFMA tiers)
+static void g1_window_sum_jac(const u64 *bases_xy, const int32_t *sd, long n,
+                              int c, int nwin, int wi, G1Jac *out);
+static inline void signed_pt_y(u64 out[4], const u64 y[4], bool negate);
+
+// 52-native batch-affine window fill: buckets AND bases in mont260
+// 52-limb form.  `bases_xy` (mont256) is still taken for the Jacobian
+// bail tier.
+static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
+                             const int32_t *sd, long n, int c, int nwin,
+                             int wi, G1Jac *out) {
+  Ifma52Field &F = fq52_field();
+  const long nbuckets = (1L << (c - 1)) + 1;
+  const long B = 2048;
+  int bits_here = 254 - wi * c;
+  if (bits_here > c) bits_here = c;
+  if (bits_here < 1 || (1L << bits_here) < 4 * B) {
+    g1_window_sum_jac(bases_xy, sd, n, c, nwin, wi, out);
+    return;
+  }
+  Aff52 *bk = new Aff52[nbuckets]();
+  int *stamp = new int[nbuckets];
+  memset(stamp, 0xff, nbuckets * sizeof(int));
+  std::vector<long> cur, next;
+  cur.reserve(n);
+  for (long i = 0; i < n; ++i) {
+    if (!sd[i * nwin + wi]) continue;
+    if (aff52_is_zero(b52[i].x) && aff52_is_zero(b52[i].y)) continue;
+    cur.push_back(i);
+  }
+  long *add_bkt = new long[B];
+  u64 (*x1a)[5] = new u64[B][5];
+  u64 (*y1a)[5] = new u64[B][5];
+  u64 (*x2a)[5] = new u64[B][5];
+  u64 (*y2a)[5] = new u64[B][5];
+  u64 (*x3a)[5] = new u64[B][5];
+  u64 (*y3a)[5] = new u64[B][5];
+  unsigned char *dbl = new unsigned char[B];
+  u64 *scratch = new u64[(size_t)8 * 5 * B];
+  auto cleanup = [&]() {
+    delete[] bk;
+    delete[] stamp;
+    delete[] add_bkt;
+    delete[] x1a;
+    delete[] y1a;
+    delete[] x2a;
+    delete[] y2a;
+    delete[] x3a;
+    delete[] y3a;
+    delete[] dbl;
+    delete[] scratch;
+  };
+  int chunk_id = 0;
+  while (!cur.empty()) {
+    next.clear();
+    size_t processed = 0;
+    bool bail = false;
+    for (size_t lo = 0; lo < cur.size() && !bail; lo += B, ++chunk_id) {
+      size_t hi = lo + B < cur.size() ? lo + B : cur.size();
+      long m = 0;
+      for (size_t k = lo; k < hi; ++k) {
+        long i = cur[k];
+        int32_t dgt = sd[i * nwin + wi];
+        long bno = dgt < 0 ? -dgt : dgt;
+        if (stamp[bno] == chunk_id) {
+          next.push_back(i);
+          continue;
+        }
+        stamp[bno] = chunk_id;
+        u64 py[5];
+        if (dgt < 0) {
+          neg52(py, b52[i].y, F);
+        } else {
+          memcpy(py, b52[i].y, 40);
+        }
+        if (aff52_is_zero(bk[bno].x) && aff52_is_zero(bk[bno].y)) {
+          memcpy(bk[bno].x, b52[i].x, 40);
+          memcpy(bk[bno].y, py, 40);
+          continue;
+        }
+        if (memcmp(bk[bno].x, b52[i].x, 40) == 0) {
+          if (memcmp(bk[bno].y, py, 40) == 0) {
+            dbl[m] = 1;
+          } else {
+            memset(&bk[bno], 0, sizeof(Aff52));  // P + (-P)
+            continue;
+          }
+        } else {
+          dbl[m] = 0;
+        }
+        memcpy(x1a[m], bk[bno].x, 40);
+        memcpy(y1a[m], bk[bno].y, 40);
+        memcpy(x2a[m], b52[i].x, 40);
+        memcpy(y2a[m], py, 40);
+        add_bkt[m] = bno;
+        ++m;
+      }
+      processed = hi;
+      if (!m) {
+        if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
+        continue;
+      }
+      g1_chunk_apply_52(x1a, y1a, x2a, y2a, dbl, m, x3a, y3a, scratch);
+      for (long j = 0; j < m; ++j) {
+        memcpy(bk[add_bkt[j]].x, x3a[j], 40);
+        memcpy(bk[add_bkt[j]].y, y3a[j], 40);
+      }
+      if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
+    }
+    if (bail || next.size() * 4 > cur.size()) {
+      G1Jac *jb = new G1Jac[nbuckets];
+      memset(jb, 0, (size_t)nbuckets * sizeof(G1Jac));
+      next.insert(next.end(), cur.begin() + processed, cur.end());
+      for (long i : next) {
+        int32_t dgt = sd[i * nwin + wi];
+        long bno = dgt < 0 ? -dgt : dgt;
+        const u64 *x = bases_xy + 8 * i;
+        u64 ys[4];
+        signed_pt_y(ys, x + 4, dgt < 0);
+        jac_add_mixed(jb[bno], jb[bno], x, ys);
+      }
+      G1Jac run, wsum;
+      memset(&run, 0, sizeof(run));
+      memset(&wsum, 0, sizeof(wsum));
+      for (long d = nbuckets - 1; d >= 1; --d) {
+        g1_add_jac(run, jb[d]);
+        if (!(aff52_is_zero(bk[d].x) && aff52_is_zero(bk[d].y))) {
+          u64 bx[4], by[4];
+          limb52_to_mont256(bk[d].x, bx, F);
+          limb52_to_mont256(bk[d].y, by, F);
+          jac_add_mixed(run, run, bx, by);
+        }
+        g1_add_jac(wsum, run);
+      }
+      delete[] jb;
+      cleanup();
+      *out = wsum;
+      return;
+    }
+    cur.swap(next);
+  }
+  G1Jac run, wsum;
+  memset(&run, 0, sizeof(run));
+  memset(&wsum, 0, sizeof(wsum));
+  for (long d = nbuckets - 1; d >= 1; --d) {
+    if (!(aff52_is_zero(bk[d].x) && aff52_is_zero(bk[d].y))) {
+      u64 bx[4], by[4];
+      limb52_to_mont256(bk[d].x, bx, F);
+      limb52_to_mont256(bk[d].y, by, F);
+      jac_add_mixed(run, run, bx, by);
+    }
+    g1_add_jac(wsum, run);
+  }
+  cleanup();
+  *out = wsum;
 }
 
 // ---- Fq2 vector helpers (u^2 = -1): componentwise lazy-domain ops on
@@ -2679,9 +3128,27 @@ void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
     int32_t *sd = new int32_t[(size_t)nr * nwin];
     for (long i = 0; i < nr; ++i) signed_digits(ps + 4 * i, c, nwin, sd + (size_t)i * nwin);
     G1Jac *wins = new G1Jac[nwin];
+#if ZKP2P_HAVE_IFMA
+    Aff52 *b52 = nullptr;
+    if (ifma_enabled()) {
+      // one mont256 -> mont260 conversion per MSM; every window's fill
+      // then runs conversion-free (persistent 52-limb storage)
+      b52 = new Aff52[nr];
+      g1_bases_to_52(pb, nr, b52);
+    }
+#endif
     run_window_sums(nwin, n_threads, wins, [&](int wi, G1Jac *o) {
+#if ZKP2P_HAVE_IFMA
+      if (b52) {
+        g1_window_sum_52(pb, b52, sd, nr, c, nwin, wi, o);
+        return;
+      }
+#endif
       g1_window_sum(pb, sd, nr, c, nwin, wi, o);
     });
+#if ZKP2P_HAVE_IFMA
+    delete[] b52;
+#endif
     delete[] sd;
     for (int wi = nwin - 1; wi >= 0; --wi) {
       if (wi != nwin - 1)
