@@ -1073,8 +1073,9 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
     comp2p[k] = _mm512_set1_epi64((long long)F.comp2p[k]);
   }
   const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
-  int stage = 0;
-  for (long len = 16; len <= m; len <<= 1, ++stage) {
+  // One radix-2 vector stage (the generic building block, and the odd
+  // leading stage when the vector-stage count is odd).
+  auto radix2_stage = [&](long len, int stage) {
     const long half = len >> 1;
     const u64 *twp = T.buf.get() + T.offsets[stage];
     for (long i0 = 0; i0 < m; i0 += len) {
@@ -1091,6 +1092,62 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
         for (int k = 0; k < 5; ++k) {
           _mm512_storeu_si512(soa + (size_t)k * m + i0 + j, un[k]);
           _mm512_storeu_si512(soa + (size_t)k * m + i0 + j + half, vn[k]);
+        }
+      }
+    }
+  };
+  // Radix-4 fusion of stage pairs (len, 2len): same 4 Montgomery muls
+  // per 4 elements as two radix-2 passes, but ONE load/store pass over
+  // the SoA planes instead of two — the stages are memory-bound at
+  // these sizes.  Twiddles come straight from the existing per-stage
+  // radix-2 tables: stage len's w^j plus stage 2len's w^j and w^{j+q}.
+  int n_vstages = 0;
+  for (long len = 16; len <= m; len <<= 1) ++n_vstages;
+  int stage = 0;
+  long len = 16;
+  if (n_vstages & 1) {
+    radix2_stage(len, stage);
+    ++stage;
+    len <<= 1;
+  }
+  for (; len * 2 <= m; len <<= 2, stage += 2) {
+    const long L = 2 * len;   // fused block size
+    const long q = len >> 1;  // quarter
+    const u64 *tw1p = T.buf.get() + T.offsets[stage];      // stage len: q entries
+    const u64 *tw2p = T.buf.get() + T.offsets[stage + 1];  // stage 2len: 2q entries
+    for (long i0 = 0; i0 < m; i0 += L) {
+      for (long j = 0; j < q; j += 8) {
+        __m512i a[5], b[5], c[5], d[5], w1[5], w2[5], w2q[5];
+        for (int k = 0; k < 5; ++k) {
+          a[k] = _mm512_loadu_si512(soa + (size_t)k * m + i0 + j);
+          b[k] = _mm512_loadu_si512(soa + (size_t)k * m + i0 + j + q);
+          c[k] = _mm512_loadu_si512(soa + (size_t)k * m + i0 + j + 2 * q);
+          d[k] = _mm512_loadu_si512(soa + (size_t)k * m + i0 + j + 3 * q);
+          w1[k] = _mm512_loadu_si512(tw1p + (size_t)k * q + j);
+          w2[k] = _mm512_loadu_si512(tw2p + (size_t)k * (2 * q) + j);
+          w2q[k] = _mm512_loadu_si512(tw2p + (size_t)k * (2 * q) + j + q);
+        }
+        __m512i t1[5], t2[5], a1[5], b1[5], c1[5], d1[5];
+        // stage len: (a,b) and (c,d) with twiddle w1
+        mont52_mul8(t1, b, w1, p, pinv);
+        mont52_mul8(t2, d, w1, p, pinv);
+        add_lazy8(a1, a, t1, comp2p);
+        sub_lazy8(b1, a, t1, p2, comp2p);
+        add_lazy8(c1, c, t2, comp2p);
+        sub_lazy8(d1, c, t2, p2, comp2p);
+        // stage 2len: (a1,c1) with w2[j], (b1,d1) with w2[j+q]
+        __m512i u1[5], u2[5], o0[5], o1[5], o2[5], o3[5];
+        mont52_mul8(u1, c1, w2, p, pinv);
+        mont52_mul8(u2, d1, w2q, p, pinv);
+        add_lazy8(o0, a1, u1, comp2p);
+        sub_lazy8(o2, a1, u1, p2, comp2p);
+        add_lazy8(o1, b1, u2, comp2p);
+        sub_lazy8(o3, b1, u2, p2, comp2p);
+        for (int k = 0; k < 5; ++k) {
+          _mm512_storeu_si512(soa + (size_t)k * m + i0 + j, o0[k]);
+          _mm512_storeu_si512(soa + (size_t)k * m + i0 + j + q, o1[k]);
+          _mm512_storeu_si512(soa + (size_t)k * m + i0 + j + 2 * q, o2[k]);
+          _mm512_storeu_si512(soa + (size_t)k * m + i0 + j + 3 * q, o3[k]);
         }
       }
     }
