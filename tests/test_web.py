@@ -121,3 +121,110 @@ def test_wrong_wallet_signature_is_rejected(server):
             raise AssertionError("expected 403")
         except urllib.error.HTTPError as e:
             assert e.code == 403
+
+
+def test_orders_paging(server):
+    """MainPage-style paging: offset/limit envelope with total."""
+    base, app = server
+    for i in range(5):
+        _post(base, "/api/orders", {"address": f"on{i}", "signature": f"s{i}", "amount": 1000 + i, "max_amount_to_pay": 2000})
+    page = _get(base, "/api/orders?offset=1&limit=2")
+    assert page["total"] == 5 and page["offset"] == 1
+    assert [r["amount"] for r in page["orders"]] == [1001, 1002]
+    # legacy bare-list shape preserved when unpaged
+    assert len(_get(base, "/api/orders")) == 5
+
+
+def test_meta_registry(server):
+    """Chain-glue registry: the contract constants a client binds to."""
+    base, app = server
+    meta = _get(base, "/api/meta")
+    assert meta["ramp_address"] == app.ramp.address
+    assert meta["max_amount_usdc"] == 100_000_000
+    assert len(meta["venmo_rsa_limbs"]) == 17
+    assert meta["msg_len"] == 26
+    assert meta["prover_loaded"] is False
+    assert "onRamp(" in meta["onramp_calldata"]
+
+
+def test_eml_upload_and_spool(server, tmp_path):
+    """Drag-and-drop equivalent: raw .eml bytes in, spooled name out,
+    readable back through the guarded spool reader."""
+    base, app = server
+    app.eml_spool = str(tmp_path)
+    raw = b"From: venmo@venmo.com\r\nSubject: test\r\n\r\nbody"
+    req = urllib.request.Request(
+        base + "/api/eml", data=raw, headers={"content-type": "message/rfc822"}
+    )
+    with urllib.request.urlopen(req) as r:
+        name = json.loads(r.read())["eml_path"]
+    assert name.startswith("upload-") and name.endswith(".eml")
+    assert app.read_spooled_eml(name) == raw
+
+
+def test_eml_upload_requires_spool(server):
+    base, app = server
+    req = urllib.request.Request(base + "/api/eml", data=b"x", headers={})
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("expected 403")
+    except urllib.error.HTTPError as e:
+        assert e.code == 403
+
+
+def test_zkey_fetch_progress(server, tmp_path):
+    """ProgressBar equivalent: background chunked-zkey pull polled via
+    /api/zkey-progress until state=done with all chunks counted."""
+    import time
+
+    from zkp2p_tpu.formats.artifact_store import DirBackend, upload_chunked
+
+    base, app = server
+    blob = bytes(range(256)) * 512  # 128 KiB "zkey"
+    upload_chunked(DirBackend(str(tmp_path)), "circuit.zkey", blob)
+    assert _get(base, "/api/zkey-progress")["state"] == "idle"
+    # the store path is SERVER config — a client cannot supply one
+    app.zkey_store = str(tmp_path)
+    _post(base, "/api/zkey-fetch", {})
+    for _ in range(100):
+        prog = _get(base, "/api/zkey-progress")
+        if prog["state"] == "done":
+            break
+        time.sleep(0.05)
+    assert prog["state"] == "done"
+    assert prog["done"] == prog["total"] > 0
+    assert prog["bytes"] == len(blob)
+
+
+def test_zkey_fetch_requires_server_config(server):
+    """A client must not be able to point the fetch at host paths."""
+    base, app = server
+    req = urllib.request.Request(
+        base + "/api/zkey-fetch",
+        data=json.dumps({"store_dir": "/etc/cron.d"}).encode(),
+    )
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("expected 403")
+    except urllib.error.HTTPError as e:
+        assert e.code == 403  # no --zkey-store configured, payload ignored
+
+
+def test_eml_upload_size_capped(server, tmp_path):
+    base, app = server
+    app.eml_spool = str(tmp_path)
+    req = urllib.request.Request(base + "/api/eml", data=b"x" * 10)
+    req.add_header("content-length", str(8 * 1024 * 1024 * 1024))
+    try:
+        urllib.request.urlopen(req)
+        raise AssertionError("expected 403")
+    except (urllib.error.HTTPError, ConnectionError, OSError) as e:
+        if isinstance(e, urllib.error.HTTPError):
+            assert e.code == 403
+
+
+def test_orders_paging_negative_limit(server):
+    base, app = server
+    _post(base, "/api/orders", {"address": "n1", "signature": "s", "amount": 5, "max_amount_to_pay": 9})
+    page = _get(base, "/api/orders?offset=0&limit=-2")
+    assert page["orders"] == [] and page["total"] == 1

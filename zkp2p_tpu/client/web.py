@@ -29,7 +29,7 @@ from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
-from ..contracts.ramp import FakeUSDC, Ramp
+from ..contracts.ramp import MSG_LEN, FakeUSDC, Ramp
 from .flow import OffRamper, OnRamper
 
 
@@ -52,10 +52,18 @@ class OnrampApp:
         usdc: FakeUSDC,
         prover: Optional[ProverBundle] = None,
         eml_spool: Optional[str] = None,
+        zkey_store: Optional[str] = None,
+        zkey_cache: Optional[str] = None,
     ):
         self.ramp = ramp
         self.usdc = usdc
         self.prover = prover
+        # The chunked-zkey store/cache are SERVER configuration, like the
+        # eml spool: a client-supplied path would hand any caller
+        # arbitrary directory creation + file writes + existence probing
+        # on the host (the threat the r3 spool lockdown closed).
+        self.zkey_store = zkey_store
+        self.zkey_cache = zkey_cache
         # Server-side .eml files may only be read from this directory:
         # /api/onramp taking an arbitrary path would let any client probe
         # file existence/contents on the host (r3 advisor).
@@ -63,6 +71,55 @@ class OnrampApp:
         self.onrampers: Dict[str, OnRamper] = {}
         self.offrampers: Dict[str, OffRamper] = {}
         self.lock = threading.Lock()
+        self.zkey_fetch: Dict = {"state": "idle"}
+
+    # ---- chunk-download progress (the reference's ProgressBar.tsx over
+    # downloadProofFiles' onDownloaded callback, zkp.ts:24-49): the
+    # server-side pull of the chunked zkey runs in a background thread
+    # and GET /api/zkey-progress polls {done, total, state}.
+    def start_zkey_fetch(self) -> None:
+        from ..formats.artifact_store import DirBackend, download_chunked
+
+        if self.zkey_store is None:
+            raise PermissionError("no --zkey-store configured on this server")
+        store_dir, cache_dir = self.zkey_store, self.zkey_cache
+        with self.lock:
+            if self.zkey_fetch.get("state") == "downloading":
+                raise PermissionError("a zkey fetch is already in progress")
+            self.zkey_fetch = {"state": "downloading", "done": 0, "total": 0}
+
+        def progress(done: int, total: int) -> None:
+            with self.lock:
+                self.zkey_fetch.update(done=done, total=total)
+
+        def run() -> None:
+            try:
+                blob = download_chunked(
+                    DirBackend(store_dir), "circuit.zkey", cache_dir=cache_dir, progress=progress
+                )
+                with self.lock:
+                    self.zkey_fetch.update(state="done", bytes=len(blob))
+            except Exception as e:  # noqa: BLE001 — polled by the client
+                with self.lock:
+                    self.zkey_fetch.update(state="error", error=str(e))
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def spool_eml(self, raw: bytes) -> str:
+        """The drag-and-drop equivalent (SubmitOrderGenerateProofForm.tsx
+        drop zone): accept raw .eml bytes, store them under the spool with
+        a server-chosen name, return the name for /api/onramp."""
+        if self.eml_spool is None:
+            raise PermissionError("no --eml-spool directory configured on this server")
+        if len(raw) > 4 * 1024 * 1024:
+            raise PermissionError("eml too large (4 MiB cap)")
+        import hashlib as _hashlib
+
+        name = f"upload-{_hashlib.sha256(raw).hexdigest()[:16]}.eml"
+        path = os.path.join(self.eml_spool, name)
+        with open(path, "wb") as f:
+            f.write(raw)
+        return name
 
     def read_spooled_eml(self, name: str) -> bytes:
         if self.eml_spool is None:
@@ -191,6 +248,11 @@ def make_handler(app: OnrampApp):
             self.wfile.write(body)
 
         def _read(self) -> Dict:
+            if self.path == "/api/eml":
+                n = int(self.headers.get("content-length", 0))
+                if n > 4 * 1024 * 1024:  # bound memory BEFORE reading
+                    raise PermissionError("eml too large (4 MiB cap)")
+                return {"_raw": self.rfile.read(n)}
             n = int(self.headers.get("content-length", 0))
             return json.loads(self.rfile.read(n) or b"{}")
 
@@ -214,6 +276,15 @@ def make_handler(app: OnrampApp):
                 self.end_headers()
                 self.wfile.write(body)
             elif u.path == "/api/orders":
+                # paging (the reference MainPage's table paging): plain
+                # offset/limit over the id-sorted book, total included so
+                # the client can render page controls
+                q = parse_qs(u.query)
+                offset = max(0, int(q.get("offset", ["0"])[0]))
+                limit_raw = q.get("limit", [None])[0]
+                limit = max(0, int(limit_raw)) if limit_raw is not None else None
+                all_rows = app.ramp.get_all_orders()
+                page = all_rows[offset : offset + limit] if limit is not None else all_rows[offset:]
                 rows = [
                     {
                         "id": oid,
@@ -222,9 +293,30 @@ def make_handler(app: OnrampApp):
                         "max_amount_to_pay": o.max_amount_to_pay,
                         "status": o.status.name,
                     }
-                    for oid, o in app.ramp.get_all_orders()
+                    for oid, o in page
                 ]
-                self._json(rows)
+                if "offset" in q or "limit" in q:
+                    self._json({"orders": rows, "total": len(all_rows), "offset": offset})
+                else:  # legacy shape: bare list
+                    self._json(rows)
+            elif u.path == "/api/zkey-progress":
+                with app.lock:
+                    self._json(dict(app.zkey_fetch))
+            elif u.path == "/api/meta":
+                # chain-glue registry (the reference's contract address +
+                # ABI constants, contracts.ts): everything a client needs
+                # to bind to this deployment
+                self._json(
+                    {
+                        "ramp_address": app.ramp.address,
+                        "usdc_address": "usdc",
+                        "max_amount_usdc": app.ramp.max_amount,
+                        "venmo_rsa_limbs": [str(v) for v in app.ramp.venmo_mailserver_keys],
+                        "msg_len": MSG_LEN,
+                        "prover_loaded": app.prover is not None,
+                        "onramp_calldata": f"onRamp(uint[2] a, uint[2][2] b, uint[2] c, uint[{MSG_LEN}] signals)",
+                    }
+                )
             else:
                 self._json({"error": "not found"}, 404)
 
@@ -265,6 +357,13 @@ def make_handler(app: OnrampApp):
                         int(payload["order_id"]), on_pk, int(payload["min_amount_to_pay"])
                     )
                     self._json({"claim_id": cid})
+                elif self.path == "/api/eml":
+                    # drag-and-drop equivalent: raw .eml bytes in the body
+                    name = app.spool_eml(payload["_raw"])
+                    self._json({"eml_path": name})
+                elif self.path == "/api/zkey-fetch":
+                    app.start_zkey_fetch()  # paths are server config only
+                    self._json({"ok": True})
                 elif self.path == "/api/onramp":
                     if app.prover is None:
                         self._json({"error": "prover bundle not loaded on this server"}, 503)

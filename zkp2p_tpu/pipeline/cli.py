@@ -420,7 +420,11 @@ def cmd_serve(args):
         _check_zkey_matches(zk, cs)
         prover = ProverBundle(cs=cs, dpk=device_pk_from_zkey(zk, infer_widths=_infer_widths(args)), params=meta[0], layout=meta[1])
         _log("prover bundle loaded")
-    app = OnrampApp(ramp, usdc, prover, eml_spool=args.eml_spool)
+    app = OnrampApp(
+        ramp, usdc, prover, eml_spool=args.eml_spool,
+        zkey_store=getattr(args, "zkey_store", None),
+        zkey_cache=os.path.join(args.build_dir, "zkey_cache"),
+    )
     srv = serve(app, port=args.port)
     _log(f"serving on http://127.0.0.1:{srv.server_address[1]} (ctrl-c to stop)")
     try:
