@@ -1633,6 +1633,57 @@ static inline bool aff52_is_zero(const u64 a[5]) {
 static void g1_window_sum_jac(const u64 *bases_xy, const int32_t *sd, long n,
                               int c, int nwin, int wi, G1Jac *out);
 static inline void signed_pt_y(u64 out[4], const u64 y[4], bool negate);
+static void g1_tree_sum(u64 (*xs)[4], u64 (*ys)[4], long n, G1Jac *out);
+static void g1_add_jac(G1Jac &acc, const G1Jac &e);
+
+// Tiny-digit-range windows (the TOP window at big domains has only a
+// few effective bits): instead of the serial Jacobian fill — every
+// point lands in one of a handful of buckets — partition points by
+// digit and run each bucket through the vectorized tree sum, then do
+// the standard suffix reduction over the few bucket sums.
+static void g1_window_sum_small(const u64 *bases_xy, const int32_t *sd,
+                                long n, int c, int nwin, int wi,
+                                int bits_here, G1Jac *out) {
+  const long nbuckets = (1L << bits_here) + 2;  // +carry headroom
+  std::vector<std::vector<long>> members((size_t)nbuckets);
+  for (long i = 0; i < n; ++i) {
+    int32_t d = sd[i * nwin + wi];
+    if (!d) continue;
+    long b = d < 0 ? -d : d;
+    if (b >= nbuckets) {  // cannot happen for a true top window; bail
+      g1_window_sum_jac(bases_xy, sd, n, c, nwin, wi, out);
+      return;
+    }
+    const u64 *x = bases_xy + 8 * i;
+    if (is_zero4(x) && is_zero4(x + 4)) continue;
+    members[b].push_back(i);  // sign re-read from sd at drain time
+  }
+  long cap = 0;
+  for (auto &v : members) cap = std::max(cap, (long)v.size());
+  u64 (*xs)[4] = new u64[cap > 0 ? cap : 1][4];
+  u64 (*ys)[4] = new u64[cap > 0 ? cap : 1][4];
+  G1Jac run, wsum;
+  memset(&run, 0, sizeof(run));
+  memset(&wsum, 0, sizeof(wsum));
+  for (long b = nbuckets - 1; b >= 1; --b) {
+    if (!members[b].empty()) {
+      long k = 0;
+      for (long i : members[b]) {
+        const u64 *x = bases_xy + 8 * i;
+        memcpy(xs[k], x, 32);
+        signed_pt_y(ys[k], x + 4, sd[i * nwin + wi] < 0);
+        ++k;
+      }
+      G1Jac bsum;
+      g1_tree_sum(xs, ys, k, &bsum);
+      g1_add_jac(run, bsum);
+    }
+    g1_add_jac(wsum, run);
+  }
+  delete[] xs;
+  delete[] ys;
+  *out = wsum;
+}
 
 // 52-native batch-affine window fill: buckets AND bases in mont260
 // 52-limb form.  `bases_xy` (mont256) is still taken for the Jacobian
@@ -1646,7 +1697,12 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
   int bits_here = 254 - wi * c;
   if (bits_here > c) bits_here = c;
   if (bits_here < 1 || (1L << bits_here) < 4 * B) {
-    g1_window_sum_jac(bases_xy, sd, n, c, nwin, wi, out);
+    if (bits_here >= 1 && bits_here <= 8) {
+      // few buckets, many points each: per-bucket vectorized tree sums
+      g1_window_sum_small(bases_xy, sd, n, c, nwin, wi, bits_here, out);
+    } else {
+      g1_window_sum_jac(bases_xy, sd, n, c, nwin, wi, out);
+    }
     return;
   }
   Aff52 *bk = new Aff52[nbuckets]();
