@@ -1471,9 +1471,14 @@ static void limb52_to_mont256(const u64 a[5], u64 out[4], const Ifma52Field &F) 
 // with NO carrier conversions and NO limb-shift packing — stashes are
 // already 5-limb mont260 canonical.  Outputs canonical.
 // buf: 8 x 5 x roundup8(m) u64 scratch (den,num,x1,y1,x2,prod,x3,y3 —
-// y2 is loaded per block straight from its AoS stash).
-static void g1_chunk_apply_52(const u64 (*x1a)[5], const u64 (*y1a)[5],
-                              const u64 (*x2a)[5], const u64 (*y2a)[5],
+// y2 is derived per block from b52 + the sign flag, no plane kept).
+// Gathers operands by INDEX (bucket id + point id + sign) straight
+// from the bucket array and the converted bases — the schedule loop
+// stores three small ints per add instead of 160 bytes of coordinate
+// stashes.
+static void g1_chunk_apply_52(const Aff52 *bk, const Aff52 *b52,
+                              const long *add_bkt, const long *add_pt,
+                              const unsigned char *negf,
                               const unsigned char *dbl, long m,
                               u64 (*x3a)[5], u64 (*y3a)[5], u64 *buf) {
   Ifma52Field &F = fq52_field();
@@ -1484,16 +1489,21 @@ static void g1_chunk_apply_52(const u64 (*x1a)[5], const u64 (*y1a)[5],
       *y352 = buf + (size_t)35 * N;
   u64 one52[5] = {1, 0, 0, 0, 0}, one260[5];
   mont52_mul_scalar(one260, one52, F.r260sq, F);
-  // transpose AoS -> SoA (pure copies)
-  auto pack5 = [&](const u64 (*src)[5], u64 *dst) {
-    for (long j = 0; j < N; ++j) {
-      const u64 *s = j < m ? src[j] : one52;  // pad value irrelevant except den
-      for (int k = 0; k < 5; ++k) dst[(size_t)k * N + j] = j < m ? s[k] : 0;
+  // gather-transpose into SoA planes (x1 = bucket, x2 = incoming point)
+  for (long j = 0; j < N; ++j) {
+    if (j < m) {
+      const Aff52 &B1 = bk[add_bkt[j]];
+      const Aff52 &P2 = b52[add_pt[j]];
+      for (int k = 0; k < 5; ++k) {
+        x152[(size_t)k * N + j] = B1.x[k];
+        y152[(size_t)k * N + j] = B1.y[k];
+        x252[(size_t)k * N + j] = P2.x[k];
+      }
+    } else {
+      for (int k = 0; k < 5; ++k)
+        x152[(size_t)k * N + j] = y152[(size_t)k * N + j] = x252[(size_t)k * N + j] = 0;
     }
-  };
-  pack5(x1a, x152);
-  pack5(y1a, y152);
-  pack5(x2a, x252);
+  }
   // y2 goes straight into the num derivation below (no plane kept)
 
   __m512i p[5], p2[5], comp2p[5], comppv[5];
@@ -1515,7 +1525,17 @@ static void g1_chunk_apply_52(const u64 (*x1a)[5], const u64 (*y1a)[5],
       u64 y2v8[5][8];
       for (int l = 0; l < 8; ++l) {
         long j = t * 8 + l;
-        for (int k = 0; k < 5; ++k) y2v8[k][l] = j < m ? y2a[j][k] : 0;
+        if (j < m) {
+          u64 py[5];
+          if (negf[j]) {
+            neg52(py, b52[add_pt[j]].y, F);
+          } else {
+            memcpy(py, b52[add_pt[j]].y, 40);
+          }
+          for (int k = 0; k < 5; ++k) y2v8[k][l] = py[k];
+        } else {
+          for (int k = 0; k < 5; ++k) y2v8[k][l] = 0;
+        }
       }
       for (int k = 0; k < 5; ++k) y2v[k] = _mm512_loadu_si512(y2v8[k]);
     }
@@ -1716,10 +1736,8 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     cur.push_back(i);
   }
   long *add_bkt = new long[B];
-  u64 (*x1a)[5] = new u64[B][5];
-  u64 (*y1a)[5] = new u64[B][5];
-  u64 (*x2a)[5] = new u64[B][5];
-  u64 (*y2a)[5] = new u64[B][5];
+  long *add_pt = new long[B];
+  unsigned char *negf = new unsigned char[B];
   u64 (*x3a)[5] = new u64[B][5];
   u64 (*y3a)[5] = new u64[B][5];
   unsigned char *dbl = new unsigned char[B];
@@ -1728,10 +1746,8 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     delete[] bk;
     delete[] stamp;
     delete[] add_bkt;
-    delete[] x1a;
-    delete[] y1a;
-    delete[] x2a;
-    delete[] y2a;
+    delete[] add_pt;
+    delete[] negf;
     delete[] x3a;
     delete[] y3a;
     delete[] dbl;
@@ -1775,11 +1791,9 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         } else {
           dbl[m] = 0;
         }
-        memcpy(x1a[m], bk[bno].x, 40);
-        memcpy(y1a[m], bk[bno].y, 40);
-        memcpy(x2a[m], b52[i].x, 40);
-        memcpy(y2a[m], py, 40);
         add_bkt[m] = bno;
+        add_pt[m] = i;
+        negf[m] = dgt < 0 ? 1 : 0;
         ++m;
       }
       processed = hi;
@@ -1787,7 +1801,7 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
         continue;
       }
-      g1_chunk_apply_52(x1a, y1a, x2a, y2a, dbl, m, x3a, y3a, scratch);
+      g1_chunk_apply_52(bk, b52, add_bkt, add_pt, negf, dbl, m, x3a, y3a, scratch);
       for (long j = 0; j < m; ++j) {
         memcpy(bk[add_bkt[j]].x, x3a[j], 40);
         memcpy(bk[add_bkt[j]].y, y3a[j], 40);
