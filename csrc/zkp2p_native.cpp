@@ -1052,10 +1052,10 @@ static IfmaTwiddles ifma_stage_twiddles(long m, const u64 root_std[4]) {
   return T;
 }
 
-// Vector stages of the radix-2 NTT: data already bit-reversed and with
-// the len<16 stages applied (scalar); values in mont256 u64x4.  Packs
-// to 52-bit SoA, runs len>=16 stages 8 butterflies at a time, unpacks
-// with full reduction mod r.
+// ALL NTT stages, vectorized: data arrives bit-reversed (mont256
+// u64x4); packs to 52-bit SoA, runs stages len 2/4/8 in-register
+// (permute + blended add/sub, constant twiddle vectors), then the
+// radix-4-fused len>=16 loop, and unpacks with full reduction mod r.
 static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
   Ifma52Field &F = fr52_field();
   IfmaTwiddles T = ifma_stage_twiddles(m, root_std);
@@ -1073,6 +1073,92 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
     comp2p[k] = _mm512_set1_epi64((long long)F.comp2p[k]);
   }
   const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+
+  // ---- stages len = 2, 4, 8 fully in-register (butterflies never
+  // cross a 512-bit vector): permute u/v lanes, one constant-twiddle
+  // mont mul (len 2 is mul-free: its only twiddle is 1), blended
+  // add/sub.  Twiddle constant vectors repeat per vector:
+  //   len 4: [1, w4] x4   len 8: [1, w8, w8^2, w8^3] x2
+  {
+    u64 one52v[5] = {1, 0, 0, 0, 0}, one260[5];
+    mont52_mul_scalar(one260, one52v, F.r260sq, F);
+    // root260 = root_std in mont260; w_len = root260^(m/len)
+    u64 root52[5], root260[5];
+    limbs4_to_52(root52, root_std);
+    mont52_mul_scalar(root260, root52, F.r260sq, F);
+    auto pow2k = [&](u64 out[5], long e_pow2) {
+      // root260^(e_pow2) where e_pow2 is a power of two: squarings
+      memcpy(out, root260, 40);
+      for (long s = e_pow2; s > 1; s >>= 1) mont52_mul_scalar(out, out, out, F);
+    };
+    u64 w4[5], w8[5], w8sq[5], w8cu[5];
+    pow2k(w4, m / 4);
+    pow2k(w8, m / 8);
+    mont52_mul_scalar(w8sq, w8, w8, F);
+    mont52_mul_scalar(w8cu, w8sq, w8, F);
+
+    const __m512i idx_even = _mm512_set_epi64(6, 6, 4, 4, 2, 2, 0, 0);
+    const __m512i idx_odd = _mm512_set_epi64(7, 7, 5, 5, 3, 3, 1, 1);
+    const __m512i idx_lo4 = _mm512_set_epi64(5, 4, 5, 4, 1, 0, 1, 0);
+    const __m512i idx_hi4 = _mm512_set_epi64(7, 6, 7, 6, 3, 2, 3, 2);
+    const __m512i idx_lo8 = _mm512_set_epi64(3, 2, 1, 0, 3, 2, 1, 0);
+    const __m512i idx_hi8 = _mm512_set_epi64(7, 6, 5, 4, 7, 6, 5, 4);
+    __m512i tw4[5], tw8[5];
+    {
+      u64 t4[5][8], t8[5][8];
+      for (int k = 0; k < 5; ++k) {
+        for (int l = 0; l < 8; ++l) {
+          t4[k][l] = (l & 1) ? w4[k] : one260[k];
+          t8[k][l] = (l & 3) == 0 ? one260[k]
+                     : (l & 3) == 1 ? w8[k]
+                     : (l & 3) == 2 ? w8sq[k]
+                                    : w8cu[k];
+        }
+        tw4[k] = _mm512_loadu_si512(t4[k]);
+        tw8[k] = _mm512_loadu_si512(t8[k]);
+      }
+    }
+    for (long i = 0; i < m; i += 8) {
+      __m512i x[5];
+      for (int k = 0; k < 5; ++k) x[k] = _mm512_loadu_si512(soa + (size_t)k * m + i);
+      // stage len=2: pairs (0,1)(2,3)(4,5)(6,7), twiddle 1 (no mul)
+      {
+        __m512i u[5], v[5], s[5], d[5];
+        for (int k = 0; k < 5; ++k) {
+          u[k] = _mm512_permutexvar_epi64(idx_even, x[k]);
+          v[k] = _mm512_permutexvar_epi64(idx_odd, x[k]);
+        }
+        add_lazy8(s, u, v, comp2p);
+        sub_lazy8(d, u, v, p2, comp2p);
+        for (int k = 0; k < 5; ++k) x[k] = _mm512_mask_blend_epi64(0xAA, s[k], d[k]);
+      }
+      // stage len=4: pairs (0,2)(1,3) per group of 4, twiddles [1, w4]
+      {
+        __m512i u[5], v[5], t[5], s[5], d[5];
+        for (int k = 0; k < 5; ++k) {
+          u[k] = _mm512_permutexvar_epi64(idx_lo4, x[k]);
+          v[k] = _mm512_permutexvar_epi64(idx_hi4, x[k]);
+        }
+        mont52_mul8(t, v, tw4, p, pinv);
+        add_lazy8(s, u, t, comp2p);
+        sub_lazy8(d, u, t, p2, comp2p);
+        for (int k = 0; k < 5; ++k) x[k] = _mm512_mask_blend_epi64(0xCC, s[k], d[k]);
+      }
+      // stage len=8: pairs (l, l+4), twiddles [1, w8, w8^2, w8^3]
+      {
+        __m512i u[5], v[5], t[5], s[5], d[5];
+        for (int k = 0; k < 5; ++k) {
+          u[k] = _mm512_permutexvar_epi64(idx_lo8, x[k]);
+          v[k] = _mm512_permutexvar_epi64(idx_hi8, x[k]);
+        }
+        mont52_mul8(t, v, tw8, p, pinv);
+        add_lazy8(s, u, t, comp2p);
+        sub_lazy8(d, u, t, p2, comp2p);
+        for (int k = 0; k < 5; ++k) x[k] = _mm512_mask_blend_epi64(0xF0, s[k], d[k]);
+      }
+      for (int k = 0; k < 5; ++k) _mm512_storeu_si512(soa + (size_t)k * m + i, x[k]);
+    }
+  }
   // One radix-2 vector stage (the generic building block, and the odd
   // leading stage when the vector-stage count is odd).
   auto radix2_stage = [&](long len, int stage) {
@@ -2366,36 +2452,9 @@ void fr_ntt_ifma(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
 #if ZKP2P_HAVE_IFMA
   if (ifma_enabled() && m >= 64) {
     fr_bitrev(data, m);
-    // scalar stages len = 2, 4, 8 (15% of the work; small-j twiddles
-    // computed directly: wlen = root^(m/len) via mont squarings)
-    u64 root_m[4];
-    fr_mul(root_m, root_std, R2R);
-    for (long len = 2; len <= 8 && len <= m; len <<= 1) {
-      u64 wlen[4];
-      memcpy(wlen, root_m, 32);
-      for (long s = m / len; s > 1; s >>= 1) fr_mul(wlen, wlen, wlen);
-      long half = len >> 1;
-      for (long i0 = 0; i0 < m; i0 += len) {
-        u64 tw[4];
-        memcpy(tw, ONE_R, 32);
-        for (long j = 0; j < half; ++j) {
-          u64 *u = data + 4 * (i0 + j);
-          u64 *v = data + 4 * (i0 + j + half);
-          u64 t[4];
-          if (j == 0) {
-            memcpy(t, v, 32);
-          } else {
-            fr_mul(t, v, tw);
-          }
-          u64 usave[4];
-          memcpy(usave, u, 32);
-          fr_add(u, usave, t);
-          fr_sub(v, usave, t);
-          if (j + 1 < half) fr_mul(tw, tw, wlen);
-        }
-      }
-    }
-    // vector stages len >= 16
+    // ALL stages vectorized: len 2/4/8 via in-register permutes (the
+    // scalar small-stage tier was ~1/3 of the NTT after radix-4), then
+    // the radix-4-fused len>=16 loop — one pack/unpack for everything
     fr_ntt_ifma_stages(data, m, root_std);
     fr_apply_scale(data, m, scale_std);
     return;
