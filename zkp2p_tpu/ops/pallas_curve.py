@@ -23,10 +23,13 @@ Layout: limb-major (16, T) tiles like `pallas_mont` — limbs on the
 sublane axis, batch on the 128-wide lane axis.  Field helpers are the
 limb-major mirrors of `field.jfield` (same Kogge-Stone carry ladder).
 
-Mosaic notes (learned on hardware, round 4): `.at[].add` lowers to an
-unsupported scatter — one-hot adds are built from `broadcasted_iota`
-comparisons; kernels cannot capture traced constants — the modulus /
-N' / R limbs are passed as (16, 1) operands.
+Mosaic notes (learned on hardware, rounds 4-5): `.at[].add` lowers to
+an unsupported scatter — limb-0 adds are built by slice-and-concat
+(NOT broadcasted_iota one-hots: an iota materialised while an outer
+jit trace is live becomes a captured kernel constant, which
+pallas_call rejects); kernels cannot capture traced constants — the
+modulus / N' / R limbs are passed as (16, 1) operands and zeros are
+derived from tracers (`a ^ a`), never `jnp.zeros`.
 
 Reference analog: rapidsnark's Jacobian point kernels (its G1/G2 hot
 loops); this is the TPU-native equivalent.
@@ -95,7 +98,10 @@ class _FqOps:
         return jnp.where(cond, a, b)
 
     def zero_like(self, a):
-        return jnp.zeros_like(a)
+        # a ^ a, not jnp.zeros_like: a zeros literal materialised while
+        # an outer jit trace is live becomes a captured kernel constant,
+        # which pallas_call rejects (see pallas_mont._mul_wide_lm).
+        return a ^ a
 
     def one_bcast(self, a):
         return jnp.broadcast_to(self.one, a.shape)
@@ -129,11 +135,11 @@ class _Fq2Ops:
         return (jnp.where(cond, a[0], b[0]), jnp.where(cond, a[1], b[1]))
 
     def zero_like(self, a):
-        return (jnp.zeros_like(a[0]), jnp.zeros_like(a[1]))
+        return (self.fq.zero_like(a[0]), self.fq.zero_like(a[1]))
 
     def one_bcast(self, a):
         # Montgomery 1 in Fq2 = (R, 0)
-        return (jnp.broadcast_to(self.fq.one, a[0].shape), jnp.zeros_like(a[1]))
+        return (jnp.broadcast_to(self.fq.one, a[0].shape), self.fq.zero_like(a[1]))
 
 
 # ------------------------------------------------------------ point math

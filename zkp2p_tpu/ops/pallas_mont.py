@@ -64,13 +64,21 @@ def _carry_lm(x: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
 
 def _mul_wide_lm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """(La, T) x (Lb, T or 1) -> (La+Lb, T) canonical limbs; schoolbook
-    accumulation is exact in u32 (sums of < 2*16 values < 2^16)."""
+    accumulation is exact in u32 (sums of < 2*16 values < 2^16).
+
+    The accumulator starts from the i=0 partial product instead of a
+    `jnp.zeros` array: a zeros literal created inside the kernel body
+    while an outer jit trace is live becomes a CAPTURED CONSTANT of the
+    kernel jaxpr, which pallas_call rejects ("captures constants ...
+    pass them as inputs") — first seen on the round-5 driver box's JAX
+    when ntt.domain() built twiddles mid-trace."""
     La = a.shape[0]
     Lb = b.shape[0]
     out_len = La + Lb + 1
-    width = max(a.shape[1], b.shape[1])
-    acc = jnp.zeros((out_len, width), dtype=jnp.uint32)
-    for i in range(La):
+    p0 = a[0][None, :] * b  # (Lb, T)
+    acc = jnp.pad(p0 & MASK, ((0, out_len - Lb), (0, 0)))
+    acc = acc + jnp.pad(p0 >> LIMB_BITS, ((1, out_len - Lb - 1), (0, 0)))
+    for i in range(1, La):
         p = a[i][None, :] * b  # (Lb, T)
         acc = acc + jnp.pad(p & MASK, ((i, out_len - Lb - i), (0, 0)))
         acc = acc + jnp.pad(p >> LIMB_BITS, ((i + 1, out_len - Lb - i - 1), (0, 0)))
@@ -81,11 +89,12 @@ def _sub_raw_lm(a: jnp.ndarray, b: jnp.ndarray):
     """(a - b) mod 2^(16*L) + borrow flag, limb-major."""
     L = a.shape[0]
     x = a + (MASK - b)
-    # +1 on limb 0 via a one-hot constant add: `.at[0].add` lowers to
-    # scatter-add, which Mosaic TPU cannot lower (found on real hardware;
-    # interpret mode accepted it).
-    lim = jax.lax.broadcasted_iota(jnp.uint32, x.shape, 0)
-    x = x + (lim == 0).astype(jnp.uint32)
+    # +1 on limb 0 by slicing and re-concatenating: `.at[0].add` lowers
+    # to scatter-add, which Mosaic TPU cannot lower (found on real
+    # hardware; interpret mode accepted it), and a broadcasted_iota
+    # one-hot becomes a captured kernel constant under a live outer
+    # trace (same failure mode as the zeros in _mul_wide_lm).
+    x = jnp.concatenate([x[0:1] + 1, x[1:]], axis=0)
     y = _carry_lm(x, L + 1)
     borrow = 1 - y[L]
     return y[:L], borrow
