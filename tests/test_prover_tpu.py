@@ -88,6 +88,29 @@ def test_tpu_batch_prove():
         assert verify(vk, proof, pub)
 
 
+def test_tpu_batch_prove_chunked(monkeypatch):
+    """Sub-chunked batch (ZKP2P_BATCH_CHUNK, the HBM-bounding path): a
+    5-witness batch over chunks of 2 — uneven tail padded by repeating
+    the last witness — must yield 5 independently-verifying proofs."""
+    from zkp2p_tpu.prover import groth16_tpu
+
+    cs, out, x, y = build_toy()
+    pk, vk = setup(cs)
+    dpk = device_pk(pk, cs)
+    cases = [(3, 5), (2, 7), (10, 11), (1, 1), (6, 9)]
+    wits, pubs = [], []
+    for a, b in cases:
+        z = a * b % R
+        o = z * z % R
+        wits.append(cs.witness([o], {x: a, y: b}))
+        pubs.append([o])
+    monkeypatch.setattr(groth16_tpu, "BATCH_CHUNK", "2")
+    proofs = groth16_tpu.prove_tpu_batch(dpk, wits)
+    assert len(proofs) == 5
+    for proof, pub in zip(proofs, pubs):
+        assert verify(vk, proof, pub)
+
+
 def test_tpu_width_classed_prover():
     """Width-classed MSM split (narrow 3-plane w=4 vs wide): a circuit
     with num2bits bit wires + full-width products must produce the EXACT

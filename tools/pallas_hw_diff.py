@@ -29,7 +29,9 @@ def main():
     enable_cache()
     # Compiled on a real chip (the point of the tool); interpret mode
     # off-TPU so the tool itself stays smoke-testable on CPU.
-    interp = jax.default_backend() != "tpu"
+    from zkp2p_tpu.utils.jaxcfg import on_tpu
+
+    interp = not on_tpu()
     t0 = time.time()
 
     def log(m):

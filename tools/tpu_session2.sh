@@ -1,19 +1,33 @@
 #!/bin/bash
-# Tunnel-window session v2 (fired by tools/tpu_watch.sh the moment a
-# probe sees the TPU up).  Order of business for a window of unknown
-# length:
-#   1. bench with a budget wide enough to finish the remaining cold
-#      compiles in ONE window (every killed attempt still banks its
-#      completed executables in the persistent cache)
-#   2. the affine/bucket hardware A/B (tools/affine_hw_check.py)
-#   3. record the winning h-MSM formulation in
-#      .bench_cache/armed_flags.json — the driver's own bench.py reads
-#      it and inherits validated arming with no human in the loop
-#   4. kernel differential + a final bench with the winner armed
+# Tunnel-window session v3 (fired by tools/tpu_watch.sh the moment a
+# probe sees the TPU up).  Reworked after the r5 first window:
+#   - jax.default_backend() is "axon" under the tunnel plugin, so every
+#     "auto on tpu" gate was OFF on chip; utils.jaxcfg.on_tpu() fixes
+#     the routing and this session must first VALIDATE the pallas
+#     kernels it arms (Mosaic has twice accepted interpret-mode
+#     semantics it could not run: scatter-add, u32 reductions).
+#   - the batched prove OOMs HBM above ~4 witnesses/chunk on the XLA
+#     field path (18 GB at batch=16); prove_tpu_batch now sub-chunks
+#     (ZKP2P_BATCH_CHUNK auto=4 on chip) so any BENCH_BATCH is safe.
+# Order of business for a window of unknown length:
+#   1. pallas kernel differential on chip (small shapes, fast compiles)
+#      — decides whether the auto-armed kernels stay on for the benches
+#      (bench.py also self-protects with its re-exec-XLA fallback).
+#   2. driver bench (batch=16, sub-chunked) with budget wide enough to
+#      finish remaining cold compiles in ONE window; killed attempts
+#      still bank completed executables in the persistent cache.
+#   3. affine/bucket A/B -> .bench_cache/armed_flags.json (driver bench
+#      inherits validated arming with no human in the loop).
+#   4. re-bench with winners armed; latency + batch sweep; MSM roofline.
 set -u
 cd "$(dirname "$0")/.."
+# One session at a time: the watcher fires on every healthy probe, and a
+# manual launch may already be in flight.
+mkdir -p .bench_cache
+exec 9> .bench_cache/session.lock
+flock -n 9 || { echo "session already in flight; exiting"; exit 3; }
 TS=$(date +%H%M%S)
-OUT=docs/logs/tpu_session2_$TS
+OUT=docs/logs/tpu_session3_$TS
 mkdir -p "$OUT"
 phase() {
   local name=$1 tmo=$2; shift 2
@@ -21,9 +35,26 @@ phase() {
   timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
   echo "   rc=$? at $(date +%H:%M:%S)" >> "$OUT/session.log"
 }
-phase bench1 1800 env BENCH_TPU_BUDGET=1700 python -u bench.py
-phase bench2 900 env BENCH_TPU_BUDGET=820 python -u bench.py
-phase affine 2400 python -u tools/affine_hw_check.py
+
+# 1. on-chip kernel differential: G1/G2 point kernels + the fused
+#    Montgomery mul/pow ladder, every special-case lane, vs the XLA path.
+phase diff 1500 python -u tools/pallas_hw_diff.py
+PALLAS_ENV=()
+if ! grep -q "ALL HARDWARE DIFFS OK" "$OUT/diff.log" 2>/dev/null; then
+  # Kernels unproven on this chip -> force the portable XLA paths for
+  # the benches (bench would also self-protect via re-exec, but that
+  # burns a compile cycle mid-window).
+  PALLAS_ENV=(ZKP2P_FIELD_MUL=xla ZKP2P_CURVE_KERNEL=xla)
+  echo "   pallas diff NOT green -> benches forced to XLA paths" >> "$OUT/session.log"
+fi
+
+# 2. the driver's own command, wide budget; back-to-back passes make
+#    monotone progress through the compile set.
+phase bench1 1800 env BENCH_TPU_BUDGET=1700 "${PALLAS_ENV[@]}" python -u bench.py
+phase bench2 1200 env BENCH_TPU_BUDGET=1100 "${PALLAS_ENV[@]}" python -u bench.py
+
+# 3. affine/bucket hardware A/B -> armed_flags.json
+phase affine 2400 env "${PALLAS_ENV[@]}" python -u tools/affine_hw_check.py
 AFFINE=0; HMODE=windowed
 if grep -q "correctness vmap B=2: OK" "$OUT/affine.log" 2>/dev/null; then
   JR=$(grep -oP 'jacobian:.*-> \K[0-9.]+' "$OUT/affine.log" | head -1)
@@ -36,14 +67,15 @@ if grep -q "correctness vmap B=2: OK" "$OUT/affine.log" 2>/dev/null; then
   fi
 fi
 echo "   armed: ZKP2P_MSM_AFFINE=$AFFINE ZKP2P_MSM_H=$HMODE" >> "$OUT/session.log"
-mkdir -p .bench_cache
 printf '{"ZKP2P_MSM_AFFINE": "%s", "ZKP2P_MSM_H": "%s"}' "$AFFINE" "$HMODE" > .bench_cache/armed_flags.json
-phase diff 1200 python -u tools/pallas_hw_diff.py
-phase bench3 1800 env BENCH_TPU_BUDGET=1700 python -u bench.py
-phase msm_w8 900 python -u tools/msm_hwbench.py --n 131072 --window 8 --signed --skip-adds
+
+# 4. re-bench with the A/B winners armed; then the north-star metrics.
+phase bench3 1800 env BENCH_TPU_BUDGET=1700 "${PALLAS_ENV[@]}" python -u bench.py
 # single-proof latency (batch=1): the north-star p50 metric
-phase bench_lat 1200 env BENCH_TPU_BUDGET=1100 BENCH_BATCH=1 python -u bench.py
-# batch sweep 32/64 (BASELINE.json configs[3]): amortization curve
-phase bench_b32 1200 env BENCH_TPU_BUDGET=1100 BENCH_BATCH=32 python -u bench.py
-phase bench_b64 1500 env BENCH_TPU_BUDGET=1400 BENCH_BATCH=64 python -u bench.py
-echo "== session2 done $(date +%H:%M:%S)" >> "$OUT/session.log"
+phase bench_lat 1200 env BENCH_TPU_BUDGET=1100 BENCH_BATCH=1 "${PALLAS_ENV[@]}" python -u bench.py
+# batch sweep (BASELINE.json configs[3]): amortization curve
+phase bench_b32 1500 env BENCH_TPU_BUDGET=1400 BENCH_BATCH=32 "${PALLAS_ENV[@]}" python -u bench.py
+phase bench_b64 1800 env BENCH_TPU_BUDGET=1700 BENCH_BATCH=64 "${PALLAS_ENV[@]}" python -u bench.py
+# 5. MSM roofline datapoint with whatever won
+phase msm_w8 900 env "${PALLAS_ENV[@]}" python -u tools/msm_hwbench.py --n 131072 --window 8 --signed --skip-adds
+echo "== session3 done $(date +%H:%M:%S)" >> "$OUT/session.log"

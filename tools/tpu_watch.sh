@@ -7,7 +7,7 @@ LOG=docs/logs/tpu_watch_r5.log
 while true; do
   if python -c "from zkp2p_tpu.utils.jaxcfg import tpu_probe_ok; import sys; sys.exit(0 if tpu_probe_ok() else 1)" 2>/dev/null; then
     echo "$(date +%H:%M:%S) tunnel UP -> firing session" >> "$LOG"
-    tools/tpu_session2.sh
+    tools/tpu_session2.sh || { rc=$?; echo "$(date +%H:%M:%S) session skipped/failed rc=$rc" >> "$LOG"; }
     echo "$(date +%H:%M:%S) session done" >> "$LOG"
   else
     echo "$(date +%H:%M:%S) tunnel down" >> "$LOG"

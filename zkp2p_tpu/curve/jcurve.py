@@ -23,6 +23,8 @@ from __future__ import annotations
 from typing import List, Sequence, Tuple
 
 import jax
+
+from ..utils.jaxcfg import on_tpu as _on_tpu
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,7 +65,7 @@ class JCurve:
         interpret mode, which is orders of magnitude slower than the XLA
         path (the differential tests call the kernels directly with
         interpret=True instead)."""
-        return CURVE_IMPL in ("pallas", "auto") and jax.default_backend() == "tpu"
+        return CURVE_IMPL in ("pallas", "auto") and _on_tpu()
 
     # ------------------------------------------------------------ helpers
 
@@ -110,7 +112,7 @@ class JCurve:
         if self._pallas():
             from ..ops.pallas_curve import g1_double, g2_double
 
-            interp = jax.default_backend() != "tpu"
+            interp = not _on_tpu()
             if F.zero_limbs.ndim == 1:
                 return g1_double(F, p, interp)
             return g2_double(F, p, interp)
@@ -137,7 +139,7 @@ class JCurve:
         if self._pallas():
             from ..ops.pallas_curve import g1_add, g2_add
 
-            interp = jax.default_backend() != "tpu"
+            interp = not _on_tpu()
             if F.zero_limbs.ndim == 1:
                 return g1_add(F, p, q, interp)
             return g2_add(F, p, q, interp)
@@ -160,7 +162,7 @@ class JCurve:
         if self._pallas():
             from ..ops.pallas_curve import g1_add_mixed, g2_add_mixed
 
-            interp = jax.default_backend() != "tpu"
+            interp = not _on_tpu()
             if F.zero_limbs.ndim == 1:
                 return g1_add_mixed(F, p, a, interp)
             return g2_add_mixed(F, p, a, interp)

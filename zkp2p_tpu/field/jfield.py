@@ -104,6 +104,7 @@ def _conv_onehot(n: int, m: int) -> jnp.ndarray:
 # fills them.  Flip at runtime (e.g. ZKP2P_FIELD_CONV=limb_major) to A/B
 # on hardware; both are bit-exact and differentially tested.
 from ..utils.config import load_config as _load_config
+from ..utils.jaxcfg import on_tpu as _on_tpu
 
 CONV_LAYOUT = _load_config().field_conv
 
@@ -119,9 +120,7 @@ def field_mul_impl() -> str:
     """The RESOLVED field-mul implementation ("pallas" or "xla") — the
     one place the "auto" rule lives (mirror of JCurve._pallas; used by
     JPrimeField.mul and by tools that label A/B arms)."""
-    import jax as _jax
-
-    if FIELD_MUL_IMPL == "pallas" or (FIELD_MUL_IMPL == "auto" and _jax.default_backend() == "tpu"):
+    if FIELD_MUL_IMPL == "pallas" or (FIELD_MUL_IMPL == "auto" and _on_tpu()):
         return "pallas"
     return "xla"
 
@@ -270,11 +269,9 @@ class JPrimeField:
         on a real TPU backend and the XLA path elsewhere; "pallas"
         forces the kernel (interpret mode off-TPU — tests only)."""
         if field_mul_impl() == "pallas":
-            import jax as _jax
-
             from ..ops.pallas_mont import mont_mul
 
-            return mont_mul(self, a, b, _jax.default_backend() != "tpu")
+            return mont_mul(self, a, b, not _on_tpu())
         t = _mul_wide(a, b)  # (..., 32)
         m = _mul_wide(t[..., :NUM_LIMBS], self.nprime_limbs)[..., :NUM_LIMBS]
         u = _mul_wide(m, self.n_limbs)  # (..., 32)
@@ -342,11 +339,9 @@ class JPrimeField:
         makes small-batch inversions latency-bound; the fused ladder
         (ops.pallas_mont.mont_pow) runs the whole ladder in VMEM."""
         if field_mul_impl() == "pallas":
-            import jax as _jax
-
             from ..ops.pallas_mont import mont_pow
 
-            return mont_pow(self, a, self.modulus - 2, _jax.default_backend() != "tpu")
+            return mont_pow(self, a, self.modulus - 2, not _on_tpu())
         return self.inv(a)
 
 

@@ -73,6 +73,7 @@ from ..ops.ntt import coset_shift, intt, ntt
 #   gated until the on-chip A/B proves it.
 # MSM_H: "windowed" or "bucket" (ops.msm_bucket sorted-prefix
 #   Pippenger) — hardware-gated like MSM_AFFINE.
+from ..utils.jaxcfg import on_tpu as _on_tpu
 from ..utils.config import load_config as _load_config
 
 _CFG = _load_config()
@@ -81,23 +82,21 @@ MSM_SIGNED = _CFG.msm_signed
 MSM_UNIFIED = _CFG.msm_unified
 MSM_AFFINE = _CFG.msm_affine
 MSM_H = _CFG.msm_h
+BATCH_CHUNK = _CFG.batch_chunk
 H_BUCKET_WINDOW = 16
 
-
+from ..snark.groth16 import Proof, ProvingKey, coset_gen, domain_size_for, qap_rows
+from ..snark.r1cs import ConstraintSystem
 def _unified() -> bool:
-    return MSM_UNIFIED == "1" or (MSM_UNIFIED == "auto" and jax.default_backend() == "tpu")
+    return MSM_UNIFIED == "1" or (MSM_UNIFIED == "auto" and _on_tpu())
 
 
 def _affine() -> bool:
-    return MSM_AFFINE == "1" or (MSM_AFFINE == "auto" and jax.default_backend() == "tpu")
+    return MSM_AFFINE == "1" or (MSM_AFFINE == "auto" and _on_tpu())
 
 
 def _h_bucket() -> bool:
-    return MSM_SIGNED and (
-        MSM_H == "bucket" or (MSM_H == "auto" and jax.default_backend() == "tpu")
-    )
-from ..snark.groth16 import Proof, ProvingKey, coset_gen, domain_size_for, qap_rows
-from ..snark.r1cs import ConstraintSystem
+    return MSM_SIGNED and (MSM_H == "bucket" or (MSM_H == "auto" and _on_tpu()))
 
 
 @dataclass
@@ -798,13 +797,48 @@ def prove_tpu_sharded(
     return _assemble(dpk, (a, b1, b2, c, hq), r, s)
 
 
+def _batch_chunk_size() -> int:
+    """Sub-batch size for prove_tpu_batch; 0 = whole batch in one vmap.
+
+    "auto" chunks only on a real TPU: the batched pipeline's peak HBM is
+    linear in the vmapped batch (~1.3 GB per witness at the 499k venmo
+    shape on the XLA field path), so a 16-witness batch plans 20+ GB
+    against the v5e's 15.75 G — chunks of 4 keep every chunk's peak
+    under ~7 GB while reusing ONE compiled executable across chunks."""
+    if BATCH_CHUNK == "auto":
+        return 4 if _on_tpu() else 0
+    try:
+        return max(0, int(BATCH_CHUNK))
+    except ValueError:
+        return 0
+
+
 def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -> List[Proof]:
     """vmap the full device pipeline over a batch of witnesses (the
-    batch=64 configuration in BASELINE.json)."""
+    batch=64 configuration in BASELINE.json).
+
+    Large batches run as shape-stable sub-chunks (see _batch_chunk_size;
+    the last chunk pads by repeating its final witness) so device memory
+    is bounded by the chunk, not the batch, and every chunk reuses the
+    same compiled executable."""
     for wit in witnesses:
         _check_inferred_widths(dpk, wit)
-    w = jnp.stack([witness_to_device(wit) for wit in witnesses])
-    accs = _prove_device(dpk, w, batched=True)
+    n = len(witnesses)
+    chunk = _batch_chunk_size()
+    if chunk <= 0 or n <= chunk:
+        spans = [list(witnesses)]
+    else:
+        spans = [list(witnesses[i : i + chunk]) for i in range(0, n, chunk)]
+        spans[-1] += [spans[-1][-1]] * (chunk - len(spans[-1]))
+    parts = []
+    for span in spans:
+        w = jnp.stack([witness_to_device(wit) for wit in span])
+        parts.append(_prove_device(dpk, w, batched=True))
+    accs = (
+        parts[0]
+        if len(parts) == 1
+        else jax.tree_util.tree_map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+    )
     a, b1, c, hq = (g1_jac_to_host(accs[i]) for i in (0, 1, 3, 4))
     b2 = g2_jac_to_host(accs[2])
     return [

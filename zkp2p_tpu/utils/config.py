@@ -60,6 +60,12 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "msm_unified": ("ZKP2P_MSM_UNIFIED", str, "auto"),
     "msm_affine": ("ZKP2P_MSM_AFFINE", str, "0"),
     "msm_h": ("ZKP2P_MSM_H", str, "windowed"),
+    # proof-batch sub-chunking: "auto" (4 per chunk on a real TPU — the
+    # 16 GB HBM budget; whole batch elsewhere), "0" (never chunk), or an
+    # explicit chunk size.  r5 bench1 on-chip: the batched h-evals stage
+    # materialises a (batch, nnz, 16, 16) partial-product tensor on the
+    # XLA field path — 18 GB at batch=16 against 15.75 G HBM.
+    "batch_chunk": ("ZKP2P_BATCH_CHUNK", str, "auto"),
     # device field/curve kernel selection — see field.jfield, curve.jcurve
     "field_conv": ("ZKP2P_FIELD_CONV", str, "matmul"),
     "field_mul": ("ZKP2P_FIELD_MUL", str, "auto"),
@@ -85,6 +91,7 @@ class ProverConfig:
     msm_unified: str = "auto"
     msm_affine: str = "0"
     msm_h: str = "windowed"
+    batch_chunk: str = "auto"
     field_conv: str = "matmul"
     field_mul: str = "auto"
     curve_kernel: str = "auto"

@@ -58,6 +58,27 @@ def enable_cache(path: str | None = None) -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def on_tpu() -> bool:
+    """True when the default JAX backend drives a real TPU.
+
+    `jax.default_backend()` names the PJRT *plugin*, not the hardware:
+    under the single-chip tunnel JAX_PLATFORMS is "axon" and every
+    `default_backend() == "tpu"` gate silently routed the on-chip run to
+    the XLA fallback paths (r5 bench1: the un-fused field mul's
+    (batch, nnz, 16, 16) partial-product tensor OOM'd 15.75 G HBM at
+    batch=16).  Match the device's platform attribute instead — the same
+    rule tpu_probe_ok() uses, stable across plugin renames."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        devs = jax.devices()
+    except Exception:
+        return False
+    return bool(devs) and getattr(devs[0], "platform", "") == "tpu"
+
+
 def tpu_probe_ok(timeout: int | None = None) -> bool:
     """Probe the TPU in a SUBPROCESS with a timeout.
 
