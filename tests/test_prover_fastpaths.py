@@ -1,0 +1,30 @@
+"""Fast-tier checks for prover host-side fast paths (no big compiles)."""
+
+import numpy as np
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.prover.groth16_tpu import witness_to_device
+
+
+def _to_u64_rows(vals):
+    rows = []
+    for v in vals:
+        rows.append([(v >> (64 * i)) & 0xFFFFFFFFFFFFFFFF for i in range(4)])
+    return np.array(rows, dtype=np.uint64)
+
+
+def test_witness_to_device_matches_host_mont_golden():
+    """Both input forms (int sequence, (n, 4)-u64 limb array — the
+    full-size witness cache format) must emit limbs byte-identical to
+    the host-side FR.to_mont_host golden, per wire."""
+    from zkp2p_tpu.field.jfield import FR
+
+    rng = np.random.default_rng(7)
+    vals = [0, 1, R - 1, R - 2, 0xFFFF, 1 << 64, (1 << 128) + 12345]
+    vals += [int.from_bytes(rng.bytes(31), "little") % R for _ in range(25)]
+    golden = np.stack([FR.to_mont_host(v % R) for v in vals])
+    from_ints = np.asarray(witness_to_device(vals))
+    from_u64 = np.asarray(witness_to_device(_to_u64_rows(vals)))
+    assert from_ints.dtype == from_u64.dtype == np.uint32
+    assert (from_ints == golden).all()
+    assert (from_u64 == golden).all()

@@ -78,4 +78,10 @@ phase bench_b32 1500 env BENCH_TPU_BUDGET=1400 BENCH_BATCH=32 "${PALLAS_ENV[@]}"
 phase bench_b64 1800 env BENCH_TPU_BUDGET=1700 BENCH_BATCH=64 "${PALLAS_ENV[@]}" python -u bench.py
 # 5. MSM roofline datapoint with whatever won
 phase msm_w8 900 env "${PALLAS_ENV[@]}" python -u tools/msm_hwbench.py --n 131072 --window 8 --signed --skip-adds
+# 6. the 4.94 M-constraint flagship ON CHIP (VERDICT r4 next #4) — needs
+#    the pallas field path (XLA matvec would OOM at full-size nnz) and
+#    the key cached by tools/prove_fullsize_native.py.
+if [ ${#PALLAS_ENV[@]} -eq 0 ] && [ -f .bench_cache/venmo_1024_6400.npz ]; then
+  phase fullsize 3600 python -u tools/fullsize_tpu.py
+fi
 echo "== session3 done $(date +%H:%M:%S)" >> "$OUT/session.log"

@@ -381,9 +381,33 @@ def device_pk_from_rows(
     )
 
 
-def witness_to_device(witness: Sequence[int]) -> jnp.ndarray:
-    """Host witness ints -> Montgomery limb matrix (n_wires, 16)."""
-    return jnp.asarray(np.stack([FR.to_mont_host(w % R) for w in witness]))
+def _is_u64_witness(witness) -> bool:
+    """The (n, 4) uint64 standard-form limb layout (the .bench_cache
+    witness format, prove_native's view) — the only ndarray form the
+    vectorized paths and _check_inferred_widths' w_std view accept."""
+    return (
+        isinstance(witness, np.ndarray)
+        and witness.dtype == np.uint64
+        and witness.ndim == 2
+        and witness.shape[-1] == 4
+    )
+
+
+def _witness_std_limbs(witness) -> np.ndarray:
+    """Host witness (int sequence or (n, 4) u64 limb rows) -> (n, 16)
+    u32 standard-form 16-bit limbs, fully vectorized (one C-speed bytes
+    pack + a numpy view; never a per-wire Python bigint loop)."""
+    from ..native.lib import _scalars_to_u64, _u64_to_limbs16
+
+    if not _is_u64_witness(witness):
+        witness = _scalars_to_u64([int(w) % R for w in witness])
+    return _u64_to_limbs16(witness)
+
+
+def witness_to_device(witness) -> jnp.ndarray:
+    """Host witness -> Montgomery limb matrix (n_wires, 16): the
+    vectorized standard-form limbs plus ONE device to_mont mul."""
+    return FR.to_mont(jnp.asarray(_witness_std_limbs(witness)))
 
 
 def _matvec(coeff, wire, row, w_mont, m):
@@ -673,7 +697,7 @@ def prove_tpu(
         r = 1 + secrets.randbelow(R - 1)
     if s is None:
         s = 1 + secrets.randbelow(R - 1)
-    _check_inferred_widths(dpk, witness)
+    _check_inferred_widths(dpk, witness, w_std=witness if _is_u64_witness(witness) else None)
     acc = _prove_device(dpk, witness_to_device(witness))
     a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (acc[0], acc[1], acc[3], acc[4]))
     b2 = g2_jac_to_host(acc[2])[0]
@@ -805,12 +829,15 @@ def _batch_chunk_size() -> int:
     shape on the XLA field path), so a 16-witness batch plans 20+ GB
     against the v5e's 15.75 G — chunks of 4 keep every chunk's peak
     under ~7 GB while reusing ONE compiled executable across chunks."""
+    auto = 4 if _on_tpu() else 0
     if BATCH_CHUNK == "auto":
-        return 4 if _on_tpu() else 0
+        return auto
     try:
         return max(0, int(BATCH_CHUNK))
     except ValueError:
-        return 0
+        # a malformed knob must not silently select the unchunked (OOM-
+        # prone) behavior the knob exists to prevent — keep the auto rule
+        return auto
 
 
 def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -> List[Proof]:
@@ -822,7 +849,7 @@ def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -
     is bounded by the chunk, not the batch, and every chunk reuses the
     same compiled executable."""
     for wit in witnesses:
-        _check_inferred_widths(dpk, wit)
+        _check_inferred_widths(dpk, wit, w_std=wit if _is_u64_witness(wit) else None)
     n = len(witnesses)
     chunk = _batch_chunk_size()
     if chunk <= 0 or n <= chunk:
@@ -832,7 +859,8 @@ def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -
         spans[-1] += [spans[-1][-1]] * (chunk - len(spans[-1]))
     parts = []
     for span in spans:
-        w = jnp.stack([witness_to_device(wit) for wit in span])
+        # one batched to_mont per chunk (not one device dispatch per witness)
+        w = FR.to_mont(jnp.asarray(np.stack([_witness_std_limbs(wit) for wit in span])))
         parts.append(_prove_device(dpk, w, batched=True))
     accs = (
         parts[0]
