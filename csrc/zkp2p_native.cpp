@@ -23,6 +23,30 @@
 #include <thread>
 #include <vector>
 
+// ---------------------------------------------------------------------------
+// Env-gated MSM phase profile (ZKP2P_MSM_PROF=1, a registered debug knob):
+// per-process accumulated wall ns for the G1 Pippenger phases of the 52-bit
+// tier, printed to stderr by zkp2p_msm_prof_dump() (and readable any time via
+// the exported counters) so the fill/schedule/reduction balance can be read
+// off a real prove instead of modeled (no perf(1) on the driver box).
+#include <chrono>
+#include <cstdio>
+static std::atomic<long long> g_prof_fill_ns(0), g_prof_apply_ns(0),
+    g_prof_suffix_ns(0), g_prof_bailfill_ns(0);
+static bool msm_prof_enabled() {
+  static int v = -1;
+  if (v < 0) {
+    const char *e = getenv("ZKP2P_MSM_PROF");
+    v = (e && e[0] == '1') ? 1 : 0;
+  }
+  return v == 1;
+}
+static inline long long prof_now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
 typedef unsigned __int128 u128;
 typedef uint64_t u64;
 
@@ -235,6 +259,18 @@ static void mont_inv(u64 out[4], const u64 a[4]) {
 }
 
 extern "C" {
+
+// Dump + reset the ZKP2P_MSM_PROF counters (ns): fill total (incl. apply),
+// batched apply alone, suffix reduction.  No-op zeros when profiling is off.
+// Counters are summed across worker threads — on an n_threads > 1 run the
+// fill total overstates wall contribution by up to the thread count, so
+// phase RATIOS are only comparable single-threaded (the driver box).
+void zkp2p_msm_prof_dump(long long out4[4]) {
+  out4[0] = g_prof_fill_ns.exchange(0);
+  out4[1] = g_prof_apply_ns.exchange(0);
+  out4[2] = g_prof_suffix_ns.exchange(0);
+  out4[3] = g_prof_bailfill_ns.exchange(0);
+}
 
 // std -> Montgomery and back (batch), for the Python bridge.
 void fp_to_mont(const u64 *in, u64 *out, int n) {
@@ -1791,12 +1827,279 @@ static void g1_window_sum_small(const u64 *bases_xy, const int32_t *sd,
   *out = wsum;
 }
 
+// ---- 8-lane vectorized suffix reduction (one lane = one window) -----------
+//
+// The per-window suffix walk (run += bucket[d]; wsum += run) is serial in d
+// but independent across windows, and profiles at ~27% of the G1 phase time
+// of a full prove (ZKP2P_MSM_PROF / tools/msm_native_prof.py) now that the
+// fill is 8-wide.  These helpers run up to 8 windows' walks in AVX-512 IFMA
+// lanes: a masked Jacobian mixed add (bucket -> run) and a masked full
+// Jacobian add (run -> wsum) per bucket index, in the same lazy [0,2p)
+// mont260 domain as the chunk pipeline.  Exceptional lanes (doubling,
+// P+(-P), infinity transitions beyond the common masks) blend out and
+// re-run through the complete scalar ops — for bucket sums they cannot
+// occur except adversarially, so the patch path is correctness-only.
+
+// v == 0 (mod p) for lazy [0,2p) 52-limb values: exact 0 or exact p.
+static inline __mmask8 is0_lazy8v(const __m512i v[5], const __m512i p[5]) {
+  __mmask8 z = 0xFF, e = 0xFF;
+  const __m512i zero = _mm512_setzero_si512();
+  for (int j = 0; j < 5; ++j) {
+    z &= _mm512_cmpeq_epu64_mask(v[j], zero);
+    e &= _mm512_cmpeq_epu64_mask(v[j], p[j]);
+  }
+  return (__mmask8)(z | e);
+}
+
+struct Jac8 {
+  __m512i X[5], Y[5], Z[5];
+  __mmask8 inf;  // lanes at the point at infinity (coords then arbitrary)
+};
+
+static inline void v8_lane52(const __m512i V[5], int l, u64 out52[5]) {
+  alignas(64) u64 b[8];
+  for (int k = 0; k < 5; ++k) {
+    _mm512_store_si512(b, V[k]);
+    out52[k] = b[l];
+  }
+}
+
+static inline void v8_set_lane52(__m512i V[5], int l, const u64 in52[5]) {
+  alignas(64) u64 b[8];
+  for (int k = 0; k < 5; ++k) {
+    _mm512_store_si512(b, V[k]);
+    b[l] = in52[k];
+    V[k] = _mm512_load_si512(b);
+  }
+}
+
+// One lane -> scalar G1Jac (canonical mont256 coords).
+static G1Jac jac8_lane(const Jac8 &s, int l, const Ifma52Field &F) {
+  G1Jac g;
+  if ((s.inf >> l) & 1) {
+    memset(&g, 0, sizeof(g));
+    return g;
+  }
+  u64 c52[5];
+  v8_lane52(s.X, l, c52);
+  limb52_to_mont256(c52, g.X, F);
+  v8_lane52(s.Y, l, c52);
+  limb52_to_mont256(c52, g.Y, F);
+  v8_lane52(s.Z, l, c52);
+  limb52_to_mont256(c52, g.Z, F);
+  return g;
+}
+
+// Scalar G1Jac -> one lane (mont256 -> mont260 carrier), inf mask updated.
+static void jac8_set_lane(Jac8 &s, int l, const G1Jac &g, const Ifma52Field &F) {
+  if (is_zero4(g.Z)) {
+    s.inf |= (__mmask8)(1u << l);
+    return;
+  }
+  s.inf &= (__mmask8)~(1u << l);
+  u64 t52[5], t260[5];
+  limbs4_to_52(t52, g.X);
+  mont52_mul_scalar(t260, t52, F.c264, F);
+  v8_set_lane52(s.X, l, t260);
+  limbs4_to_52(t52, g.Y);
+  mont52_mul_scalar(t260, t52, F.c264, F);
+  v8_set_lane52(s.Y, l, t260);
+  limbs4_to_52(t52, g.Z);
+  mont52_mul_scalar(t260, t52, F.c264, F);
+  v8_set_lane52(s.Z, l, t260);
+}
+
+// Run up to 8 windows' suffix walks in lanes.  allbk: nwin x nbuckets
+// canonical-mont260 bucket arrays (all-zero = empty); wis[0..nl): the
+// window index each lane reduces; outs[l]: the window sum (Jacobian
+// mont256), written for l < nl.
+static void g1_suffix8(const Aff52 *allbk, long nbuckets, const int *wis,
+                       int nl, G1Jac *outs) {
+  Ifma52Field &F = fq52_field();
+  __m512i p[5], p2[5], comp2p[5], onev[5];
+  u64 one52[5] = {1, 0, 0, 0, 0}, one260[5];
+  mont52_mul_scalar(one260, one52, F.r260sq, F);
+  for (int k = 0; k < 5; ++k) {
+    p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+    p2[k] = _mm512_set1_epi64((long long)F.p2_52[k]);
+    comp2p[k] = _mm512_set1_epi64((long long)F.comp2p[k]);
+    onev[k] = _mm512_set1_epi64((long long)one260[k]);
+  }
+  const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+
+  alignas(64) long long lane_base[8];
+  for (int l = 0; l < 8; ++l) {
+    int w = l < nl ? wis[l] : wis[0];
+    lane_base[l] = (long long)((size_t)w * (size_t)nbuckets * sizeof(Aff52));
+  }
+  const __m512i vbase = _mm512_load_si512(lane_base);
+  const __mmask8 act_lanes = (__mmask8)((1u << nl) - 1);
+
+  Jac8 run, ws;
+  for (int k = 0; k < 5; ++k) {
+    run.X[k] = run.Y[k] = run.Z[k] = onev[k];
+    ws.X[k] = ws.Y[k] = ws.Z[k] = onev[k];
+  }
+  run.inf = 0xFF;
+  ws.inf = 0xFF;
+
+  const char *base_ptr = (const char *)allbk;
+  for (long d = nbuckets - 1; d >= 1; --d) {
+    // the walk is perfectly predictable but gather-driven (no hardware
+    // prefetch): pull the next TWO steps' bucket lines ahead of time —
+    // 8 lanes x 80 B spans two cache lines each
+    if (d > 2) {
+      for (int l = 0; l < 8; ++l) {
+        const char *nx = base_ptr + lane_base[l] + (d - 2) * (long long)sizeof(Aff52);
+        _mm_prefetch(nx, _MM_HINT_T0);
+        _mm_prefetch(nx + 64, _MM_HINT_T0);
+      }
+    }
+    const __m512i doff = _mm512_add_epi64(
+        vbase, _mm512_set1_epi64((long long)d * (long long)sizeof(Aff52)));
+    __m512i x2[5], y2[5];
+    for (int k = 0; k < 5; ++k) {
+      x2[k] = _mm512_i64gather_epi64(
+          _mm512_add_epi64(doff, _mm512_set1_epi64(8LL * k)),
+          (const long long *)allbk, 1);
+      y2[k] = _mm512_i64gather_epi64(
+          _mm512_add_epi64(doff, _mm512_set1_epi64(40 + 8LL * k)),
+          (const long long *)allbk, 1);
+    }
+    __mmask8 xz = 0xFF, yz = 0xFF;
+    {
+      const __m512i zero = _mm512_setzero_si512();
+      for (int k = 0; k < 5; ++k) {
+        xz &= _mm512_cmpeq_epu64_mask(x2[k], zero);
+        yz &= _mm512_cmpeq_epu64_mask(y2[k], zero);
+      }
+    }
+    const __mmask8 nz = act_lanes & (__mmask8)~(xz & yz);
+    if (nz) {
+      const __mmask8 fresh = nz & run.inf;
+      const __mmask8 addm = nz & (__mmask8)~run.inf;
+      if (addm) {
+        // madd-2007-bl shape, all lanes computed, exceptional ones patched
+        __m512i Z1Z1[5], U2[5], S2[5], H[5], Rr[5], HH[5], HHH[5], V[5];
+        __m512i t[5], t2[5], X3[5], Y3[5], Z3[5];
+        mont52_mul8(Z1Z1, run.Z, run.Z, p, pinv);
+        mont52_mul8(U2, x2, Z1Z1, p, pinv);
+        mont52_mul8(t, y2, run.Z, p, pinv);
+        mont52_mul8(S2, t, Z1Z1, p, pinv);
+        sub_lazy8(H, U2, run.X, p2, comp2p);
+        sub_lazy8(Rr, S2, run.Y, p2, comp2p);
+        const __mmask8 exc = addm & is0_lazy8v(H, p);
+        const __mmask8 ok = addm & (__mmask8)~exc;
+        mont52_mul8(HH, H, H, p, pinv);
+        mont52_mul8(HHH, H, HH, p, pinv);
+        mont52_mul8(V, run.X, HH, p, pinv);
+        mont52_mul8(t, Rr, Rr, p, pinv);
+        sub_lazy8(t, t, HHH, p2, comp2p);
+        add_lazy8(t2, V, V, comp2p);
+        sub_lazy8(X3, t, t2, p2, comp2p);
+        sub_lazy8(t, V, X3, p2, comp2p);
+        mont52_mul8(t, Rr, t, p, pinv);
+        mont52_mul8(t2, run.Y, HHH, p, pinv);
+        sub_lazy8(Y3, t, t2, p2, comp2p);
+        mont52_mul8(Z3, run.Z, H, p, pinv);
+        for (int k = 0; k < 5; ++k) {
+          run.X[k] = _mm512_mask_blend_epi64(ok, run.X[k], X3[k]);
+          run.Y[k] = _mm512_mask_blend_epi64(ok, run.Y[k], Y3[k]);
+          run.Z[k] = _mm512_mask_blend_epi64(ok, run.Z[k], Z3[k]);
+        }
+        if (exc) {
+          for (int l = 0; l < nl; ++l) {
+            if (!((exc >> l) & 1)) continue;
+            G1Jac g = jac8_lane(run, l, F);
+            const Aff52 &b = allbk[(size_t)wis[l] * (size_t)nbuckets + d];
+            u64 bx4[4], by4[4];
+            limb52_to_mont256(b.x, bx4, F);
+            limb52_to_mont256(b.y, by4, F);
+            jac_add_mixed(g, g, bx4, by4);
+            jac8_set_lane(run, l, g, F);
+          }
+        }
+      }
+      if (fresh) {
+        for (int k = 0; k < 5; ++k) {
+          run.X[k] = _mm512_mask_blend_epi64(fresh, run.X[k], x2[k]);
+          run.Y[k] = _mm512_mask_blend_epi64(fresh, run.Y[k], y2[k]);
+          run.Z[k] = _mm512_mask_blend_epi64(fresh, run.Z[k], onev[k]);
+        }
+        run.inf &= (__mmask8)~fresh;
+      }
+    }
+    // ws += run (add-2007-bl), lanes with run finite
+    const __mmask8 a2 = act_lanes & (__mmask8)~run.inf;
+    if (a2) {
+      const __mmask8 copy = a2 & ws.inf;
+      const __mmask8 addm = a2 & (__mmask8)~ws.inf;
+      if (addm) {
+        __m512i Z1Z1[5], Z2Z2[5], U1[5], U2[5], S1[5], S2[5], H[5], Rr[5];
+        __m512i HH[5], HHH[5], V[5], t[5], t2[5], X3[5], Y3[5], Z3[5];
+        mont52_mul8(Z1Z1, ws.Z, ws.Z, p, pinv);
+        mont52_mul8(Z2Z2, run.Z, run.Z, p, pinv);
+        mont52_mul8(U1, ws.X, Z2Z2, p, pinv);
+        mont52_mul8(U2, run.X, Z1Z1, p, pinv);
+        mont52_mul8(t, ws.Y, run.Z, p, pinv);
+        mont52_mul8(S1, t, Z2Z2, p, pinv);
+        mont52_mul8(t, run.Y, ws.Z, p, pinv);
+        mont52_mul8(S2, t, Z1Z1, p, pinv);
+        sub_lazy8(H, U2, U1, p2, comp2p);
+        sub_lazy8(Rr, S2, S1, p2, comp2p);
+        const __mmask8 exc = addm & is0_lazy8v(H, p);
+        const __mmask8 ok = addm & (__mmask8)~exc;
+        mont52_mul8(HH, H, H, p, pinv);
+        mont52_mul8(HHH, H, HH, p, pinv);
+        mont52_mul8(V, U1, HH, p, pinv);
+        mont52_mul8(t, Rr, Rr, p, pinv);
+        sub_lazy8(t, t, HHH, p2, comp2p);
+        add_lazy8(t2, V, V, comp2p);
+        sub_lazy8(X3, t, t2, p2, comp2p);
+        sub_lazy8(t, V, X3, p2, comp2p);
+        mont52_mul8(t, Rr, t, p, pinv);
+        mont52_mul8(t2, S1, HHH, p, pinv);
+        sub_lazy8(Y3, t, t2, p2, comp2p);
+        mont52_mul8(t, ws.Z, run.Z, p, pinv);
+        mont52_mul8(Z3, t, H, p, pinv);
+        for (int k = 0; k < 5; ++k) {
+          ws.X[k] = _mm512_mask_blend_epi64(ok, ws.X[k], X3[k]);
+          ws.Y[k] = _mm512_mask_blend_epi64(ok, ws.Y[k], Y3[k]);
+          ws.Z[k] = _mm512_mask_blend_epi64(ok, ws.Z[k], Z3[k]);
+        }
+        if (exc) {
+          for (int l = 0; l < nl; ++l) {
+            if (!((exc >> l) & 1)) continue;
+            G1Jac g = jac8_lane(ws, l, F);
+            G1Jac r = jac8_lane(run, l, F);
+            g1_add_jac(g, r);
+            jac8_set_lane(ws, l, g, F);
+          }
+        }
+      }
+      if (copy) {
+        for (int k = 0; k < 5; ++k) {
+          ws.X[k] = _mm512_mask_blend_epi64(copy, ws.X[k], run.X[k]);
+          ws.Y[k] = _mm512_mask_blend_epi64(copy, ws.Y[k], run.Y[k]);
+          ws.Z[k] = _mm512_mask_blend_epi64(copy, ws.Z[k], run.Z[k]);
+        }
+        ws.inf &= (__mmask8)~copy;
+      }
+    }
+  }
+  for (int l = 0; l < nl; ++l) outs[l] = jac8_lane(ws, l, F);
+}
+
 // 52-native batch-affine window fill: buckets AND bases in mont260
 // 52-limb form.  `bases_xy` (mont256) is still taken for the Jacobian
 // bail tier.
-static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
+// Returns true when `bk_ext` (caller-zeroed, nbuckets entries) was filled
+// and the caller must reduce it (the vectorized cross-window suffix);
+// false when *out was already computed via a fallback tier (small/top
+// window, conflict bail) or the internal suffix (bk_ext == nullptr).
+static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
                              const int32_t *sd, long n, int c, int nwin,
-                             int wi, G1Jac *out) {
+                             int wi, G1Jac *out, Aff52 *bk_ext = nullptr) {
   Ifma52Field &F = fq52_field();
   const long nbuckets = (1L << (c - 1)) + 1;
   const long B = 2048;
@@ -1809,9 +2112,9 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     } else {
       g1_window_sum_jac(bases_xy, sd, n, c, nwin, wi, out);
     }
-    return;
+    return false;
   }
-  Aff52 *bk = new Aff52[nbuckets]();
+  Aff52 *bk = bk_ext ? bk_ext : new Aff52[nbuckets]();
   int *stamp = new int[nbuckets];
   memset(stamp, 0xff, nbuckets * sizeof(int));
   std::vector<long> cur, next;
@@ -1829,7 +2132,7 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
   unsigned char *dbl = new unsigned char[B];
   u64 *scratch = new u64[(size_t)8 * 5 * B];
   auto cleanup = [&]() {
-    delete[] bk;
+    if (!bk_ext) delete[] bk;
     delete[] stamp;
     delete[] add_bkt;
     delete[] add_pt;
@@ -1840,6 +2143,7 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     delete[] scratch;
   };
   int chunk_id = 0;
+  long long fl0 = msm_prof_enabled() ? prof_now_ns() : 0;
   while (!cur.empty()) {
     next.clear();
     size_t processed = 0;
@@ -1887,7 +2191,9 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
         continue;
       }
+      long long ap0 = msm_prof_enabled() ? prof_now_ns() : 0;
       g1_chunk_apply_52(bk, b52, add_bkt, add_pt, negf, dbl, m, x3a, y3a, scratch);
+      if (ap0) g_prof_apply_ns += prof_now_ns() - ap0;
       for (long j = 0; j < m; ++j) {
         memcpy(bk[add_bkt[j]].x, x3a[j], 40);
         memcpy(bk[add_bkt[j]].y, y3a[j], 40);
@@ -1895,6 +2201,8 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
       if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
     }
     if (bail || next.size() * 4 > cur.size()) {
+      if (fl0) g_prof_fill_ns += prof_now_ns() - fl0;
+      long long bs0 = msm_prof_enabled() ? prof_now_ns() : 0;
       G1Jac *jb = new G1Jac[nbuckets];
       memset(jb, 0, (size_t)nbuckets * sizeof(G1Jac));
       next.insert(next.end(), cur.begin() + processed, cur.end());
@@ -1905,6 +2213,10 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         u64 ys[4];
         signed_pt_y(ys, x + 4, dgt < 0);
         jac_add_mixed(jb[bno], jb[bno], x, ys);
+      }
+      if (bs0) {
+        g_prof_bailfill_ns += prof_now_ns() - bs0;
+        bs0 = prof_now_ns();
       }
       G1Jac run, wsum;
       memset(&run, 0, sizeof(run));
@@ -1919,13 +2231,21 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         }
         g1_add_jac(wsum, run);
       }
+      if (bs0) g_prof_suffix_ns += prof_now_ns() - bs0;
       delete[] jb;
       cleanup();
       *out = wsum;
-      return;
+      return false;
     }
     cur.swap(next);
   }
+  if (fl0) g_prof_fill_ns += prof_now_ns() - fl0;  // incl. apply; sched = fill - apply
+  if (bk_ext) {
+    // caller reduces this window through the 8-lane vector suffix
+    cleanup();
+    return true;
+  }
+  long long sf0 = msm_prof_enabled() ? prof_now_ns() : 0;
   G1Jac run, wsum;
   memset(&run, 0, sizeof(run));
   memset(&wsum, 0, sizeof(wsum));
@@ -1938,8 +2258,10 @@ static void g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     }
     g1_add_jac(wsum, run);
   }
+  if (sf0) g_prof_suffix_ns += prof_now_ns() - sf0;
   cleanup();
   *out = wsum;
+  return false;
 }
 
 // ---- Fq2 vector helpers (u^2 = -1): componentwise lazy-domain ops on
@@ -3323,15 +3645,55 @@ void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
       g1_bases_to_52(pb, nr, b52);
     }
 #endif
+#if ZKP2P_HAVE_IFMA
+    // Deferred windows leave their bucket arrays in allbk; the 8-lane
+    // vector suffix then reduces up to 8 windows at once (one lane per
+    // window) instead of 2^(c-1) serial Jacobian adds per window.
+    const long nbuckets52 = (1L << (c - 1)) + 1;
+    Aff52 *allbk = nullptr;
+    unsigned char *defer = nullptr;
+    // Defer only single-threaded: with worker threads each window's
+    // serial suffix already runs CONCURRENTLY on its own worker, and a
+    // post-join vector pass would serialize that tail instead.
+    if (b52 && n_threads <= 1) {
+      allbk = new Aff52[(size_t)nwin * (size_t)nbuckets52]();
+      defer = new unsigned char[nwin]();
+    }
+#endif
     run_window_sums(nwin, n_threads, wins, [&](int wi, G1Jac *o) {
 #if ZKP2P_HAVE_IFMA
       if (b52) {
-        g1_window_sum_52(pb, b52, sd, nr, c, nwin, wi, o);
+        if (!allbk) {  // multi-threaded: internal per-worker suffix
+          g1_window_sum_52(pb, b52, sd, nr, c, nwin, wi, o);
+          return;
+        }
+        defer[wi] = g1_window_sum_52(pb, b52, sd, nr, c, nwin, wi, o,
+                                     allbk + (size_t)wi * (size_t)nbuckets52)
+                        ? 1
+                        : 0;
         return;
       }
 #endif
       g1_window_sum(pb, sd, nr, c, nwin, wi, o);
     });
+#if ZKP2P_HAVE_IFMA
+    if (allbk) {
+      long long sf0 = msm_prof_enabled() ? prof_now_ns() : 0;
+      int lanes[8], nl = 0;
+      G1Jac louts[8];
+      for (int wi = 0; wi <= nwin; ++wi) {
+        if (wi < nwin && defer[wi]) lanes[nl++] = wi;
+        if (nl == 8 || (wi == nwin && nl > 0)) {
+          g1_suffix8(allbk, nbuckets52, lanes, nl, louts);
+          for (int k = 0; k < nl; ++k) wins[lanes[k]] = louts[k];
+          nl = 0;
+        }
+      }
+      if (sf0) g_prof_suffix_ns += prof_now_ns() - sf0;
+      delete[] allbk;
+      delete[] defer;
+    }
+#endif
 #if ZKP2P_HAVE_IFMA
     delete[] b52;
 #endif

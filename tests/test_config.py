@@ -37,6 +37,7 @@ def test_env_overrides_every_knob():
         "ZKP2P_NATIVE_IFMA": "0",
         "ZKP2P_NATIVE_THREADS": "7",
         "ZKP2P_NO_CACHE": "1",
+        "ZKP2P_MSM_PROF": "1",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False

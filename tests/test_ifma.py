@@ -380,3 +380,41 @@ def test_g2_msm_affine_bail_path_matches_scalar():
         assert ref.returncode == 0, ref.stderr[-800:]
         want = np.array(json.loads(ref.stdout.strip().splitlines()[-1]), dtype=np.uint64)
     assert np.array_equal(out, want)
+
+
+def test_msm_suffix_vector_exceptional_lanes():
+    """Exceptional cases INSIDE the 8-lane vector suffix walk (not the
+    fill): run == bucket forces the doubling patch (scalar 5 and 6 on
+    the SAME point -> run = P after bucket 6, then P + P at bucket 5),
+    and run == -bucket forces the infinity transition (P at 6, -P at 5
+    via the negated-digit encoding).  Scalars < 2^12 keep every higher
+    window empty, so the walk's state is exactly these lanes."""
+    lib = _setup()
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_add, g1_mul
+
+    cases = []
+    # doubling inside the suffix: same point in buckets 5 and 6
+    P = g1_mul(G1_GENERATOR, 11)
+    cases.append(([P, P], [5, 6]))
+    # cancellation to infinity mid-walk, then a later bucket revives run
+    Q = g1_mul(G1_GENERATOR, 23)
+    cases.append(([Q, Q, g1_mul(G1_GENERATOR, 7)], [6, R - 6, 3]))
+    # wsum-side equality: buckets arranged so wsum == run at some step
+    cases.append(([P, P, P], [2, 1, 3]))
+    for base_pts, scs in cases:
+        n = len(base_pts)
+        bases = np.zeros((n, 8), dtype=np.uint64)
+        for i, pt in enumerate(base_pts):
+            bases[i, :4] = np.frombuffer(pt[0].to_bytes(32, "little"), dtype=np.uint64)
+            bases[i, 4:] = np.frombuffer(pt[1].to_bytes(32, "little"), dtype=np.uint64)
+        bm = np.zeros_like(bases)
+        lib.fp_to_mont(bases.ctypes.data_as(npv._u64p), bm.ctypes.data_as(npv._u64p), 2 * n)
+        sc = npv._scalars_to_u64(scs).copy()
+        out = np.zeros((2, 4), dtype=np.uint64)
+        lib.g1_msm_pippenger(bm.ctypes.data_as(npv._u64p), npv._p(sc), n, 13, npv._p(out))
+        ax, ay = native._u64x4_to_int(out[0]), native._u64x4_to_int(out[1])
+        want = None
+        for pt, s in zip(base_pts, scs):
+            want = g1_add(want, g1_mul(pt, s % R))
+        got = None if ax == 0 and ay == 0 else (ax, ay)
+        assert got == want, (scs, got, want)

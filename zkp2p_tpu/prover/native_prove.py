@@ -146,13 +146,19 @@ def _pick_window(n: int, g2: bool = False) -> int:
     the big domains reach c=17 while the bench shape keeps its
     measured-best c=15 (signed sweep at 2^19: c=15 6.3s, c=16 7.6s)."""
     if not g2 and _lib() is not None and _lib().zkp2p_ifma_available():
-        # IFMA regime (G1 only — the vector chunk apply has no Fq2
-        # counterpart yet): the vectorized batch-affine fill costs ~3x
-        # less per add than the scalar one, so the fill/reduction
-        # optimum shifts to a smaller window (reduction cost scales
-        # with 2^c, fill with ceil(254/c); measured sweep at n=2^19:
-        # c=14 beats c=17 once the fill is 8-wide).
-        return max(4, min(14, n.bit_length() - 5))
+        # IFMA regime (G1 only) with the 8-lane vector suffix (csrc
+        # g1_suffix8): the serial per-window reduction that clamped the
+        # r5 sweep at c=14 is vectorized across windows, so wider
+        # windows win again (fill scales with ceil(254/c)).  Measured
+        # on the vector-suffix build, random full-width scalars:
+        #   2^15: c15 166 ms vs c14 189;  2^17: c15 404 vs c14 495;
+        #   2^19: c16 1456 vs c14 1808 (c17 equal — keep the smaller).
+        bl = n.bit_length()
+        if bl >= 20:
+            return 16
+        if bl >= 16:  # sweep coverage starts at 2^15; below it keep the old curve
+            return 15
+        return max(4, bl - 5)
     return max(4, min(17, n.bit_length() - 5))
 
 

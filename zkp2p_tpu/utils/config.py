@@ -76,6 +76,8 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     # compilation-cache opt-out (read by tests/conftest.py at process
     # start as well — the env var is authoritative there by necessity)
     "no_cache": ("ZKP2P_NO_CACHE", _BOOL, False),
+    # debug: native MSM phase counters (csrc zkp2p_msm_prof_dump)
+    "msm_prof": ("ZKP2P_MSM_PROF", _BOOL, False),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -98,6 +100,7 @@ class ProverConfig:
     native_ifma: bool = True
     native_threads: Optional[int] = None
     no_cache: bool = False
+    msm_prof: bool = False
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 
