@@ -18,6 +18,7 @@
 #include <memory>
 #include <mutex>
 #include <utility>
+#include <cassert>
 #include <cstdlib>
 #include <cstring>
 #include <thread>
@@ -1909,12 +1910,21 @@ static void jac8_set_lane(Jac8 &s, int l, const G1Jac &g, const Ifma52Field &F) 
   v8_set_lane52(s.Z, l, t260);
 }
 
-// Run up to 8 windows' suffix walks in lanes.  allbk: nwin x nbuckets
-// canonical-mont260 bucket arrays (all-zero = empty); wis[0..nl): the
-// window index each lane reduces; outs[l]: the window sum (Jacobian
-// mont256), written for l < nl.
+// Run up to SUFFIX_MAX_LANES windows' suffix walks in lanes (8 per
+// group, groups interleaved).  allbk: nwin x nbuckets canonical-mont260
+// bucket arrays (all-zero = empty); wis[0..nl_total): the window index
+// each lane reduces; outs[l]: that window's sum (Jacobian mont256).
+// Up to MAXG groups of 8 window-lanes walk INTERLEAVED inside one
+// d-loop: each group's mixed/full adds are a serial mont52_mul8
+// dependency chain (~25 muls deep), so consecutive independent groups
+// give the out-of-order engine real overlap that back-to-back
+// single-group calls cannot.
+static constexpr int SUFFIX_MAXG = 3;           // interleaved lane-groups
+static constexpr int SUFFIX_MAX_LANES = 8 * SUFFIX_MAXG;  // caller batch cap
+
 static void g1_suffix8(const Aff52 *allbk, long nbuckets, const int *wis,
-                       int nl, G1Jac *outs) {
+                       int nl_total, G1Jac *outs) {
+  constexpr int MAXG = SUFFIX_MAXG;
   Ifma52Field &F = fq52_field();
   __m512i p[5], p2[5], comp2p[5], onev[5];
   u64 one52[5] = {1, 0, 0, 0, 0}, one260[5];
@@ -1927,24 +1937,44 @@ static void g1_suffix8(const Aff52 *allbk, long nbuckets, const int *wis,
   }
   const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
 
-  alignas(64) long long lane_base[8];
-  for (int l = 0; l < 8; ++l) {
-    int w = l < nl ? wis[l] : wis[0];
-    lane_base[l] = (long long)((size_t)w * (size_t)nbuckets * sizeof(Aff52));
+  const int ngroups = (nl_total + 7) / 8;
+  assert(ngroups <= MAXG);
+  int nlg[MAXG];
+  const int *wisg[MAXG];
+  __m512i vbaseg[MAXG];
+  __mmask8 actg[MAXG];
+  alignas(64) long long lane_baseg[MAXG][8];
+  for (int g = 0; g < ngroups; ++g) {
+    nlg[g] = nl_total - 8 * g > 8 ? 8 : nl_total - 8 * g;
+    wisg[g] = wis + 8 * g;
+    for (int l = 0; l < 8; ++l) {
+      int w = l < nlg[g] ? wisg[g][l] : wisg[g][0];
+      lane_baseg[g][l] = (long long)((size_t)w * (size_t)nbuckets * sizeof(Aff52));
+    }
+    vbaseg[g] = _mm512_load_si512(lane_baseg[g]);
+    actg[g] = (__mmask8)((1u << nlg[g]) - 1);
   }
-  const __m512i vbase = _mm512_load_si512(lane_base);
-  const __mmask8 act_lanes = (__mmask8)((1u << nl) - 1);
 
-  Jac8 run, ws;
-  for (int k = 0; k < 5; ++k) {
-    run.X[k] = run.Y[k] = run.Z[k] = onev[k];
-    ws.X[k] = ws.Y[k] = ws.Z[k] = onev[k];
+  Jac8 rung[MAXG], wsg[MAXG];
+  for (int g = 0; g < ngroups; ++g) {
+    for (int k = 0; k < 5; ++k) {
+      rung[g].X[k] = rung[g].Y[k] = rung[g].Z[k] = onev[k];
+      wsg[g].X[k] = wsg[g].Y[k] = wsg[g].Z[k] = onev[k];
+    }
+    rung[g].inf = 0xFF;
+    wsg[g].inf = 0xFF;
   }
-  run.inf = 0xFF;
-  ws.inf = 0xFF;
 
   const char *base_ptr = (const char *)allbk;
   for (long d = nbuckets - 1; d >= 1; --d) {
+   for (int gi = 0; gi < ngroups; ++gi) {
+    Jac8 &run = rung[gi];
+    Jac8 &ws = wsg[gi];
+    const __m512i vbase = vbaseg[gi];
+    const __mmask8 act_lanes = actg[gi];
+    const int nl = nlg[gi];
+    const int *wisl = wisg[gi];
+    const long long *lane_base = lane_baseg[gi];
     // the walk is perfectly predictable but gather-driven (no hardware
     // prefetch): pull the next TWO steps' bucket lines ahead of time —
     // 8 lanes x 80 B spans two cache lines each
@@ -2011,7 +2041,7 @@ static void g1_suffix8(const Aff52 *allbk, long nbuckets, const int *wis,
           for (int l = 0; l < nl; ++l) {
             if (!((exc >> l) & 1)) continue;
             G1Jac g = jac8_lane(run, l, F);
-            const Aff52 &b = allbk[(size_t)wis[l] * (size_t)nbuckets + d];
+            const Aff52 &b = allbk[(size_t)wisl[l] * (size_t)nbuckets + d];
             u64 bx4[4], by4[4];
             limb52_to_mont256(b.x, bx4, F);
             limb52_to_mont256(b.y, by4, F);
@@ -2086,8 +2116,10 @@ static void g1_suffix8(const Aff52 *allbk, long nbuckets, const int *wis,
         ws.inf &= (__mmask8)~copy;
       }
     }
+   }
   }
-  for (int l = 0; l < nl; ++l) outs[l] = jac8_lane(ws, l, F);
+  for (int g = 0; g < ngroups; ++g)
+    for (int l = 0; l < nlg[g]; ++l) outs[8 * g + l] = jac8_lane(wsg[g], l, F);
 }
 
 // 52-native batch-affine window fill: buckets AND bases in mont260
@@ -3646,9 +3678,10 @@ void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
     }
 #endif
 #if ZKP2P_HAVE_IFMA
-    // Deferred windows leave their bucket arrays in allbk; the 8-lane
-    // vector suffix then reduces up to 8 windows at once (one lane per
-    // window) instead of 2^(c-1) serial Jacobian adds per window.
+    // Deferred windows leave their bucket arrays in allbk; the vector
+    // suffix then reduces up to SUFFIX_MAX_LANES windows in one call
+    // (8-lane groups, interleaved) instead of 2^(c-1) serial Jacobian
+    // adds per window.
     const long nbuckets52 = (1L << (c - 1)) + 1;
     Aff52 *allbk = nullptr;
     unsigned char *defer = nullptr;
@@ -3679,11 +3712,11 @@ void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
 #if ZKP2P_HAVE_IFMA
     if (allbk) {
       long long sf0 = msm_prof_enabled() ? prof_now_ns() : 0;
-      int lanes[8], nl = 0;
-      G1Jac louts[8];
+      int lanes[SUFFIX_MAX_LANES], nl = 0;
+      G1Jac louts[SUFFIX_MAX_LANES];
       for (int wi = 0; wi <= nwin; ++wi) {
         if (wi < nwin && defer[wi]) lanes[nl++] = wi;
-        if (nl == 8 || (wi == nwin && nl > 0)) {
+        if (nl == SUFFIX_MAX_LANES || (wi == nwin && nl > 0)) {
           g1_suffix8(allbk, nbuckets52, lanes, nl, louts);
           for (int k = 0; k < nl; ++k) wins[lanes[k]] = louts[k];
           nl = 0;
