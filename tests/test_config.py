@@ -30,6 +30,7 @@ def test_env_overrides_every_knob():
         "ZKP2P_MSM_UNIFIED": "1",
         "ZKP2P_MSM_AFFINE": "1",
         "ZKP2P_MSM_H": "bucket",
+        "ZKP2P_MSM_GLV": "1",
         "ZKP2P_BATCH_CHUNK": "8",
         "ZKP2P_FIELD_CONV": "limb_major",
         "ZKP2P_FIELD_MUL": "pallas",
@@ -42,6 +43,7 @@ def test_env_overrides_every_knob():
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
     assert cfg.msm_unified == "1" and cfg.msm_affine == "1" and cfg.msm_h == "bucket"
+    assert cfg.msm_glv is True
     assert cfg.batch_chunk == "8"
     assert cfg.field_conv == "limb_major" and cfg.field_mul == "pallas" and cfg.curve_kernel == "xla"
     assert cfg.native_ifma is False and cfg.native_threads == 7 and cfg.no_cache is True

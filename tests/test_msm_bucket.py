@@ -84,6 +84,26 @@ def test_msm_bucket_all_zero_scalars():
     _diff_bucket29(pts, [0] * 8)
 
 
+def test_msm_bucket_glv_vs_host_w4():
+    """GLV planes through the bucket MSM: half the sorted-prefix planes
+    over the endomorphism-doubled base axis, same host-oracle result.
+    Reuses the w=4 compile budget (K=8) like the plain bucket tests."""
+    n = 14
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(n)]
+    sc = [rng.randrange(R) for _ in range(n)]
+    pts[2] = None
+    sc[3] = 0
+    sc[4] = 1
+    sc[5] = R - 1
+    pts[7] = pts[6]
+    glv_bases = jmsm.glv_extend_bases(g1_to_affine_arrays(pts))
+    mags, negs = jmsm.glv_signed_planes_from_limbs(_limbs(sc), 4)
+    got = g1_jac_to_host(
+        jax.jit(lambda b, m, s: msm_bucket_affine(G1J, b, m, s, window=4))(glv_bases, mags, negs)
+    )[0]
+    assert got == g1_msm(pts, sc)
+
+
 @pytest.mark.xslow
 def test_msm_bucket_vs_host_w8_batched():
     """w=8 (K=128) under vmap — the batched-prover shape.  XLA:CPU

@@ -115,3 +115,30 @@ def test_msm_windowed_signed_g2_vs_host():
     mags, negs = jmsm.signed_digit_planes_from_limbs(_limbs(scalars), 4)
     got = g2_jac_to_host(jmsm.msm_windowed_signed(G2J, g2_to_affine_arrays(pts), mags, negs, lanes=8, window=4))[0]
     assert got == g2_msm(pts, scalars)
+
+
+def test_msm_windowed_glv_vs_plain():
+    """GLV (half planes over the endomorphism-doubled base axis) and the
+    plain signed path must agree with the host oracle on the SAME MSM —
+    infinity holes, 0/1/r-1 scalars, duplicate bases included."""
+    n = 19
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(n)]
+    scalars = [rng.randrange(R) for _ in range(n)]
+    pts[1] = None
+    pts[4] = pts[3]
+    scalars[2] = 0
+    scalars[5] = 1
+    scalars[6] = R - 1
+    limbs = _limbs(scalars)
+    bases = g1_to_affine_arrays(pts)
+    glv_bases = jmsm.glv_extend_bases(bases)
+    mags, negs = jmsm.glv_signed_planes_from_limbs(limbs, 4)
+    from zkp2p_tpu.field.bn254 import glv_num_planes
+
+    assert mags.shape == (glv_num_planes(4), 2 * n)
+    got = g1_jac_to_host(
+        jax.jit(lambda b, m, s: jmsm.msm_windowed_signed(G1J, b, m, s, lanes=8, window=4))(
+            glv_bases, mags, negs
+        )
+    )[0]
+    assert got == g1_msm(pts, scalars)

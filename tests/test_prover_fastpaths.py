@@ -28,3 +28,17 @@ def test_witness_to_device_matches_host_mont_golden():
     assert from_ints.dtype == from_u64.dtype == np.uint32
     assert (from_ints == golden).all()
     assert (from_u64 == golden).all()
+
+
+def test_witness_u64_fast_path_rejects_unreduced():
+    """The (n, 4)-u64 fast path trusts its rows to be < R; an unreduced
+    row must raise at the witness_to_device boundary instead of silently
+    emitting a wrong Montgomery form (ADVICE r5 #3)."""
+    import pytest
+
+    for bad in (R, R + 1, (1 << 256) - 1):
+        rows = _to_u64_rows([1, 2, bad, 3])
+        with pytest.raises(ValueError, match="not reduced"):
+            witness_to_device(rows)
+    # boundary value R - 1 stays accepted
+    witness_to_device(_to_u64_rows([R - 1]))

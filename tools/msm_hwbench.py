@@ -35,7 +35,19 @@ def main():
     ap.add_argument("--skip-msm", action="store_true")
     ap.add_argument("--skip-adds", action="store_true")
     ap.add_argument("--signed", action="store_true", help="signed digit recoding (half-size table)")
+    glv_grp = ap.add_mutually_exclusive_group()
+    glv_grp.add_argument(
+        "--glv", action="store_true",
+        help="GLV endomorphism arm: half the signed digit planes over the "
+        "endomorphism-doubled [P, phi(P)] base axis (implies --signed)",
+    )
+    glv_grp.add_argument(
+        "--no-glv", action="store_true",
+        help="explicit non-GLV arm (the default; named so A/B run logs are self-labelling)",
+    )
     args = ap.parse_args()
+    if args.glv:
+        args.signed = True
 
     import jax
     import jax.numpy as jnp
@@ -51,7 +63,11 @@ def main():
     from zkp2p_tpu.field.jfield import field_mul_impl
 
     curve_impl = "pallas" if G1J._pallas() else "xla"
-    print(f"device={dev} curve={curve_impl} fieldmul={field_mul_impl()}", flush=True)
+    print(
+        f"device={dev} curve={curve_impl} fieldmul={field_mul_impl()} "
+        f"glv={'on' if args.glv else 'off'}",
+        flush=True,
+    )
 
     from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
     from zkp2p_tpu.curve.jcurve import g1_to_affine_arrays
@@ -102,9 +118,17 @@ def main():
     # ---- full windowed MSM ----
     limbs_np = rng.integers(0, 1 << 16, size=(n, 16), dtype=np.uint32)
     limbs_np[:, 15] &= 0x3FFF  # < 2^254, like Fr scalars (signed recoding bound)
-    lanes = args.lanes or default_lanes(n)
+    lanes = args.lanes or default_lanes(2 * n if args.glv else n)
     tag = f"n={n} lanes={lanes} w={args.window}"
-    if args.signed:
+    if args.glv:
+        from zkp2p_tpu.ops.msm import glv_extend_bases, glv_signed_planes_from_limbs
+
+        gb = glv_extend_bases(bases)
+        mags, negs = glv_signed_planes_from_limbs(jnp.asarray(limbs_np), args.window)
+        f = jax.jit(lambda b, m, s: msm_windowed_signed(curve, b, m, s, lanes=lanes, window=args.window))
+        fargs = (gb, mags, negs)
+        tag += f" glv({mags.shape[0]} planes x 2n bases)"
+    elif args.signed:
         mags, negs = signed_digit_planes_from_limbs(jnp.asarray(limbs_np), args.window)
         f = jax.jit(lambda b, m, s: msm_windowed_signed(curve, b, m, s, lanes=lanes, window=args.window))
         fargs = (bases, mags, negs)

@@ -39,7 +39,9 @@ def main():
     assert lib is not None, "native library unavailable"
     from zkp2p_tpu.utils.config import load_config
 
-    nthreads = load_config().native_threads
+    cfg = load_config()
+    print(f"native msm mode: glv={'on' if cfg.msm_glv else 'off'}", flush=True)
+    nthreads = cfg.native_threads
     if nthreads and nthreads > 1:
         print(
             f"WARNING: ZKP2P_NATIVE_THREADS={nthreads} — fill counters sum "

@@ -50,6 +50,9 @@ from ..field.jfield import FR, NUM_LIMBS, lazy_segment_sum_mod
 from ..ops.msm import (
     default_lanes,
     digit_planes_from_limbs,
+    glv_extend_bases,
+    glv_sel,
+    glv_signed_planes_from_limbs,
     msm_windowed,
     msm_windowed_signed,
     signed_digit_planes_from_limbs,
@@ -82,6 +85,7 @@ MSM_SIGNED = _CFG.msm_signed
 MSM_UNIFIED = _CFG.msm_unified
 MSM_AFFINE = _CFG.msm_affine
 MSM_H = _CFG.msm_h
+MSM_GLV = _CFG.msm_glv
 BATCH_CHUNK = _CFG.batch_chunk
 H_BUCKET_WINDOW = 16
 
@@ -97,6 +101,13 @@ def _affine() -> bool:
 
 def _h_bucket() -> bool:
     return MSM_SIGNED and (MSM_H == "bucket" or (MSM_H == "auto" and _on_tpu()))
+
+
+def _glv() -> bool:
+    """GLV endomorphism decomposition for the G1 MSMs (ZKP2P_MSM_GLV).
+    Rides the signed-digit machinery, so MSM_SIGNED off disables it —
+    the unsigned path stays the byte-stable fallback."""
+    return MSM_GLV and MSM_SIGNED
 
 
 @dataclass
@@ -393,6 +404,31 @@ def _is_u64_witness(witness) -> bool:
     )
 
 
+_R_U64 = np.frombuffer(R.to_bytes(32, "little"), dtype="<u8").copy()
+
+
+def _check_u64_reduced(rows: np.ndarray) -> None:
+    """Reject (n, 4)-u64 witness rows >= R.  The fast path trusts its
+    input to already be reduced (the .bench_cache contract) — an
+    unreduced row would silently emit a wrong Montgomery form and an
+    unverifiable proof, so the boundary asserts it (8 vectorized
+    compares; negligible next to to_mont)."""
+    ge = np.zeros(rows.shape[0], dtype=bool)
+    eq = np.ones(rows.shape[0], dtype=bool)
+    for j in range(3, -1, -1):
+        col = rows[:, j]
+        ge |= eq & (col > _R_U64[j])
+        eq &= col == _R_U64[j]
+    ge |= eq  # exactly R is unreduced too
+    if ge.any():
+        i = int(np.flatnonzero(ge)[0])
+        raise ValueError(
+            f"witness row {i} is not reduced below the Fr modulus: the "
+            f"(n, 4)-u64 fast path requires canonical scalars (< R); "
+            f"reduce mod R before witness_to_device"
+        )
+
+
 def _witness_std_limbs(witness) -> np.ndarray:
     """Host witness (int sequence or (n, 4) u64 limb rows) -> (n, 16)
     u32 standard-form 16-bit limbs, fully vectorized (one C-speed bytes
@@ -401,6 +437,8 @@ def _witness_std_limbs(witness) -> np.ndarray:
 
     if not _is_u64_witness(witness):
         witness = _scalars_to_u64([int(w) % R for w in witness])
+    else:
+        _check_u64_reduced(witness)
     return _u64_to_limbs16(witness)
 
 
@@ -445,8 +483,27 @@ def _h_and_planes(dpk: DeviceProvingKey, w_mont: jnp.ndarray):
     h = h_evals(dpk, w_mont)
     if MSM_SIGNED:
         w_std = FR.from_mont(w_mont)
-        w_mags, w_negs = signed_digit_planes_from_limbs(w_std, MSM_WINDOW)
         h_window = H_BUCKET_WINDOW if _h_bucket() else MSM_WINDOW
+        if _glv():
+            # G1 planes in the GLV-doubled column layout (k1 digits for
+            # P_i, k2 digits for phi(P_i)): HALF the digit planes over
+            # twice the columns.  The G2 MSM has no cheap endomorphism
+            # here, so it keeps full-width signed planes — but ONLY for
+            # the b_sel wires it can consume (recoding all n_wires just
+            # for b2 would materialize ~65 planes x n_wires per proof);
+            # its columns are therefore b_sel POSITIONS, not wire ids.
+            w_mags, w_negs = glv_signed_planes_from_limbs(w_std, MSM_WINDOW)
+            g2_planes = signed_digit_planes_from_limbs(
+                jnp.take(w_std, dpk.b_sel, axis=-2), MSM_WINDOW
+            )
+            h_mags, h_negs = glv_signed_planes_from_limbs(FR.from_mont(h), h_window)
+            if int(dpk.a_nsel.shape[0]) > 0:
+                n4_mags, n4_negs = signed_digit_planes_from_limbs(w_std, 4)
+                narrow = (n4_mags[-NARROW_PLANES:], n4_negs[-NARROW_PLANES:])
+            else:
+                narrow = ()
+            return ((w_mags, w_negs), narrow, g2_planes), (h_mags, h_negs)
+        w_mags, w_negs = signed_digit_planes_from_limbs(w_std, MSM_WINDOW)
         h_mags, h_negs = signed_digit_planes_from_limbs(FR.from_mont(h), h_window)
         # Narrow-class planes: witness wires with width bounds <= 2^11
         # only populate the last NARROW_PLANES signed w=4 digits — the
@@ -548,6 +605,21 @@ def _take_planes(planes, sel):
     return jnp.take(planes, sel, axis=-1)
 
 
+def _glv_key_bases(dpk: DeviceProvingKey, name: str, bases: AffPoint) -> AffPoint:
+    """GLV-doubled base set [P, phi(P)] for one query, memoised on the
+    key instance (one batched Fq mul per query per key — witness-
+    independent, like _split_cache)."""
+    cache = getattr(dpk, "_glv_cache", None)
+    if cache is None:
+        cache = {}
+        setattr(dpk, "_glv_cache", cache)
+    got = cache.get(name)
+    if got is None:
+        got = glv_extend_bases(bases)
+        cache[name] = got
+    return got
+
+
 def _take_bases(bases, pos):
     return tuple(jnp.take(c, pos, axis=0) for c in bases)
 
@@ -587,34 +659,51 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
         else (_jit_msm_g1_narrow, _jit_msm_g2_narrow)
     )
     w_all, h_planes = jh(dpk, w_mont)
-    if MSM_SIGNED:
-        w_planes, w_narrow = w_all
+    if _glv():
+        # GLV layout: G1 planes carry 2*n_wires columns (k1 digits for
+        # the P half, k2 for the phi(P) half); the G2 MSM keeps its own
+        # full-width planes.  G1 bases and column selectors lift to the
+        # doubled layout; everything downstream is shape-generic.
+        w_planes, w_narrow, g2_planes = w_all
+        g1_bases = lambda name, b: _glv_key_bases(dpk, name, b)  # noqa: E731
+        g1_cols = lambda sel: glv_sel(sel, dpk.n_wires)  # noqa: E731
     else:
-        w_planes, w_narrow = w_all, None
+        if MSM_SIGNED:
+            w_planes, w_narrow = w_all
+        else:
+            w_planes, w_narrow = w_all, None
+        g2_planes = w_planes
+        g1_bases = lambda name, b: b  # noqa: E731
+        g1_cols = lambda sel: sel  # noqa: E731
 
     if not classed:
+        a_b = g1_bases("a", dpk.a_bases)
+        b1_b = g1_bases("b1", dpk.b1_bases)
+        c_b = g1_bases("c", dpk.c_bases)
+        h_b = g1_bases("h", dpk.h_bases)
         # bucket-h mode: h no longer shares the unified executable, so
         # padding a/b1/c up to the (domain-sized) h base count would be
         # pure waste — unify the three query MSMs among themselves only.
         g1_n = 0 if not _unified() else max(
-            dpk.a_bases[0].shape[0], dpk.b1_bases[0].shape[0],
-            dpk.c_bases[0].shape[0],
-            *(() if _h_bucket() else (dpk.h_bases[0].shape[0],)),
+            a_b[0].shape[0], b1_b[0].shape[0], c_b[0].shape[0],
+            *(() if _h_bucket() else (h_b[0].shape[0],)),
         )
-        b_planes = _take_planes(w_planes, dpk.b_sel)
-        c_planes = _take_planes(w_planes, dpk.c_sel)
+        b_planes = _take_planes(w_planes, g1_cols(dpk.b_sel))
+        c_planes = _take_planes(w_planes, g1_cols(dpk.c_sel))
+        # GLV g2_planes are already gathered to the b_sel columns
+        b2_planes = g2_planes if _glv() else b_planes
         # windowed mode keeps the m1 wrapper so the compiled-executable
         # identity (and its persistent-cache entry) is unchanged
         h_acc = (
-            mh(dpk.h_bases, h_planes)
+            mh(h_b, h_planes)
             if _h_bucket()
-            else m1(*_pad_msm(dpk.h_bases, h_planes, g1_n))
+            else m1(*_pad_msm(h_b, h_planes, g1_n))
         )
         return (
-            m1(*_pad_msm(dpk.a_bases, w_planes, g1_n)),
-            m1(*_pad_msm(dpk.b1_bases, b_planes, g1_n)),
-            m2(dpk.b2_bases, b_planes),
-            m1(*_pad_msm(dpk.c_bases, c_planes, g1_n)),
+            m1(*_pad_msm(a_b, w_planes, g1_n)),
+            m1(*_pad_msm(b1_b, b_planes, g1_n)),
+            m2(dpk.b2_bases, b2_planes),
+            m1(*_pad_msm(c_b, c_planes, g1_n)),
             h_acc,
         )
 
@@ -627,6 +716,8 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
     if _unified():
         g1_wide_n = max(dpk.a_wsel.shape[0], dpk.b_wsel.shape[0], dpk.c_wsel.shape[0])
         g1_narrow_n = max(dpk.a_nsel.shape[0], dpk.b_nsel.shape[0], dpk.c_nsel.shape[0])
+        if _glv():
+            g1_wide_n *= 2  # wide-class MSMs run over the doubled base axis
 
     # The split bases/wire arrays depend only on the KEY — memoise them
     # on the dpk instance so the gathers (O(key size) HBM copies) run
@@ -646,14 +737,18 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
 
     def query(name, bases, nsel, wsel, wires_of):
         """One witness MSM (a/b1/c): narrow + wide class partial sums.
-        wires_of maps base positions to wire ids (None = identity)."""
+        wires_of maps base positions to wire ids (None = identity).
+        Under GLV only the WIDE class decomposes — narrow wires are
+        width-bounded below 2^11, where a 2-term split has nothing to
+        halve — so the narrow executable is byte-identical either way."""
         accs = []
         if int(nsel.shape[0]):
             nb, nw = key_split(name + ".n", bases, nsel, wires_of)
             accs.append(m1n(*_pad_msm(nb, _take_planes(w_narrow, nw), g1_narrow_n)))
         if int(wsel.shape[0]):
             wb, ww = key_split(name + ".w", bases, wsel, wires_of)
-            accs.append(m1(*_pad_msm(wb, _take_planes(w_planes, ww), g1_wide_n)))
+            wb = g1_bases(name + ".w", wb)
+            accs.append(m1(*_pad_msm(wb, _take_planes(w_planes, g1_cols(ww)), g1_wide_n)))
         return accs[0] if len(accs) == 1 else G1J.add(accs[0], accs[1])
 
     def query_g2(name, bases, nsel, wsel, wires_of):
@@ -663,7 +758,10 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
             accs.append(m2n(nb, _take_planes(w_narrow, nw)))
         if int(wsel.shape[0]):
             wb, ww = key_split(name + ".w", bases, wsel, wires_of)
-            accs.append(m2(wb, _take_planes(w_planes, ww)))
+            # GLV g2_planes carry b_sel POSITIONS (wsel indexes those);
+            # the plain path's full-wire planes gather by wire id
+            cols = wsel if _glv() else ww
+            accs.append(m2(wb, _take_planes(g2_planes, cols)))
         return accs[0] if len(accs) == 1 else G2J.add(accs[0], accs[1])
 
     return (
@@ -671,7 +769,7 @@ def _prove_device(dpk: DeviceProvingKey, w_mont: jnp.ndarray, batched: bool = Fa
         query("b1", dpk.b1_bases, dpk.b_nsel, dpk.b_wsel, dpk.b_sel),
         query_g2("b2", dpk.b2_bases, dpk.b_nsel, dpk.b_wsel, dpk.b_sel),
         query("c", dpk.c_bases, dpk.c_nsel, dpk.c_wsel, dpk.c_sel),
-        (mh if _h_bucket() else m1)(dpk.h_bases, h_planes),
+        (mh if _h_bucket() else m1)(g1_bases("h", dpk.h_bases), h_planes),
     )
 
 

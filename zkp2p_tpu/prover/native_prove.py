@@ -23,7 +23,18 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from ..field.bn254 import R, fr_domain_root
+from ..field.bn254 import (
+    GLV_BETA,
+    GLV_K1_TERMS,
+    GLV_K2_TERMS,
+    GLV_MAX_BITS,
+    GLV_MU1,
+    GLV_MU2,
+    P,
+    R,
+    fr_domain_root,
+    to_mont,
+)
 from ..field.tower import Fq2
 from ..native.lib import _scalars_to_u64, get_lib
 from ..snark.groth16 import Proof, coset_gen
@@ -53,6 +64,11 @@ def _lib():
         lib.g2_msm_pippenger.argtypes = [_u64p, _u64p, ctypes.c_long, ctypes.c_int, _u64p]
         lib.g1_msm_pippenger_mt.argtypes = [_u64p, _u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, _u64p]
         lib.g2_msm_pippenger_mt.argtypes = [_u64p, _u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, _u64p]
+        lib.g1_glv_phi_bases.argtypes = [_u64p, ctypes.c_long, _u64p, _u64p]
+        lib.g1_msm_pippenger_glv_mt.argtypes = [
+            _u64p, _u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            _u64p, ctypes.c_int, _u64p,
+        ]
         # Self-test the Fr multiplier before trusting proofs to it (the
         # same covenant native/lib.py applies to the Fq side).
         a, b = R - 987654321, 0xFEDCBA9876543210 << 128 | 0x42
@@ -91,8 +107,8 @@ _bases_cache: dict = {}
 _BASES_CACHE_CAP = 16
 
 
-def _bases_memo(bases, convert):
-    key = (id(bases[0]), id(bases[1]))
+def _bases_memo(bases, convert, tag: str = ""):
+    key = (id(bases[0]), id(bases[1]), tag)
     hit = _bases_cache.get(key)
     if hit is not None and hit[0] is bases[0] and hit[1] is bases[1]:
         return hit[2]
@@ -115,6 +131,57 @@ def _g1_bases_u64(bases) -> np.ndarray:
     return _bases_memo(bases, convert)
 
 
+_glv_consts_arr: Optional[np.ndarray] = None
+
+
+def _glv_consts() -> np.ndarray:
+    """GLV constants packed for the C runtime (csrc glv_split layout):
+    beta (Montgomery), the two Barrett mus, the four lattice-term
+    magnitudes, and the subtract-flag word — all DERIVED in field.bn254,
+    nothing hardcoded on either side."""
+    global _glv_consts_arr
+    if _glv_consts_arr is None:
+        mask = (1 << 64) - 1
+
+        def u64x4(v: int):
+            return [(v >> (64 * i)) & mask for i in range(4)]
+
+        flags = 0
+        mags = []
+        for j, (mag, sub) in enumerate(GLV_K1_TERMS):
+            mags += u64x4(mag)
+            flags |= int(sub) << j
+        for j, (mag, sub) in enumerate(GLV_K2_TERMS):
+            mags += u64x4(mag)
+            flags |= int(sub) << (2 + j)
+        _glv_consts_arr = np.array(
+            u64x4(to_mont(GLV_BETA, P)) + u64x4(GLV_MU1) + u64x4(GLV_MU2) + mags + [flags],
+            dtype=np.uint64,
+        )
+    return _glv_consts_arr
+
+
+def _g1_bases_glv_u64(bases) -> np.ndarray:
+    """AffPoint Montgomery limbs -> the GLV-doubled (2n, 8) u64 base set
+    [P, phi(P)] (csrc g1_glv_phi_bases).  Key-dependent only: memoized
+    beside the plain conversion so a service pays the n Fq muls once."""
+
+    def convert(b):
+        plain = _g1_bases_u64(b)
+        n = plain.shape[0]
+        phi = np.zeros_like(plain)
+        _lib().g1_glv_phi_bases(_p(plain), n, _p(_glv_consts()), _p(phi))
+        return np.ascontiguousarray(np.concatenate([plain, phi]))
+
+    return _bases_memo(bases, convert, tag="glv")
+
+
+def _use_glv() -> bool:
+    from ..utils.config import load_config
+
+    return load_config().msm_glv
+
+
 def _g2_bases_u64(bases) -> np.ndarray:
     """AffPoint ((n,2,16),(n,2,16)) -> (n, 16) u64 (x.c0 x.c1 y.c0 y.c1)."""
 
@@ -135,7 +202,7 @@ def _u64x4_to_int_arr(a: np.ndarray) -> list:
     return [int.from_bytes(a[i].tobytes(), "little") for i in range(a.shape[0])]
 
 
-def _pick_window(n: int, g2: bool = False) -> int:
+def _pick_window(n: int, g2: bool = False, threads: int = 1) -> int:
     """Pippenger window: ~log2(n) - 4 with SIGNED digits — the signed
     recoding halves the bucket count at a given c, so the sweet spot
     sits one window wider than the unsigned sweep (n=2^19: unsigned
@@ -153,13 +220,44 @@ def _pick_window(n: int, g2: bool = False) -> int:
         # on the vector-suffix build, random full-width scalars:
         #   2^15: c15 166 ms vs c14 189;  2^17: c15 404 vs c14 495;
         #   2^19: c16 1456 vs c14 1808 (c17 equal — keep the smaller).
+        # The vector suffix only engages SINGLE-threaded (csrc gates the
+        # deferred-bucket pass on n_threads <= 1: each worker already
+        # runs its own serial suffix concurrently) — so multi-threaded
+        # runs keep the r5 serial-suffix optimum of c=14 instead of
+        # paying a 4x longer per-window serial tail at c=15/16
+        # (ADVICE r5 #1).
         bl = n.bit_length()
         if bl >= 20:
-            return 16
-        if bl >= 16:  # sweep coverage starts at 2^15; below it keep the old curve
-            return 15
-        return max(4, bl - 5)
+            c = 16
+        elif bl >= 16:  # sweep coverage starts at 2^15; below it the old curve
+            c = 15
+        else:
+            c = max(4, bl - 5)
+        return min(c, 14) if threads > 1 else c
     return max(4, min(17, n.bit_length() - 5))
+
+
+def _pick_window_glv(n: int, threads: int = 1) -> int:
+    """Pippenger window for the GLV shape: 2n points of ~129-bit
+    half-scalars, nwin = ceil((GLV_MAX_BITS+1)/c).  Swept on the IFMA
+    build (min-of-reps, random full-width scalars, GLV arm):
+      2^15: c16 225 ms vs c15 253 / c17 533
+      2^17: c16 796 vs c15 933
+      2^19: c15 3173 vs c16 4383 — at 2^19 the c=16 deferred-suffix
+            bucket block (nwin x 2^15 x 80 B = 23 MB) falls out of LLC,
+            so the curve steps DOWN a window at the domain shape.
+    Multi-threaded keeps the same c=14 serial-suffix clamp as the plain
+    curve (the vector suffix is gated off there)."""
+    bl = (2 * n).bit_length()
+    if _lib() is not None and _lib().zkp2p_ifma_available():
+        if bl >= 20:
+            c = 15
+        elif bl >= 14:
+            c = 16
+        else:
+            c = max(4, bl - 5)
+        return min(c, 14) if threads > 1 else c
+    return max(4, min(17, bl - 5))
 
 
 def _n_threads() -> int:
@@ -251,13 +349,27 @@ def prove_native(
 
     threads = _n_threads()
 
+    glv = _use_glv()
+
     def msm_g1(bases, scalars: np.ndarray, tag: str):
         with trace(f"native/msm_{tag}"):
-            b = _g1_bases_u64(bases)
-            n = min(b.shape[0], scalars.shape[0])
-            sc = np.ascontiguousarray(scalars[:n])
             out = np.zeros(8, dtype=np.uint64)
-            lib.g1_msm_pippenger_mt(_p(b), _p(sc), n, _pick_window(n), threads, _p(out))
+            if glv:
+                b = _g1_bases_glv_u64(bases)
+                nb = b.shape[0] // 2  # phi half offset in the cached doubled set
+                n = min(nb, scalars.shape[0])
+                sc = np.ascontiguousarray(scalars[:n])
+                c = _pick_window_glv(n, threads=threads)
+                lib.g1_msm_pippenger_glv_mt(
+                    _p(b), _p(sc), n, nb, c, threads, _p(_glv_consts()), GLV_MAX_BITS, _p(out)
+                )
+            else:
+                b = _g1_bases_u64(bases)
+                n = min(b.shape[0], scalars.shape[0])
+                sc = np.ascontiguousarray(scalars[:n])
+                lib.g1_msm_pippenger_mt(
+                    _p(b), _p(sc), n, _pick_window(n, threads=threads), threads, _p(out)
+                )
         x, y = _u64x4_to_int_arr(out.reshape(2, 4))
         return None if x == 0 and y == 0 else (x, y)
 

@@ -60,6 +60,12 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "msm_unified": ("ZKP2P_MSM_UNIFIED", str, "auto"),
     "msm_affine": ("ZKP2P_MSM_AFFINE", str, "0"),
     "msm_h": ("ZKP2P_MSM_H", str, "windowed"),
+    # GLV endomorphism scalar decomposition for the G1 MSMs (JAX and
+    # native provers): every Fr scalar splits into two ~128-bit halves,
+    # halving digit planes / Pippenger windows at the cost of doubling
+    # the base axis.  Off by default (the existing path is the pinned
+    # fallback); armable so a hardware A/B session can switch it on.
+    "msm_glv": ("ZKP2P_MSM_GLV", _BOOL, False),
     # proof-batch sub-chunking: "auto" (4 per chunk on a real TPU — the
     # 16 GB HBM budget; whole batch elsewhere), "0" (never chunk), or an
     # explicit chunk size.  r5 bench1 on-chip: the batched h-evals stage
@@ -82,7 +88,7 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
 # whitelist, promoted here so there is a single list).
-ARMABLE = ("msm_affine", "msm_h")
+ARMABLE = ("msm_affine", "msm_h", "msm_glv")
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
 
@@ -93,6 +99,7 @@ class ProverConfig:
     msm_unified: str = "auto"
     msm_affine: str = "0"
     msm_h: str = "windowed"
+    msm_glv: bool = False
     batch_chunk: str = "auto"
     field_conv: str = "matmul"
     field_mul: str = "auto"
