@@ -12,8 +12,11 @@
 
 #include <algorithm>
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <array>
+#include <deque>
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -50,6 +53,176 @@ static inline long long prof_now_ns() {
 
 typedef unsigned __int128 u128;
 typedef uint64_t u64;
+
+// ---------------------------------------------------------------------------
+// Persistent worker pool.  Every parallel region in this library (the
+// Pippenger window sums, the 3-way h_ladder split) used to spawn-and-join
+// its own std::thread vector per CALL — ~5 MSMs + 1 ladder per prove, each
+// paying thread creation latency and a cold stack/TLB.  The pool spawns
+// workers once (lazily, or via zkp2p_pool_init) and keeps them parked on a
+// condition variable between regions.  Concurrency semantics are unchanged:
+// ZKP2P_NATIVE_THREADS still bounds how many indices run at once (the pool
+// grows to the largest n_threads any caller has asked for, never shrinks
+// below it), and n_threads <= 1 keeps the exact serial caller-thread path.
+//
+// The pool is MPMC-safe: multiple Python threads may each submit a region
+// (the prover's stage task-graph overlaps independent MSMs), and workers
+// drain region index spaces FIFO.  Each region carries a WIDTH cap — at
+// most `width` workers join its index space, so a caller's n_threads
+// request bounds ITS region even when the pool has grown wider for some
+// other caller.  pool_run() must not be called from a pool worker (no
+// region in this library nests).
+struct PoolJob {
+  std::function<void(long)> fn;
+  long n = 0;
+  int width = 1;           // max workers on this job (caller's n_threads)
+  int active = 0;          // workers currently on it (guarded by pool mu_)
+  std::atomic<long> next{0};
+  std::atomic<long> done{0};
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+class WorkPool {
+ public:
+  ~WorkPool() { shutdown(); }
+
+  // Grow to at least n workers (never shrinks: a one-off wide caller
+  // leaves capacity parked, which is the point of persistence).
+  void ensure(int n) {
+    std::lock_guard<std::mutex> life(lifecycle_mu_);
+    ensure_inner(n);
+  }
+
+  int size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int)workers_.size();
+  }
+
+  // Run fn(0..n-1) on at most `width` workers; blocks until every index
+  // completed.  The caller thread does NOT execute indices itself —
+  // n_threads keeps its historical meaning (worker count), and a
+  // blocked caller is what lets overlapped submissions share one
+  // bounded worker set.  lifecycle_mu_ brackets the ensure+enqueue pair
+  // so a concurrent shutdown() either drains this job with the old
+  // workers or sees it after respawn — never in between (a job enqueued
+  // onto a pool mid-join would wait forever).
+  void run(long n, std::function<void(long)> fn, int width) {
+    if (n <= 0) return;
+    auto job = std::make_shared<PoolJob>();
+    job->fn = std::move(fn);
+    job->n = n;
+    job->width = width > 0 ? width : 1;
+    {
+      std::lock_guard<std::mutex> life(lifecycle_mu_);
+      ensure_inner(1);  // a job on an empty pool would wait forever
+      std::lock_guard<std::mutex> lk(mu_);
+      jobs_.push_back(job);
+    }
+    cv_.notify_all();
+    std::unique_lock<std::mutex> lk(job->mu);
+    job->cv.wait(lk, [&] { return job->done.load() >= job->n; });
+  }
+
+  // Join all workers (draining queued jobs first).  The pool respawns
+  // lazily on the next run()/ensure(), so shutdown is safe mid-process
+  // (tests cycle it; services can drop the threads while idle).
+  // lifecycle_mu_ serializes against ensure()/run(), closing the race
+  // where a worker spawned during the join would exit immediately yet
+  // linger in workers_, leaving later jobs waiting on a dead pool.
+  void shutdown() {
+    std::lock_guard<std::mutex> life(lifecycle_mu_);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    std::vector<std::thread> ws;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ws.swap(workers_);
+    }
+    for (auto &t : ws) t.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = false;
+  }
+
+ private:
+  void ensure_inner(int n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    while ((int)workers_.size() < n) workers_.emplace_back([this] { worker_loop(); });
+  }
+
+  // Under mu_: drop jobs whose index space is fully handed out (their
+  // in-flight indices finish on the workers that claimed them; run()
+  // waits on the done counter, not queue presence) and return the first
+  // job with free indices AND head-room under its width cap.
+  std::shared_ptr<PoolJob> runnable_locked() {
+    for (auto it = jobs_.begin(); it != jobs_.end();) {
+      if ((*it)->next.load() >= (*it)->n) {
+        it = jobs_.erase(it);
+        continue;
+      }
+      if ((*it)->active < (*it)->width) return *it;
+      ++it;
+    }
+    return nullptr;
+  }
+
+  void worker_loop() {
+    for (;;) {
+      std::shared_ptr<PoolJob> job;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || runnable_locked() != nullptr; });
+        job = runnable_locked();
+        if (!job) return;  // stop_ set and nothing left to join
+        ++job->active;
+      }
+      long i;
+      while ((i = job->next.fetch_add(1)) < job->n) {
+        job->fn(i);
+        if (job->done.fetch_add(1) + 1 == job->n) {
+          std::lock_guard<std::mutex> jlk(job->mu);
+          job->cv.notify_all();
+        }
+      }
+      std::lock_guard<std::mutex> lk(mu_);
+      --job->active;  // width slot back (job is exhausted, not re-joined)
+    }
+  }
+
+  std::mutex mu_;
+  std::mutex lifecycle_mu_;  // serializes shutdown vs ensure/enqueue
+  std::condition_variable cv_;
+  std::vector<std::thread> workers_;
+  std::deque<std::shared_ptr<PoolJob>> jobs_;
+  bool stop_ = false;
+};
+
+static WorkPool &work_pool() {
+  static WorkPool pool;  // joined by the static destructor at exit
+  return pool;
+}
+
+// The env-resolved default worker count (ZKP2P_NATIVE_THREADS, else the
+// core count) — the same rule fr_h_ladder applied per call before.
+static int pool_default_threads() {
+  const char *tenv = getenv("ZKP2P_NATIVE_THREADS");
+  int nt = tenv ? atoi(tenv) : (int)std::thread::hardware_concurrency();
+  return nt > 0 ? nt : 1;
+}
+
+extern "C" {
+// Explicit lifecycle (optional — every parallel entry point lazily
+// ensures capacity): init pre-spawns n workers (n <= 0 resolves
+// ZKP2P_NATIVE_THREADS / core count), shutdown joins them all.
+void zkp2p_pool_init(int n_threads) {
+  work_pool().ensure(n_threads > 0 ? n_threads : pool_default_threads());
+}
+void zkp2p_pool_shutdown(void) { work_pool().shutdown(); }
+int zkp2p_pool_size(void) { return work_pool().size(); }
+}  // extern "C"
 
 // BN254 base field p and scalar field r moduli (little-endian limbs).
 static const u64 P[4] = {0x3c208c16d87cfd47ULL, 0x97816a916871ca8dULL,
@@ -2865,14 +3038,14 @@ void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
     for (long j = 0; j < m; ++j) fr_mul(v + 4 * j, v + 4 * j, gpow + 4 * j);
     fr_ntt_ifma(v, m, w_std, ONE_STD);  // forward: coefficients -> coset evals
   };
-  // The three polynomial ladders are independent: thread them when the
-  // host has cores to spare (same env-driven knob as the MSM pool).
-  const char *tenv = getenv("ZKP2P_NATIVE_THREADS");
-  int nt = tenv ? atoi(tenv) : (int)std::thread::hardware_concurrency();
+  // The three polynomial ladders are independent: run them on the
+  // persistent pool when the host has cores to spare (same env-driven
+  // knob as the MSM pool; spawn-per-call threads retired with it).
+  int nt = pool_default_threads();
   if (nt > 1) {
-    std::vector<std::thread> pool;
-    for (int k = 0; k < 3; ++k) pool.emplace_back(ladder_one, vecs[k]);
-    for (auto &th : pool) th.join();
+    int w = nt < 3 ? nt : 3;
+    work_pool().ensure(w);
+    work_pool().run(3, [&](long k) { ladder_one(vecs[k]); }, w);
   } else {
     for (int k = 0; k < 3; ++k) ladder_one(vecs[k]);
   }
@@ -3580,20 +3753,15 @@ static void g1_tree_sum(u64 (*xs)[4], u64 (*ys)[4], long n, G1Jac *out) {
   }
 }
 
-// threads pulling from an atomic queue when n_threads > 1.  Shared by
-// the G1 and G2 MSMs (one driver to tune, not two copies).
+// the persistent worker pool when n_threads > 1.  Shared by the G1 and
+// G2 MSMs (one driver to tune, not two copies).  The pool is grown to
+// n_threads once and reused across calls — no thread spawn per MSM.
 template <typename P, typename F>
 static void run_window_sums(int nwin, int n_threads, P *wins, F sum_one) {
   if (n_threads > 1) {
-    std::vector<std::thread> pool;
-    std::atomic<int> next(0);
-    for (int t = 0; t < n_threads && t < nwin; ++t) {
-      pool.emplace_back([&]() {
-        int wi;
-        while ((wi = next.fetch_add(1)) < nwin) sum_one(wi, &wins[wi]);
-      });
-    }
-    for (auto &th : pool) th.join();
+    int w = n_threads < nwin ? n_threads : nwin;
+    work_pool().ensure(w);
+    work_pool().run(nwin, [&](long wi) { sum_one((int)wi, &wins[wi]); }, w);
   } else {
     for (int wi = 0; wi < nwin; ++wi) sum_one(wi, &wins[wi]);
   }
