@@ -129,6 +129,38 @@ def _build_venmo(index: int = 0):
     return cs, lay, make_input
 
 
+def _host_attribution(cfg) -> dict:
+    """Host facts that explain run-to-run spread in the BENCH records
+    (r5's 3.28–3.68 s spread across identical reps was unattributable):
+    the RESOLVED worker count (ZKP2P_NATIVE_THREADS else core count, the
+    same rule the C pool and prover apply), the CPU model string, and
+    the MSM knob states."""
+    cpu_model = "unknown"
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    cpu_model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    ifma = 0
+    try:
+        from zkp2p_tpu.native.lib import get_lib
+
+        lib = get_lib()
+        if lib is not None:
+            ifma = int(lib.zkp2p_ifma_available())
+    except Exception:  # noqa: BLE001 — attribution must not break the bench
+        pass
+    return {
+        "native_threads": cfg.native_threads or (os.cpu_count() or 1),
+        "cpu_model": cpu_model,
+        "cpu_count": os.cpu_count() or 1,
+        "ifma": ifma,
+    }
+
+
 def _fullsize_record() -> dict:
     """{fullsize_prove_s, fullsize_constraints} from the committed
     full-size artifact (docs/fullsize_proof/timing.json, regenerated by
@@ -181,9 +213,23 @@ def _native_fallback_bench(plat: str) -> bool:
         # write the RESOLVED value back: prove_native reads the plain
         # env-backed config, so an armed decision only reaches it here
         os.environ["ZKP2P_MSM_GLV"] = "1" if glv_on else "0"
+        # batch-affine buckets / stage overlap default ON globally
+        # (utils/config.py); an armed or env decision resolved above
+        # rides the same write-back (prove_native reads the plain
+        # env-backed config, so an armed value only reaches it here)
+        ba_on = cfg.msm_batch_affine
+        os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "1" if ba_on else "0"
+        ov_on = cfg.msm_overlap
+        os.environ["ZKP2P_MSM_OVERLAP"] = "1" if ov_on else "0"
+        host = _host_attribution(cfg)
         # label the MSM mode before the per-stage trace so the native
-        # msm_a/b1/c/h stage times are attributable to a GLV arm
-        log(f"native msm mode: glv={'on' if glv_on else 'off'}")
+        # msm_a/b1/c/h stage times are attributable to the knob arms
+        log(
+            f"native msm mode: glv={'on' if glv_on else 'off'} "
+            f"batch_affine={'on' if ba_on else 'off'} "
+            f"overlap={'on' if ov_on else 'off'} "
+            f"threads={host['native_threads']} ifma={host['ifma']} cpu={host['cpu_model']}"
+        )
         inputs = make_input(0)
         with trace("witness_gen"):
             w = cs.witness(inputs.public_signals, inputs.seed)
@@ -236,6 +282,11 @@ def _native_fallback_bench(plat: str) -> bool:
                 "p50_s": round(p50, 3),
                 "batch": 1,
                 "msm_glv": bool(glv_on),
+                "msm_batch_affine": bool(ba_on),
+                "msm_overlap": bool(ov_on),
+                # host attribution: resolved thread count + CPU identity,
+                # so spread across identical reps has a suspect
+                **host,
                 # the flagship-scale datapoint (VERDICT r4 weak #3: the
                 # bench shape is 499k constraints; constraint
                 # normalization assumes linear scaling, so the real

@@ -51,6 +51,18 @@ static inline long long prof_now_ns() {
       .count();
 }
 
+// Batch-affine Pippenger bucket accumulation (ZKP2P_MSM_BATCH_AFFINE,
+// default ON; off only on a leading '0', the ZKP2P_NATIVE_IFMA rule).
+// Gates the affine-bucket fill tiers of the G1/G2 MSMs — off routes
+// every window through the plain mixed-Jacobian fill, which is the
+// honest A/B arm for what the shared-inversion affine adds buy.
+// Deliberately NOT cached: re-read once per MSM (and per G2 window), so
+// a single process can diff both arms (tests monkeypatch the env).
+static bool batch_affine_enabled() {
+  const char *e = getenv("ZKP2P_MSM_BATCH_AFFINE");
+  return !(e && e[0] == '0');
+}
+
 typedef unsigned __int128 u128;
 typedef uint64_t u64;
 
@@ -2936,6 +2948,10 @@ void fr_ntt(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
 // instructions, and ZKP2P_NATIVE_IFMA != 0.
 int zkp2p_ifma_available(void) { return ifma_enabled() ? 1 : 0; }
 
+// 1 when the batch-affine bucket tiers are active (ZKP2P_MSM_BATCH_AFFINE
+// unset / not leading-'0').  Fresh-read, so tools can echo the live arm.
+int zkp2p_batch_affine_enabled(void) { return batch_affine_enabled() ? 1 : 0; }
+
 // Differential-test hook for the 8-wide kernel: c[i] = a[i]*b[i] mod r,
 // standard form in/out, driven through pack -> mont260 vector multiply
 // -> unpack (the exact pipeline the NTT stages use).  Falls back to the
@@ -3649,7 +3665,7 @@ static void g2_window_sum_affine(const u64 *bases, const int32_t *sd, long n,
 static void g2_window_sum(const u64 *bases, const int32_t *sd, long n,
                           int c, int nwin, int wi, G2Jac *out) {
 #if ZKP2P_HAVE_IFMA
-  if (ifma_enabled()) {
+  if (ifma_enabled() && batch_affine_enabled()) {
     g2_window_sum_affine(bases, sd, n, c, nwin, wi, out);
     return;
   }
@@ -3842,11 +3858,16 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
                               int nwin, int n_threads, G1Jac *acc_out,
                               int total_bits = 254) {
   G1Jac &acc = *acc_out;
+  // ZKP2P_MSM_BATCH_AFFINE=0: every window through the mixed-Jacobian
+  // fill — the A/B arm measuring what affine buckets + the shared batch
+  // inversion buy (both the IFMA 52-limb tier and the scalar tier are
+  // batch-affine, so the gate sits above them, read once per MSM).
+  const bool batch_affine = batch_affine_enabled();
   {
     G1Jac *wins = new G1Jac[nwin];
 #if ZKP2P_HAVE_IFMA
     Aff52 *b52 = nullptr;
-    if (ifma_enabled()) {
+    if (ifma_enabled() && batch_affine) {
       // one mont256 -> mont260 conversion per MSM; every window's fill
       // then runs conversion-free (persistent 52-limb storage)
       b52 = new Aff52[nr];
@@ -3884,7 +3905,11 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
         return;
       }
 #endif
-      g1_window_sum(pb, sd, nr, c, nwin, wi, o, total_bits);
+      if (batch_affine) {
+        g1_window_sum(pb, sd, nr, c, nwin, wi, o, total_bits);
+      } else {
+        g1_window_sum_jac(pb, sd, nr, c, nwin, wi, o);
+      }
     });
 #if ZKP2P_HAVE_IFMA
     if (allbk) {

@@ -20,6 +20,11 @@ def test_defaults():
     assert cfg.msm_signed is True
     assert cfg.msm_h == "windowed"
     assert cfg.native_ifma is True
+    # the native batch-affine bucket tier is the committed-on arm; its
+    # parser follows the C runtime's leading-'0' rule like native_ifma
+    assert cfg.msm_batch_affine is True
+    assert load_config(environ={"ZKP2P_MSM_BATCH_AFFINE": "true"}).msm_batch_affine is True
+    assert load_config(environ={"ZKP2P_MSM_BATCH_AFFINE": "0"}).msm_batch_affine is False
     assert all(v == "default" for v in cfg.provenance.values())
 
 
@@ -31,6 +36,8 @@ def test_env_overrides_every_knob():
         "ZKP2P_MSM_AFFINE": "1",
         "ZKP2P_MSM_H": "bucket",
         "ZKP2P_MSM_GLV": "1",
+        "ZKP2P_MSM_OVERLAP": "0",
+        "ZKP2P_MSM_BATCH_AFFINE": "0",
         "ZKP2P_BATCH_CHUNK": "8",
         "ZKP2P_FIELD_CONV": "limb_major",
         "ZKP2P_FIELD_MUL": "pallas",
@@ -44,6 +51,8 @@ def test_env_overrides_every_knob():
     assert cfg.msm_window == 8 and cfg.msm_signed is False
     assert cfg.msm_unified == "1" and cfg.msm_affine == "1" and cfg.msm_h == "bucket"
     assert cfg.msm_glv is True
+    assert cfg.msm_overlap is False
+    assert cfg.msm_batch_affine is False
     assert cfg.batch_chunk == "8"
     assert cfg.field_conv == "limb_major" and cfg.field_mul == "pallas" and cfg.curve_kernel == "xla"
     assert cfg.native_ifma is False and cfg.native_threads == 7 and cfg.no_cache is True

@@ -15,6 +15,16 @@ time, so each arm runs in its own process).  The defaults are "auto"
 
 Prints per-stage rates: batched add_mixed (the MSM inner op), and a full
 G1 msm_windowed at the requested size.
+
+`--native` benches the C++ Pippenger tier (csrc zkp2p_native) instead of
+the JAX path — the arm the tunnel-down bench actually runs.  The
+batch-affine bucket knob is A/B-able there:
+
+  python tools/msm_hwbench.py --native --n 524288 --glv --batch-affine
+  python tools/msm_hwbench.py --native --n 524288 --glv --no-batch-affine
+
+Each arm runs in its own process anyway (import-time constants on the
+JAX side; one clean env per arm on the native side).
 """
 
 import argparse
@@ -26,15 +36,108 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
+def _native_bench(args):
+    """The C++ Pippenger arm: random full-width scalars over a tiled
+    base set, min-of-reps wall time (this box is ±30% noisy), result
+    x-coordinate echoed so A/B arms can be cross-checked for parity."""
+    import ctypes
+    import random
+
+    import numpy as np
+
+    from zkp2p_tpu.field.bn254 import GLV_MAX_BITS, R
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
+    from zkp2p_tpu.native.lib import _pack_affine, _scalars_to_u64
+    from zkp2p_tpu.prover.native_prove import (
+        _glv_consts,
+        _lib,
+        _p,
+        _pick_window,
+        _pick_window_glv,
+    )
+    from zkp2p_tpu.utils.config import load_config
+
+    lib = _lib()
+    assert lib is not None, "native library unavailable"
+    load_config()  # resolve + validate env the same way the prover does
+    from zkp2p_tpu.prover.native_prove import _n_threads
+
+    # the PROVER's thread resolution (env else core count), so the bench
+    # measures the arm the tunnel-down bench actually runs; pin
+    # ZKP2P_NATIVE_THREADS=1 for single-worker microbenches
+    threads = _n_threads()
+    if args.window is not None and args.window <= 0:
+        args.window = None  # 0 = auto, same as omitting the flag
+    ba = bool(lib.zkp2p_batch_affine_enabled())
+    print(
+        f"native arm: n={args.n} ifma={'on' if lib.zkp2p_ifma_available() else 'off'} "
+        f"threads={threads} glv={'on' if args.glv else 'off'} "
+        f"batch_affine={'on' if ba else 'off'}",
+        flush=True,
+    )
+    rng = np.random.default_rng(7)
+    host_pts = [g1_mul(G1_GENERATOR, int(k)) for k in rng.integers(1, 1 << 30, 64)]
+    n = args.n
+    bases = _pack_affine(host_pts)
+    bm64 = np.zeros_like(bases)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.fp_to_mont.argtypes = [u64p, u64p, ctypes.c_int]
+    lib.fp_to_mont(_p(bases), _p(bm64), 2 * 64)
+    bm = np.ascontiguousarray(np.tile(bm64, ((n + 63) // 64, 1))[:n])
+    py_rng = random.Random(11)
+    sc = np.ascontiguousarray(_scalars_to_u64([py_rng.randrange(R) for _ in range(n)]))
+    out = np.zeros(8, dtype=np.uint64)
+    reps = args.reps
+    if args.glv:
+        c = args.window if args.window is not None else _pick_window_glv(n, threads=threads)
+        phi = np.zeros_like(bm)
+        lib.g1_glv_phi_bases(_p(bm), n, _p(_glv_consts()), _p(phi))
+        b2 = np.ascontiguousarray(np.concatenate([bm, phi]))
+
+        def run():
+            lib.g1_msm_pippenger_glv_mt(
+                _p(b2), _p(sc), n, n, c, threads, _p(_glv_consts()), GLV_MAX_BITS, _p(out)
+            )
+    else:
+        c = args.window if args.window is not None else _pick_window(n, threads=threads)
+
+        def run():
+            lib.g1_msm_pippenger_mt(_p(bm), _p(sc), n, c, threads, _p(out))
+
+    times = []
+    for _ in range(reps):
+        t0 = time.time()
+        run()
+        times.append(time.time() - t0)
+    best = min(times)
+    x = int.from_bytes(out[:4].tobytes(), "little")
+    print(
+        f"native msm: n={n} c={c} reps={reps} min={best*1e3:.0f} ms "
+        f"(all: {' '.join(f'{t*1e3:.0f}' for t in times)}) -> {n/best/1e6:.3f} M pts/s "
+        f"result_x={x % (1 << 64):#x}",
+        flush=True,
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1 << 17)
-    ap.add_argument("--window", type=int, default=4)
+    ap.add_argument(
+        "--window", type=int, default=None,
+        help="digit/window width; default: 4 on the JAX path, the prover's "
+        "_pick_window choice on --native (an explicit value always wins)",
+    )
     ap.add_argument("--lanes", type=int, default=0, help="0 = default_lanes(n)")
     ap.add_argument("--adds", type=int, default=1 << 20, help="batch size for the raw add bench")
     ap.add_argument("--skip-msm", action="store_true")
     ap.add_argument("--skip-adds", action="store_true")
     ap.add_argument("--signed", action="store_true", help="signed digit recoding (half-size table)")
+    ap.add_argument(
+        "--native", action="store_true",
+        help="bench the native C++ Pippenger tier (csrc) instead of the JAX path; "
+        "omit --window (or pass 0) for the prover's _pick_window choice",
+    )
+    ap.add_argument("--reps", type=int, default=5, help="native arm: min-of-reps (noisy box)")
     glv_grp = ap.add_mutually_exclusive_group()
     glv_grp.add_argument(
         "--glv", action="store_true",
@@ -45,9 +148,31 @@ def main():
         "--no-glv", action="store_true",
         help="explicit non-GLV arm (the default; named so A/B run logs are self-labelling)",
     )
+    ba_grp = ap.add_mutually_exclusive_group()
+    ba_grp.add_argument(
+        "--batch-affine", action="store_true",
+        help="native tier: batch-affine Pippenger buckets (one shared Montgomery "
+        "inversion per chunk of bucket adds) — the default arm",
+    )
+    ba_grp.add_argument(
+        "--no-batch-affine", action="store_true",
+        help="native tier: plain mixed-Jacobian bucket fill (the A/B baseline)",
+    )
     args = ap.parse_args()
     if args.glv:
         args.signed = True
+    # The knob rides the env so the C runtime (and any child) sees it;
+    # set BEFORE the native lib is loaded/called.
+    if args.batch_affine:
+        os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "1"
+    elif args.no_batch_affine:
+        os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "0"
+
+    if args.native:
+        _native_bench(args)
+        return
+    if args.window is None:
+        args.window = 4
 
     import jax
     import jax.numpy as jnp
@@ -63,9 +188,12 @@ def main():
     from zkp2p_tpu.field.jfield import field_mul_impl
 
     curve_impl = "pallas" if G1J._pallas() else "xla"
+    from zkp2p_tpu.utils.config import load_config
+
     print(
         f"device={dev} curve={curve_impl} fieldmul={field_mul_impl()} "
-        f"glv={'on' if args.glv else 'off'}",
+        f"glv={'on' if args.glv else 'off'} "
+        f"batch_affine={'on' if load_config().msm_batch_affine else 'off'} (native tier knob)",
         flush=True,
     )
 

@@ -40,7 +40,11 @@ def main():
     from zkp2p_tpu.utils.config import load_config
 
     cfg = load_config()
-    print(f"native msm mode: glv={'on' if cfg.msm_glv else 'off'}", flush=True)
+    print(
+        f"native msm mode: glv={'on' if cfg.msm_glv else 'off'} "
+        f"batch_affine={'on' if cfg.msm_batch_affine else 'off'}",
+        flush=True,
+    )
     nthreads = cfg.native_threads
     if nthreads and nthreads > 1:
         print(

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import ctypes
 import secrets
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -102,20 +103,27 @@ def _limbs16_to_u64(a: np.ndarray) -> np.ndarray:
 # against one DeviceProvingKey converts each MSM's bases ONCE (at full
 # size the five conversions cost seconds per proof otherwise).  Each
 # entry pins the source arrays, so an id() key cannot be reused while
-# its entry is alive; a small cap bounds test-suite churn.
+# its entry is alive; a small cap bounds test-suite churn.  Guarded by a
+# lock: the stage task-graph converts the a/b1/b2/c bases from worker
+# threads concurrently, and a racing evict+insert must not corrupt the
+# dict (worst case under the lock is a duplicate convert, never a wrong
+# entry).
 _bases_cache: dict = {}
 _BASES_CACHE_CAP = 16
+_bases_lock = threading.Lock()
 
 
 def _bases_memo(bases, convert, tag: str = ""):
     key = (id(bases[0]), id(bases[1]), tag)
-    hit = _bases_cache.get(key)
-    if hit is not None and hit[0] is bases[0] and hit[1] is bases[1]:
-        return hit[2]
+    with _bases_lock:
+        hit = _bases_cache.get(key)
+        if hit is not None and hit[0] is bases[0] and hit[1] is bases[1]:
+            return hit[2]
     out = convert(bases)
-    if len(_bases_cache) >= _BASES_CACHE_CAP:
-        _bases_cache.pop(next(iter(_bases_cache)))
-    _bases_cache[key] = (bases[0], bases[1], out)
+    with _bases_lock:
+        if len(_bases_cache) >= _BASES_CACHE_CAP:
+            _bases_cache.pop(next(iter(_bases_cache)))
+        _bases_cache[key] = (bases[0], bases[1], out)
     return out
 
 
@@ -182,6 +190,12 @@ def _use_glv() -> bool:
     return load_config().msm_glv
 
 
+def _use_batch_affine() -> bool:
+    from ..utils.config import load_config
+
+    return load_config().msm_batch_affine
+
+
 def _g2_bases_u64(bases) -> np.ndarray:
     """AffPoint ((n,2,16),(n,2,16)) -> (n, 16) u64 (x.c0 x.c1 y.c0 y.c1)."""
 
@@ -212,7 +226,12 @@ def _pick_window(n: int, g2: bool = False, threads: int = 1) -> int:
     purely from doubled batch-affine conflicts; the raised clamp lets
     the big domains reach c=17 while the bench shape keeps its
     measured-best c=15 (signed sweep at 2^19: c=15 6.3s, c=16 7.6s)."""
-    if not g2 and _lib() is not None and _lib().zkp2p_ifma_available():
+    if (
+        not g2
+        and _use_batch_affine()  # jac-fill arm: wide-window curve n/a
+        and _lib() is not None
+        and _lib().zkp2p_ifma_available()
+    ):
         # IFMA regime (G1 only) with the 8-lane vector suffix (csrc
         # g1_suffix8): the serial per-window reduction that clamped the
         # r5 sweep at c=14 is vectorized across windows, so wider
@@ -225,7 +244,10 @@ def _pick_window(n: int, g2: bool = False, threads: int = 1) -> int:
         # runs its own serial suffix concurrently) — so multi-threaded
         # runs keep the r5 serial-suffix optimum of c=14 instead of
         # paying a 4x longer per-window serial tail at c=15/16
-        # (ADVICE r5 #1).
+        # (ADVICE r5 #1).  The whole IFMA curve also rides the
+        # batch-affine tier: with ZKP2P_MSM_BATCH_AFFINE=0 (the
+        # Jacobian A/B arm) both the 52-limb fill and the vector suffix
+        # are gated off, so the generic curve below applies instead.
         bl = n.bit_length()
         if bl >= 20:
             c = 16
@@ -249,7 +271,7 @@ def _pick_window_glv(n: int, threads: int = 1) -> int:
     Multi-threaded keeps the same c=14 serial-suffix clamp as the plain
     curve (the vector suffix is gated off there)."""
     bl = (2 * n).bit_length()
-    if _lib() is not None and _lib().zkp2p_ifma_available():
+    if _use_batch_affine() and _lib() is not None and _lib().zkp2p_ifma_available():
         if bl >= 20:
             c = 15
         elif bl >= 14:
@@ -335,15 +357,6 @@ def prove_native(
                 matvec(*j)
         lib.fr_mul_batch(_p(a_ev), _p(b_ev), _p(c_ev), m)
 
-    # H ladder: d_j = (A.B - C)(g . w^j), Montgomery -> standard scalars.
-    d = np.zeros((m, 4), dtype=np.uint64)
-    with trace("native/h_ladder"):
-        w_root = _scalars_to_u64([fr_domain_root(dpk.log_m)]).copy()
-        g_cos = _scalars_to_u64([coset_gen(dpk.log_m)]).copy()
-        lib.fr_h_ladder(_p(a_ev), _p(b_ev), _p(c_ev), m, _p(w_root), _p(g_cos), _p(d))
-        d_std = np.zeros_like(d)
-        lib.fr_from_mont_batch(_p(d), _p(d_std), m)
-
     b_sel = np.asarray(dpk.b_sel)
     c_sel = np.asarray(dpk.c_sel)
 
@@ -385,9 +398,60 @@ def prove_native(
             return None
         return (Fq2(xc0, xc1), Fq2(yc0, yc1))
 
-    a_acc = msm_g1(dpk.a_bases, w_std, "a")
-    b1_acc = msm_g1(dpk.b1_bases, np.ascontiguousarray(w_std[b_sel]), "b1")
-    b2_acc = msm_g2(dpk.b2_bases, np.ascontiguousarray(w_std[b_sel]), "b2")
-    c_acc = msm_g1(dpk.c_bases, np.ascontiguousarray(w_std[c_sel]), "c")
-    h_acc = msm_g1(dpk.h_bases, d_std, "h")
+    def h_ladder_and_d():
+        # H ladder: d_j = (A.B - C)(g . w^j), Montgomery -> std scalars.
+        d = np.zeros((m, 4), dtype=np.uint64)
+        with trace("native/h_ladder"):
+            w_root = _scalars_to_u64([fr_domain_root(dpk.log_m)]).copy()
+            g_cos = _scalars_to_u64([coset_gen(dpk.log_m)]).copy()
+            lib.fr_h_ladder(_p(a_ev), _p(b_ev), _p(c_ev), m, _p(w_root), _p(g_cos), _p(d))
+            d_std = np.zeros_like(d)
+            lib.fr_from_mont_batch(_p(d), _p(d_std), m)
+        return d_std
+
+    # Stage task-graph (ZKP2P_MSM_OVERLAP, default on): the a/b1/b2/c
+    # MSMs depend only on the witness scalars, while msm_h sits behind
+    # the H ladder — so the four independent MSMs run on worker threads
+    # (ctypes releases the GIL; the C pool's per-region width caps bound
+    # total MSM-window concurrency) and OVERLAP the ladder and msm_h on
+    # this thread instead of queuing behind them.  Gated on threads > 1:
+    # a ZKP2P_NATIVE_THREADS=1 pin means "at most one busy core", and
+    # Python-level concurrency would quietly break that promise.
+    # Results are gathered in the fixed assembly order, so proof bytes
+    # are identical to the sequential schedule (pinned by
+    # tests/test_msm_native_edge.py parity).
+    from ..utils.config import load_config
+
+    if load_config().msm_overlap and threads > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..utils.trace import adopt_stack, current_stack
+
+        # worker-thread trace records keep this thread's stage prefix
+        # (e.g. bench.py's prove_native_N span) — without it the four
+        # submitted MSMs log under a bare root and per-rep stage
+        # attribution in the bench trace is lost
+        stack = current_stack()
+
+        def seeded(fn, *fargs):
+            adopt_stack(stack)
+            return fn(*fargs)
+
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            fut_a = ex.submit(seeded, msm_g1, dpk.a_bases, w_std, "a")
+            fut_b1 = ex.submit(seeded, msm_g1, dpk.b1_bases, np.ascontiguousarray(w_std[b_sel]), "b1")
+            fut_b2 = ex.submit(seeded, msm_g2, dpk.b2_bases, np.ascontiguousarray(w_std[b_sel]), "b2")
+            fut_c = ex.submit(seeded, msm_g1, dpk.c_bases, np.ascontiguousarray(w_std[c_sel]), "c")
+            d_std = h_ladder_and_d()
+            h_acc = msm_g1(dpk.h_bases, d_std, "h")
+            a_acc, b1_acc, b2_acc, c_acc = (
+                fut_a.result(), fut_b1.result(), fut_b2.result(), fut_c.result()
+            )
+    else:
+        d_std = h_ladder_and_d()
+        a_acc = msm_g1(dpk.a_bases, w_std, "a")
+        b1_acc = msm_g1(dpk.b1_bases, np.ascontiguousarray(w_std[b_sel]), "b1")
+        b2_acc = msm_g2(dpk.b2_bases, np.ascontiguousarray(w_std[b_sel]), "b2")
+        c_acc = msm_g1(dpk.c_bases, np.ascontiguousarray(w_std[c_sel]), "c")
+        h_acc = msm_g1(dpk.h_bases, d_std, "h")
     return _assemble(dpk, (a_acc, b1_acc, b2_acc, c_acc, h_acc), r, s)

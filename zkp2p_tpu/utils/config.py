@@ -66,6 +66,24 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     # the base axis.  Off by default (the existing path is the pinned
     # fallback); armable so a hardware A/B session can switch it on.
     "msm_glv": ("ZKP2P_MSM_GLV", _BOOL, False),
+    # Stage task-graph in prove_native: the a/b1/b2/c MSMs run on worker
+    # threads overlapping the H ladder + msm_h ("1"), or strictly
+    # sequentially ("0").  Only engages when the resolved thread count
+    # is > 1 (a ZKP2P_NATIVE_THREADS=1 pin means one busy core, which
+    # Python-side concurrency must not break).  Overlap wins when cores
+    # outnumber the per-region pool width or per-MSM serial glue
+    # dominates; where the C tier already saturates every core per
+    # stage it is neutral — hence a knob, so the arm is attributable
+    # and host-tunable.
+    "msm_overlap": ("ZKP2P_MSM_OVERLAP", _BOOL, True),
+    # Batch-affine Pippenger bucket accumulation in the NATIVE (C++) MSM
+    # tiers: buckets live as affine points, every chunk of bucket adds
+    # shares ONE Montgomery batch inversion (~7 muls/add vs ~12 for the
+    # mixed-Jacobian add).  Default ON (the measured-fastest arm and the
+    # long-standing behavior); off routes every window through the plain
+    # Jacobian fill — the honest A/B arm.  The C runtime re-reads the env
+    # per MSM (csrc batch_affine_enabled), so flips apply immediately.
+    "msm_batch_affine": ("ZKP2P_MSM_BATCH_AFFINE", _not_zero, True),
     # proof-batch sub-chunking: "auto" (4 per chunk on a real TPU — the
     # 16 GB HBM budget; whole batch elsewhere), "0" (never chunk), or an
     # explicit chunk size.  r5 bench1 on-chip: the batched h-evals stage
@@ -88,7 +106,7 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
 # whitelist, promoted here so there is a single list).
-ARMABLE = ("msm_affine", "msm_h", "msm_glv")
+ARMABLE = ("msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap")
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
 
@@ -100,6 +118,8 @@ class ProverConfig:
     msm_affine: str = "0"
     msm_h: str = "windowed"
     msm_glv: bool = False
+    msm_overlap: bool = True
+    msm_batch_affine: bool = True
     batch_chunk: str = "auto"
     field_conv: str = "matmul"
     field_mul: str = "auto"

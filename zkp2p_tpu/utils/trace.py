@@ -44,6 +44,18 @@ def trace(stage: str, **attrs):
         stack.pop()
 
 
+def current_stack() -> List[str]:
+    """Snapshot of this thread's stage-nesting stack — hand it to worker
+    threads (with adopt_stack) so their records keep the submitting
+    stage's path prefix instead of starting a fresh root."""
+    return list(getattr(_tls, "stack", None) or [])
+
+
+def adopt_stack(stack: List[str]) -> None:
+    """Seed THIS thread's nesting stack (see current_stack)."""
+    _tls.stack = list(stack)
+
+
 def records() -> List[Dict[str, Any]]:
     return list(_records)
 
