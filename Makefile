@@ -6,10 +6,18 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native test test-slow driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
+
+# Sanitizer smoke: build the ASan+UBSan library and run the MSM parity
+# check against it (tests/test_native_asan.py LD_PRELOADs libasan into a
+# python subprocess — the interpreter itself is uninstrumented).  Green
+# means the batch-affine fill / batch-inversion buffers ran clean.
+native-asan:
+	$(MAKE) -C csrc libzkp2p_native_asan.so
+	env -u PALLAS_AXON_POOL_IPS ZKP2P_RUN_SLOW=1 python -m pytest tests/test_native_asan.py -q
 
 # env -u PALLAS_AXON_POOL_IPS: the axon sitecustomize dials the TPU relay
 # at interpreter start when the var is set, and that dial BLOCKS while any

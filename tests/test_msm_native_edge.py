@@ -1,0 +1,241 @@
+"""Native MSM edge-case suite, diffed against the host oracle.
+
+The batch-affine bucket fill (csrc g1_window_sum / g1_window_sum_52) has
+three degenerate branches a random-scalar test essentially never drives:
+a bucket receiving ITS OWN point again inside one batch round (the P+P
+doubling lane), a bucket receiving its negation (P+(-P) cancellation to
+the empty bucket), and the install/defer machinery around them.  Chunk
+scheduling makes these reachable deterministically: the fill processes
+points in index order in chunks of B=2048, and the per-chunk conflict
+stamp only defers SAME-chunk collisions — so a duplicate (point, scalar)
+pair placed >= B indices after its first occurrence meets the installed
+bucket in a later chunk of the same pass and takes the batch-round
+doubling (or cancellation) lane, no deferral involved.
+
+Scalars are kept small (~20 bits) so the pure-python oracle stays cheap:
+g1_mul cost scales with scalar bit length, while the fill still sees
+full window-0/1 activity at c=15 (2^15 buckets >= the 4*B batch-affine
+floor).  Every case runs both ZKP2P_MSM_BATCH_AFFINE arms (the C gate
+re-reads the env per MSM) and the GLV driver on top.
+"""
+
+import ctypes
+import os
+import random
+
+import numpy as np
+import pytest
+
+from zkp2p_tpu.curve.host import G1_GENERATOR, g1_msm, g1_mul
+from zkp2p_tpu.field.bn254 import GLV_MAX_BITS, P, R
+from zkp2p_tpu.native import lib as native
+from zkp2p_tpu.native.lib import _pack_affine, _scalars_to_u64
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None, reason="native toolchain unavailable")
+
+rng = random.Random(31)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+
+B = 2048  # the csrc batch-affine chunk size the cross-chunk cases straddle
+
+
+def _p(a: np.ndarray):
+    return a.ctypes.data_as(_u64p)
+
+
+def _lib():
+    from zkp2p_tpu.prover.native_prove import _lib as pl
+
+    return pl()
+
+
+def _mont_bases(pts) -> np.ndarray:
+    lib = _lib()
+    bases = _pack_affine(pts)
+    bm = np.zeros_like(bases)
+    lib.fp_to_mont.argtypes = [_u64p, _u64p, ctypes.c_int]
+    lib.fp_to_mont(_p(bases), _p(bm), 2 * len(pts))
+    return bm
+
+
+def _msm(bm: np.ndarray, scalars, c: int, threads: int = 1):
+    lib = _lib()
+    n = len(scalars)
+    sc = np.ascontiguousarray(_scalars_to_u64(scalars))
+    out = np.zeros(8, dtype=np.uint64)
+    lib.g1_msm_pippenger_mt(_p(bm), _p(sc), n, c, threads, _p(out))
+    x = int.from_bytes(out[:4].tobytes(), "little")
+    y = int.from_bytes(out[4:].tobytes(), "little")
+    return None if x == 0 and y == 0 else (x, y)
+
+
+def _msm_glv(b2: np.ndarray, nb: int, scalars, c: int, threads: int = 1):
+    from zkp2p_tpu.prover.native_prove import _glv_consts
+
+    lib = _lib()
+    n = len(scalars)
+    sc = np.ascontiguousarray(_scalars_to_u64(scalars))
+    out = np.zeros(8, dtype=np.uint64)
+    lib.g1_msm_pippenger_glv_mt(
+        _p(b2), _p(sc), n, nb, c, threads, _p(_glv_consts()), GLV_MAX_BITS, _p(out)
+    )
+    x = int.from_bytes(out[:4].tobytes(), "little")
+    y = int.from_bytes(out[4:].tobytes(), "little")
+    return None if x == 0 and y == 0 else (x, y)
+
+
+def _glv_doubled(bm: np.ndarray) -> np.ndarray:
+    from zkp2p_tpu.prover.native_prove import _glv_consts
+
+    lib = _lib()
+    n = bm.shape[0]
+    phi = np.zeros_like(bm)
+    lib.g1_glv_phi_bases(_p(bm), n, _p(_glv_consts()), _p(phi))
+    return np.ascontiguousarray(np.concatenate([bm, phi]))
+
+
+@pytest.fixture
+def both_arms(monkeypatch):
+    """Run the wrapped check under each ZKP2P_MSM_BATCH_AFFINE arm (the
+    csrc gate is fresh-read per MSM, so one process can diff both)."""
+
+    def runner(check):
+        for arm in ("1", "0"):
+            monkeypatch.setenv("ZKP2P_MSM_BATCH_AFFINE", arm)
+            check(arm)
+
+    yield runner
+
+
+def test_msm_n_zero_and_n_one(both_arms):
+    bm0 = np.zeros((0, 8), dtype=np.uint64)
+    pt = g1_mul(G1_GENERATOR, 0xDEADBEEF)
+    bm1 = _mont_bases([pt])
+
+    def check(arm):
+        assert _msm(bm0, [], 8) is None, arm
+        for k in (0, 1, 2, R - 1, rng.randrange(R)):
+            assert _msm(bm1, [k], 8) == g1_mul(pt, k), (arm, k)
+        # n=1 with an infinity base
+        assert _msm(_mont_bases([None]), [12345], 8) is None, arm
+
+    both_arms(check)
+
+
+def test_msm_all_zero_scalars_and_holes(both_arms):
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(20)]
+    pts[4] = None
+    pts[17] = None
+    bm = _mont_bases(pts)
+
+    def check(arm):
+        assert _msm(bm, [0] * 20, 8) is None, arm
+        # holes only contribute nothing even with live scalars elsewhere
+        scalars = [rng.randrange(R) for _ in range(20)]
+        assert _msm(bm, scalars, 8) == g1_msm(pts, scalars), arm
+
+    both_arms(check)
+
+
+def _cross_chunk_vector():
+    """Points/scalars whose index layout forces same-bucket P+P doubling
+    AND P+(-P) cancellation inside a batch round: chunk 1 (indices < B)
+    installs, chunk 2 (indices >= B) re-meets the installed buckets."""
+    base_pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(96)]
+    pts, scalars = [], []
+    # chunk 1: distinct ~20-bit scalars -> mostly distinct window-0 buckets
+    seen = set()
+    for i in range(B):
+        while True:
+            s = rng.randrange(1 << 14, 1 << 20)
+            if s not in seen:
+                seen.add(s)
+                break
+        pts.append(base_pts[i % len(base_pts)])
+        scalars.append(s)
+    # chunk 2, doubling lanes: same (point, scalar) as entries 0..31 --
+    # the bucket already holds exactly this point, so the batch round
+    # classifies dbl=1 (lambda = 3x^2/2y through the shared inversion)
+    for i in range(32):
+        pts.append(pts[i])
+        scalars.append(scalars[i])
+    # chunk 2, cancellation lanes: negated point, same scalar, for
+    # entries 32..63 -- x matches, y differs -> bucket memset to empty
+    for i in range(32, 64):
+        x, y = pts[i]
+        pts.append((x, P - y))
+        scalars.append(scalars[i])
+    # chunk 2, triple for entries 64..79: dup NOW (doubling), and a
+    # second dup below so the 2P bucket then takes the CHORD lane
+    for i in range(64, 80):
+        pts.append(pts[i])
+        scalars.append(scalars[i])
+    for i in range(64, 80):
+        pts.append(pts[i])
+        scalars.append(scalars[i])
+    return pts, scalars
+
+
+def test_same_bucket_double_and_cancel_in_batch_round(both_arms):
+    pts, scalars = _cross_chunk_vector()
+    bm = _mont_bases(pts)
+    want = g1_msm(pts, scalars)
+    assert want is not None
+
+    def check(arm):
+        # c=15 clears the batch-affine floor (2^15 buckets >= 4*B); c=8
+        # routes through the small/jac tiers as a cross-check
+        for c, threads in ((15, 1), (15, 2), (8, 1)):
+            assert _msm(bm, scalars, c, threads) == want, (arm, c, threads)
+
+    both_arms(check)
+
+
+def test_glv_composes_with_batch_affine(both_arms):
+    pts, scalars = _cross_chunk_vector()
+    # GLV decomposes even small scalars into full lattice terms, so mix
+    # in some full-width ones plus the tree-sum classification edges
+    for i in range(0, 48):
+        scalars[i] = rng.randrange(R)
+    scalars[48] = 0
+    scalars[49] = 1
+    scalars[50] = R - 1
+    pts[51] = None
+    bm = _mont_bases(pts)
+    b2 = _glv_doubled(bm)
+    want = g1_msm(pts, scalars)
+
+    def check(arm):
+        for c, threads in ((15, 1), (14, 2)):
+            assert _msm_glv(b2, len(pts), scalars, c, threads) == want, (arm, c, threads)
+
+    both_arms(check)
+
+
+def test_prove_native_batch_affine_parity(monkeypatch):
+    """Proof bytes are identical with the batch-affine tier on and off
+    for the same (witness, r, s) — the determinism contract the knob's
+    bench A/B rides on (mirror of the GLV parity pin)."""
+    from zkp2p_tpu.prover import device_pk
+    from zkp2p_tpu.prover.native_prove import prove_native
+    from zkp2p_tpu.snark.groth16 import setup, verify
+    from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+    cs = ConstraintSystem("ba-toy")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+    cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
+    cs.compute(z, lambda a, bb: a * bb % R, [x, y])
+    w = cs.witness([225], {x: 3, y: 5})
+    pk, vk = setup(cs)
+    dpk = device_pk(pk, cs)
+    r, s = rng.randrange(1, R), rng.randrange(1, R)
+    monkeypatch.delenv("ZKP2P_MSM_BATCH_AFFINE", raising=False)
+    on = prove_native(dpk, w, r=r, s=s)
+    monkeypatch.setenv("ZKP2P_MSM_BATCH_AFFINE", "0")
+    off = prove_native(dpk, w, r=r, s=s)
+    assert on == off
+    assert verify(vk, off, [225])

@@ -1,0 +1,150 @@
+"""ASan/UBSan smoke of the native MSM tiers (`make native-asan`).
+
+Builds the sanitizer-instrumented library (csrc libzkp2p_native_asan.so)
+and runs a small-but-representative G1 MSM parity check against the host
+oracle INSIDE it: enough points and window width to drive the
+batch-affine bucket fill (its shared-inversion scratch buffers are the
+new-code risk this guards), the Jacobian A/B arm, the GLV driver, and
+the persistent worker pool — all under `-fno-sanitize-recover`, so any
+ASan/UBSan report aborts the subprocess and fails the test.
+
+The python interpreter is NOT instrumented, so the library must be
+loaded with libasan LD_PRELOADed — hence the subprocess (slow tier; run
+via `make native-asan` or ZKP2P_RUN_SLOW=1).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASAN_SO = os.path.join(REPO, "csrc", "libzkp2p_native_asan.so")
+
+# The check script runs in a fresh interpreter with libasan preloaded.
+# It computes the oracle with the pure-python host curve and diffs the
+# instrumented library's MSM output bit-for-bit, covering: the
+# batch-affine fill (c=14 => the affine tier engages even at small n),
+# the jac arm (ZKP2P_MSM_BATCH_AFFINE=0), GLV, threads via the pool, and
+# the edge scalars 0 / 1 / r-1.
+_CHECK = r"""
+import ctypes, os, random, sys
+sys.path.insert(0, os.environ["ZKP2P_REPO"])
+import numpy as np
+from zkp2p_tpu.curve.host import G1_GENERATOR, g1_msm, g1_mul
+from zkp2p_tpu.field.bn254 import GLV_MAX_BITS, R
+from zkp2p_tpu.native.lib import _pack_affine, _scalars_to_u64
+
+lib = ctypes.CDLL(os.environ["ZKP2P_ASAN_SO"])
+u64p = ctypes.POINTER(ctypes.c_uint64)
+lib.fp_to_mont.argtypes = [u64p, u64p, ctypes.c_int]
+lib.g1_msm_pippenger_mt.argtypes = [u64p, u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, u64p]
+lib.g1_glv_phi_bases.argtypes = [u64p, ctypes.c_long, u64p, u64p]
+lib.g1_msm_pippenger_glv_mt.argtypes = [
+    u64p, u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+    u64p, ctypes.c_int, u64p,
+]
+
+rng = random.Random(5)
+n = 300
+pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(n)]
+pts[7] = None  # infinity hole
+scalars = [rng.randrange(R) for _ in range(n)]
+scalars[0] = 0
+scalars[1] = 1
+scalars[2] = R - 1
+# duplicate point+scalar pairs: same-bucket P+P / P+(-P) shapes
+pts[10] = pts[11]
+scalars[10] = scalars[11]
+pts[12] = pts[13]
+scalars[13] = R - scalars[12]
+
+want = g1_msm(pts, scalars)
+bases = _pack_affine(pts)
+bm = np.zeros_like(bases)
+lib.fp_to_mont(bases.ctypes.data_as(u64p), bm.ctypes.data_as(u64p), 2 * n)
+sc = np.ascontiguousarray(_scalars_to_u64(scalars))
+
+def check(tag, got):
+    x = int.from_bytes(got[:4].tobytes(), "little")
+    y = int.from_bytes(got[4:].tobytes(), "little")
+    g = None if x == 0 and y == 0 else (x, y)
+    assert g == want, tag
+    print("ok", tag, flush=True)
+
+for ba in ("1", "0"):
+    os.environ["ZKP2P_MSM_BATCH_AFFINE"] = ba  # fresh-read per MSM in csrc
+    for c, threads in ((8, 1), (14, 1), (14, 2)):
+        out = np.zeros(8, dtype=np.uint64)
+        lib.g1_msm_pippenger_mt(
+            bm.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, c, threads,
+            out.ctypes.data_as(u64p))
+        check(f"plain ba={ba} c={c} t={threads}", out)
+
+# GLV x batch-affine composed.  The consts are packed inline from the
+# pure-python field.bn254 constants (same layout as native_prove's
+# _glv_consts) — importing the prover module would pull in jaxlib, whose
+# pybind exception machinery trips ASan's interceptors under LD_PRELOAD.
+from zkp2p_tpu.field.bn254 import GLV_BETA, GLV_K1_TERMS, GLV_K2_TERMS, GLV_MU1, GLV_MU2, P, to_mont
+mask = (1 << 64) - 1
+u64x4 = lambda v: [(v >> (64 * i)) & mask for i in range(4)]
+flags, mags = 0, []
+for j, (mag, sub) in enumerate(GLV_K1_TERMS):
+    mags += u64x4(mag); flags |= int(sub) << j
+for j, (mag, sub) in enumerate(GLV_K2_TERMS):
+    mags += u64x4(mag); flags |= int(sub) << (2 + j)
+gc = np.ascontiguousarray(np.array(
+    u64x4(to_mont(GLV_BETA, P)) + u64x4(GLV_MU1) + u64x4(GLV_MU2) + mags + [flags],
+    dtype=np.uint64))
+phi = np.zeros_like(bm)
+lib.g1_glv_phi_bases(bm.ctypes.data_as(u64p), n, gc.ctypes.data_as(u64p),
+                     phi.ctypes.data_as(u64p))
+b2 = np.ascontiguousarray(np.concatenate([bm, phi]))
+for ba in ("1", "0"):
+    os.environ["ZKP2P_MSM_BATCH_AFFINE"] = ba
+    out = np.zeros(8, dtype=np.uint64)
+    lib.g1_msm_pippenger_glv_mt(
+        b2.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, n, 14, 2,
+        gc.ctypes.data_as(u64p), GLV_MAX_BITS, out.ctypes.data_as(u64p))
+    check(f"glv ba={ba}", out)
+
+lib.zkp2p_pool_shutdown()
+print("ASAN-PARITY-GREEN", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_asan_msm_parity_smoke():
+    if not os.path.exists(ASAN_SO):
+        r = subprocess.run(
+            ["make", "-C", os.path.join(REPO, "csrc"), "libzkp2p_native_asan.so"],
+            capture_output=True, text=True,
+        )
+        if r.returncode != 0:
+            pytest.skip(f"asan build unavailable: {r.stderr[-300:]}")
+    # locate the asan runtime the instrumented .so links against
+    asan_rt = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"], capture_output=True, text=True
+    ).stdout.strip()
+    if not asan_rt or not os.path.exists(asan_rt):
+        pytest.skip("libasan runtime not found")
+    env = dict(
+        os.environ,
+        ZKP2P_REPO=REPO,
+        ZKP2P_ASAN_SO=ASAN_SO,
+        LD_PRELOAD=asan_rt,
+        # CPython leaks by design at interpreter teardown; leak reports
+        # would drown real findings.  Everything else stays fatal
+        # (-fno-sanitize-recover + abort_on_error).
+        ASAN_OPTIONS="detect_leaks=0:abort_on_error=1",
+        UBSAN_OPTIONS="halt_on_error=1:abort_on_error=1",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the tunnel from tests
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", _CHECK], env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0, f"sanitizer run failed:\n{r.stdout[-2000:]}\n{r.stderr[-4000:]}"
+    assert "ASAN-PARITY-GREEN" in r.stdout, r.stdout[-2000:]
