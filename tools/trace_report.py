@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Aggregate zkp2p observability JSONL sinks into per-stage tables.
+
+Input: one or more JSONL files produced by utils.trace.dump_trace, the
+ProvingService sink, or bench.py with ZKP2P_METRICS_SINK set.  Lines:
+
+  {"type": "manifest", "run_id": ..., "host": {...}, "knobs": {...}}
+  {"stage": "native/msm_a", "ms": 812.3, "run_id": ..., "pid": ...}
+  {"type": "request", "request_id": ..., "state": "done", "ms": ...}
+
+Modes:
+  default      per-stage n / p50 / p95 / max / total table (+ request
+               state summary when request records are present)
+  --tree       stage-path tree (indented by "/" nesting) with the same
+               percentiles per node
+  --runs       list the run_ids found (with knob arms) and exit
+  --run RID    restrict aggregation to one run_id
+  --diff A B   A/B: two files OR (with one file) two run_ids — per-stage
+               p50 delta table, replacing eyeballed min-of-5 comparisons
+
+Exact percentiles from the raw records (the registry's histograms are
+bucket-resolution; this reads the records themselves).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def load_records(paths: List[str]) -> Tuple[List[dict], List[dict], List[dict]]:
+    """(stage_records, request_records, manifests) from JSONL files,
+    rotation backups included if named explicitly.  Unparseable lines
+    are counted, not fatal (a torn tail from a crashed worker must not
+    hide the rest of the file)."""
+    stages: List[dict] = []
+    requests: List[dict] = []
+    manifests: List[dict] = []
+    bad = 0
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                t = rec.get("type")
+                if t == "manifest":
+                    manifests.append(rec)
+                elif t == "request":
+                    requests.append(rec)
+                elif "stage" in rec and "ms" in rec:
+                    stages.append(rec)
+    if bad:
+        print(f"[trace_report] skipped {bad} unparseable line(s)", file=sys.stderr)
+    return stages, requests, manifests
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def aggregate(stages: List[dict], run: Optional[str] = None) -> Dict[str, dict]:
+    """stage path -> {n, p50, p95, max, total_ms}."""
+    by_stage: Dict[str, List[float]] = {}
+    for rec in stages:
+        if run and rec.get("run_id") != run:
+            continue
+        by_stage.setdefault(rec["stage"], []).append(float(rec["ms"]))
+    out: Dict[str, dict] = {}
+    for stage, vals in by_stage.items():
+        vals.sort()
+        out[stage] = {
+            "n": len(vals),
+            "p50": _pct(vals, 0.50),
+            "p95": _pct(vals, 0.95),
+            "max": vals[-1],
+            "total_ms": sum(vals),
+        }
+    return out
+
+
+def _fmt_ms(v: float) -> str:
+    if v >= 10000:
+        return f"{v / 1000:.1f}s"
+    return f"{v:.1f}"
+
+
+def render_table(agg: Dict[str, dict]) -> str:
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["total_ms"])
+    w = max([len("stage")] + [len(s) for s, _ in rows]) if rows else 5
+    lines = [f"{'stage':<{w}}  {'n':>6}  {'p50':>9}  {'p95':>9}  {'max':>9}  {'total':>9}"]
+    lines.append("-" * len(lines[0]))
+    for stage, a in rows:
+        lines.append(
+            f"{stage:<{w}}  {a['n']:>6}  {_fmt_ms(a['p50']):>9}  {_fmt_ms(a['p95']):>9}  "
+            f"{_fmt_ms(a['max']):>9}  {_fmt_ms(a['total_ms']):>9}"
+        )
+    return "\n".join(lines)
+
+
+def render_tree(agg: Dict[str, dict]) -> str:
+    """Stage-path tree: each node indented by its '/' depth, children
+    under their parent, siblings ordered by total time."""
+    children: Dict[str, List[str]] = {"": []}
+    for stage in agg:
+        parts = stage.split("/")
+        for d in range(len(parts)):
+            node = "/".join(parts[: d + 1])
+            parent = "/".join(parts[:d])
+            children.setdefault(parent, [])
+            children.setdefault(node, [])
+            if node not in children[parent]:
+                children[parent].append(node)
+
+    lines: List[str] = []
+    w = max([len("stage") + 2] + [len(s) + 2 * s.count("/") for s in agg]) if agg else 5
+    lines.append(f"{'stage':<{w}}  {'n':>6}  {'p50':>9}  {'p95':>9}  {'total':>9}")
+    lines.append("-" * len(lines[0]))
+
+    def total(node: str) -> float:
+        a = agg.get(node)
+        if a:
+            return a["total_ms"]
+        return sum(total(c) for c in children.get(node, []))
+
+    def walk(node: str, depth: int) -> None:
+        if node:
+            a = agg.get(node)
+            label = "  " * (depth - 1) + node.split("/")[-1]
+            if a:
+                lines.append(
+                    f"{label:<{w}}  {a['n']:>6}  {_fmt_ms(a['p50']):>9}  "
+                    f"{_fmt_ms(a['p95']):>9}  {_fmt_ms(a['total_ms']):>9}"
+                )
+            else:
+                lines.append(f"{label:<{w}}  {'-':>6}  {'-':>9}  {'-':>9}  {_fmt_ms(total(node)):>9}")
+        for c in sorted(children.get(node, []), key=lambda n: -total(n)):
+            walk(c, depth + 1)
+
+    walk("", 0)
+    return "\n".join(lines)
+
+
+def render_requests(requests: List[dict], run: Optional[str] = None) -> str:
+    by_state: Dict[str, List[float]] = {}
+    for rec in requests:
+        if run and rec.get("run_id") != run:
+            continue
+        by_state.setdefault(rec.get("state", "?"), []).append(float(rec.get("ms") or 0.0))
+    if not by_state:
+        return ""
+    lines = ["request states:"]
+    for state, vals in sorted(by_state.items()):
+        vals.sort()
+        lines.append(
+            f"  {state:<24} n={len(vals):<6} p50={_fmt_ms(_pct(vals, 0.5))} "
+            f"p95={_fmt_ms(_pct(vals, 0.95))} max={_fmt_ms(vals[-1] if vals else 0)}"
+        )
+    return "\n".join(lines)
+
+
+def render_diff(agg_a: Dict[str, dict], agg_b: Dict[str, dict], label_a: str, label_b: str) -> str:
+    """Per-stage p50 A-vs-B — the knob-arm comparison the bench notes
+    used to eyeball from two min-of-5 logs."""
+    stages = sorted(
+        set(agg_a) | set(agg_b),
+        key=lambda s: -(agg_a.get(s, {}).get("total_ms", 0) + agg_b.get(s, {}).get("total_ms", 0)),
+    )
+    w = max([len("stage")] + [len(s) for s in stages]) if stages else 5
+    head = (
+        f"{'stage':<{w}}  {'n(A)':>5} {'n(B)':>5}  {'p50 A':>9}  {'p50 B':>9}  {'delta':>8}"
+    )
+    lines = [f"A = {label_a}", f"B = {label_b}", head, "-" * len(head)]
+    for s in stages:
+        a, b = agg_a.get(s), agg_b.get(s)
+        pa = a["p50"] if a else None
+        pb = b["p50"] if b else None
+        if pa is not None and pb is not None and pa > 0:
+            delta = f"{(pb - pa) / pa * 100:+.1f}%"
+        else:
+            delta = "-"
+        lines.append(
+            f"{s:<{w}}  {a['n'] if a else 0:>5} {b['n'] if b else 0:>5}  "
+            f"{_fmt_ms(pa) if pa is not None else '-':>9}  "
+            f"{_fmt_ms(pb) if pb is not None else '-':>9}  {delta:>8}"
+        )
+    return "\n".join(lines)
+
+
+def _runs_summary(stages: List[dict], manifests: List[dict]) -> str:
+    counts: Dict[str, int] = {}
+    for rec in stages:
+        rid = rec.get("run_id", "?")
+        counts[rid] = counts.get(rid, 0) + 1
+    knobs_by_run = {m.get("run_id"): m.get("knobs", {}) for m in manifests}
+    lines = []
+    for rid, n in sorted(counts.items()):
+        k = knobs_by_run.get(rid, {})
+        arms = " ".join(
+            f"{name}={k[name]}" for name in ("msm_glv", "msm_batch_affine", "msm_overlap") if name in k
+        )
+        lines.append(f"{rid}: {n} records  {arms}")
+    return "\n".join(lines) or "(no run_ids found)"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("files", nargs="+", help="JSONL sink file(s)")
+    ap.add_argument("--tree", action="store_true", help="stage-path tree view")
+    ap.add_argument("--runs", action="store_true", help="list run_ids and exit")
+    ap.add_argument("--run", help="restrict to one run_id")
+    ap.add_argument(
+        "--diff", nargs=2, metavar=("A", "B"),
+        help="two run_ids (single input) or ignored-with-two-files A/B p50 diff",
+    )
+    args = ap.parse_args(argv)
+
+    if args.diff and len(args.files) == 2:
+        # file-vs-file diff: --diff labels the columns
+        sa, _, _ = load_records([args.files[0]])
+        sb, _, _ = load_records([args.files[1]])
+        print(render_diff(aggregate(sa), aggregate(sb), args.diff[0], args.diff[1]))
+        return 0
+
+    stages, requests, manifests = load_records(args.files)
+    if args.runs:
+        print(_runs_summary(stages, manifests))
+        return 0
+    if args.diff:
+        agg_a = aggregate(stages, run=args.diff[0])
+        agg_b = aggregate(stages, run=args.diff[1])
+        if not agg_a or not agg_b:
+            print(f"no records for run_id {args.diff[0] if not agg_a else args.diff[1]}", file=sys.stderr)
+            return 1
+        print(render_diff(agg_a, agg_b, args.diff[0], args.diff[1]))
+        return 0
+    agg = aggregate(stages, run=args.run)
+    print(render_tree(agg) if args.tree else render_table(agg))
+    req_view = render_requests(requests, run=args.run)
+    if req_view:
+        print()
+        print(req_view)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

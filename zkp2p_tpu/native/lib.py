@@ -78,6 +78,64 @@ def get_lib() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+# Slot order of the C runtime's always-on stats block (csrc StatSlot) —
+# the ctypes ABI: index i here reads g_stats[i] there.  Append-only on
+# both sides; zkp2p_stats_count() guards against drift at runtime.
+STATS_FIELDS = (
+    "msm_g1_calls",
+    "msm_g2_calls",
+    "msm_glv_calls",
+    "msm_batch_affine_calls",
+    "msm_points",
+    "msm_wall_ns",
+    "msm_fill_ns",
+    "msm_apply_ns",
+    "msm_suffix_ns",
+    "msm_bailfill_ns",
+    "msm_window_last",
+    "msm_dbl_lanes",
+    "msm_cancel_lanes",
+    "msm_defer_hits",
+    "pool_jobs",
+    "pool_tasks",
+    "pool_wait_ns",
+    "pool_run_ns",
+    "pool_depth_peak",
+    "pool_workers",
+)
+
+
+def stats_snapshot() -> Optional[dict]:
+    """Read the native runtime's lock-free counter block as a dict
+    (field -> int); None if the native lib is unavailable.  Purely
+    observational — counters keep accumulating."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "zkp2p_stats_count"):
+        # a stale pre-stats .so (toolchain gone, rebuild failed) still
+        # passes get_lib's self-checks — observation must degrade to
+        # None, never AttributeError a finished prove
+        return None
+    n = int(lib.zkp2p_stats_count())
+    buf = np.zeros(max(n, len(STATS_FIELDS)), dtype=np.int64)
+    lib.zkp2p_stats_snapshot.argtypes = [ctypes.POINTER(ctypes.c_longlong)]
+    lib.zkp2p_stats_snapshot(buf.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)))
+    # a lib ahead of this bridge exposes extra slots we cannot name; a
+    # lib behind it reads 0 for the missing names (buf is zero-filled
+    # past n) — either way every STATS_FIELDS key is present, so
+    # consumers never KeyError on version skew
+    return {name: int(buf[i]) for i, name in enumerate(STATS_FIELDS)}
+
+
+def stats_reset() -> bool:
+    """Zero the native counter block; False if the lib is unavailable
+    (or predates the stats block — see stats_snapshot)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "zkp2p_stats_reset"):
+        return False
+    lib.zkp2p_stats_reset()
+    return True
+
+
 def g1_fixed_base_batch(base: Tuple[int, int], scalars: Sequence[int]) -> Optional[List]:
     """Batch k_i * base over G1; None if the native lib is unavailable.
     Returns affine (x, y) int tuples, None entries for infinity."""

@@ -26,7 +26,8 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ..formats.proof_json import dump
-from ..utils.trace import trace
+from ..utils.metrics import REGISTRY, JsonlSink, maybe_start_metrics_server, publish_native_stats, run_id, run_manifest
+from ..utils.trace import drain as drain_trace, set_context, trace
 
 
 @dataclass
@@ -35,6 +36,11 @@ class Request:
     payload: Dict
     witness: Optional[list] = None
     error: Optional[str] = None
+    # observability: request_id (the spool base name — unique per
+    # request, stable across worker takeovers) + claim timestamp, so the
+    # terminal record carries true claim->terminal latency
+    rid: str = ""
+    t_claim: float = 0.0
 
 
 class ProvingService:
@@ -78,6 +84,60 @@ class ProvingService:
         self.prover_fn = prover_fn
         self.prefetch = max(1, prefetch)
         self.stale_claim_s = stale_claim_s
+        # per-spool rotating JSONL sinks (lazy; see _sink).  Locked:
+        # the witness producer thread and the proving thread both emit
+        # records, and two racing JsonlSink instances for one path
+        # would rotate against each other.
+        self._sinks: Dict[str, JsonlSink] = {}
+        self._sinks_lock = threading.Lock()
+        # knob manifest + sink override for request records, resolved
+        # once per process (env-derived; cannot change under a running
+        # service — and _emit_record must not re-parse the config per
+        # record).  None = not yet resolved.
+        self._knobs: Optional[Dict] = None
+        self._sink_override: Optional[str] = None
+
+    # -------------------------------------------------------- observability
+    #
+    # Every request's terminal transition is RECORDED, not just counted:
+    # one JSONL line per request (request_id, state, claim->terminal ms,
+    # run_id/pid, the full knob manifest) in a rotating sink next to the
+    # spool, aggregatable offline by tools/trace_report.py.  The env-level
+    # ZKP2P_METRICS_SINK override redirects all spools to one path.
+
+    def _sink(self, spool: str) -> JsonlSink:
+        # keyed by the RESOLVED path, not the spool: a ZKP2P_METRICS_SINK
+        # override funnels every spool into one file, which must mean one
+        # JsonlSink instance (two would race each other's rotation)
+        with self._sinks_lock:
+            if self._sink_override is None:
+                from ..utils.config import load_config
+
+                self._sink_override = load_config().metrics_sink  # "" = per-spool
+            path = self._sink_override or (spool.rstrip("/") + ".metrics.jsonl")
+            s = self._sinks.get(path)
+            if s is None:
+                s = self._sinks[path] = JsonlSink(path)
+            return s
+
+    def _emit_record(self, spool: str, req: Request, state: str, knobs: Dict) -> None:
+        try:
+            rec = {
+                "type": "request",
+                "ts": round(time.time(), 3),
+                "run_id": run_id(),
+                "pid": os.getpid(),
+                "request_id": req.rid,
+                "state": state,
+                "ms": round((time.time() - req.t_claim) * 1e3, 3) if req.t_claim else None,
+                "knobs": knobs,
+            }
+            if req.error:
+                rec["error"] = req.error[:500]
+            self._sink(spool).write(rec)
+        except Exception:  # noqa: BLE001 — observation must never fail a prove
+            pass
+        REGISTRY.counter("zkp2p_service_requests_total", {"state": state}).inc()
 
     # ------------------------------------------------------------- claims
     #
@@ -137,6 +197,14 @@ class ProvingService:
         from ..snark.groth16 import verify
 
         stats = {"done": 0, "error-bad-input": 0, "error-failed-to-prove": 0}
+        # knob manifest stamped on every request record (the acceptance
+        # contract: a record is attributable without joining against a
+        # separate manifest line) — resolved once per process, not per
+        # sweep: an idle 1 s poll loop must not re-read /proc/cpuinfo
+        # and re-parse the config every tick
+        if self._knobs is None:
+            self._knobs = run_manifest()["knobs"]
+        knobs = self._knobs
         pending: List[Request] = []
         for fn in sorted(os.listdir(spool)):
             if not fn.endswith(".req.json"):
@@ -148,7 +216,7 @@ class ProvingService:
                 self._release_claim(os.path.join(spool, base))
                 continue
             with open(os.path.join(spool, fn)) as f:
-                pending.append(Request(path=os.path.join(spool, base), payload=json.load(f)))
+                pending.append(Request(path=os.path.join(spool, base), payload=json.load(f), rid=base))
 
         # Pipeline overlap (SURVEY.md §2.7 "witness ∥ prove"): witness
         # generation is host CPU, proving is device compute — a producer
@@ -162,6 +230,7 @@ class ProvingService:
         producer_error: List[BaseException] = []
 
         def scalar_witness(req: Request) -> bool:
+            set_context(request_id=req.rid)
             try:
                 with trace("service/witness"):
                     req.witness = self.witness_fn(req.payload)
@@ -170,8 +239,11 @@ class ProvingService:
             except Exception as e:  # noqa: BLE001 — recorded, not silenced
                 req.error = f"error-bad-input: {e}"
                 self._emit_error(req, "error-bad-input", e)
+                self._emit_record(spool, req, "error-bad-input", knobs)
                 stats["error-bad-input"] += 1
                 return False
+            finally:
+                set_context(request_id=None)
 
         def batched_witness(cand: List[Request]) -> List[Request]:
             """Vectorized tier: per-request input derivation (errors stay
@@ -182,13 +254,17 @@ class ProvingService:
             inputs = []
             for req in cand:
                 try:
+                    set_context(request_id=req.rid)
                     with trace("service/inputs"):
                         inputs.append(self.inputs_fn(req.payload))
                     batch.append(req)
                 except Exception as e:  # noqa: BLE001
                     req.error = f"error-bad-input: {e}"
                     self._emit_error(req, "error-bad-input", e)
+                    self._emit_record(spool, req, "error-bad-input", knobs)
                     stats["error-bad-input"] += 1
+                finally:
+                    set_context(request_id=None)
             if not batch:
                 return []
             try:
@@ -213,6 +289,8 @@ class ProvingService:
                     # earlier batches prove (peer takeover would then
                     # duplicate in-progress work).
                     cand = [r for r in pending[i : i + self.batch_size] if self._try_claim(r.path)]
+                    for r in cand:
+                        r.t_claim = time.time()
                     if self.inputs_fn is not None:
                         batch = batched_witness(cand)
                     else:
@@ -233,6 +311,7 @@ class ProvingService:
             batch = ready_q.get()
             if batch is None:
                 break
+            completed: set = set()  # rids terminal as done in THIS batch
             try:
                 # heartbeat: refresh the batch's claims periodically WHILE
                 # the prove runs, so claim age stays bounded by the refresh
@@ -254,7 +333,7 @@ class ProvingService:
                 hb = threading.Thread(target=_heartbeat, daemon=True)
                 hb.start()
                 try:
-                    with trace("service/prove", n=len(batch)):
+                    with trace("service/prove", n=len(batch), request_ids=[r.rid for r in batch]):
                         prove = self.prover_fn or prove_tpu_batch
                         proofs = prove(self.dpk, [r.witness for r in batch])
                 finally:
@@ -265,13 +344,29 @@ class ProvingService:
                 if not verify(self.vk, proofs[0], sample_pub):
                     raise RuntimeError("sample proof failed verification")
                 for req, proof in zip(batch, proofs):
-                    dump(proof_to_json(proof), req.path + ".proof.json")
-                    dump(public_to_json(self.public_fn(req.witness)), req.path + ".public.json")
+                    set_context(request_id=req.rid)
+                    try:
+                        with trace("service/emit"):
+                            dump(proof_to_json(proof), req.path + ".proof.json")
+                            dump(public_to_json(self.public_fn(req.witness)), req.path + ".public.json")
+                    finally:
+                        set_context(request_id=None)
                     self._release_claim(req.path)
+                    self._emit_record(spool, req, "done", knobs)
+                    completed.add(req.rid)
                     stats["done"] += 1
             except Exception as e:  # noqa: BLE001
+                # Only requests NOT already terminal: a dump() failing
+                # mid-batch must not stamp an error artifact/record (and
+                # a second counter bump) onto requests whose proofs were
+                # already emitted as done — one terminal state per
+                # request is what the per-request attribution rides on.
                 for req in batch:
+                    if req.rid in completed:
+                        continue
+                    req.error = f"error-failed-to-prove: {e}"
                     self._emit_error(req, "error-failed-to-prove", e)
+                    self._emit_record(spool, req, "error-failed-to-prove", knobs)
                     stats["error-failed-to-prove"] += 1
         producer.join()
         if producer_error:
@@ -330,10 +425,30 @@ class ProvingService:
         return cls(cs, dpk, vk, witness_fn, public_fn, **kw)
 
     def run(self, spool: str, poll_s: float = 1.0, max_sweeps: Optional[int] = None) -> None:
+        # Prometheus exposition (ZKP2P_METRICS_PORT, default off) — the
+        # scrape sees stage histograms, request-state counters, and a
+        # scrape-time native counter refresh.
+        maybe_start_metrics_server()
         sweeps = 0
         while max_sweeps is None or sweeps < max_sweeps:
             stats = self.process_dir(spool)
             if any(stats.values()):
                 print(f"[service] {stats}", flush=True)
+                # Per-sweep observability flush: buffered stage spans go
+                # to the rotating sink (stamped with run_id/pid so
+                # concurrent workers stay separable) and the native C
+                # counter block is re-published for the next scrape.
+                # The trace ring is DRAINED, which with the bounded
+                # buffer closes the unbounded-growth leak the run() loop
+                # had.
+                rid, pid = run_id(), os.getpid()
+                spans = [
+                    {"type": "stage", "run_id": rid, "pid": pid, **r} for r in drain_trace()
+                ]
+                try:
+                    self._sink(spool).write_many(spans)
+                except Exception:  # noqa: BLE001 — observation only
+                    pass
+                publish_native_stats()
             sweeps += 1
             time.sleep(poll_s)

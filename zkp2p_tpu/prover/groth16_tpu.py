@@ -791,15 +791,21 @@ def prove_tpu(
     r: Optional[int] = None,
     s: Optional[int] = None,
 ) -> Proof:
+    from ..utils.metrics import REGISTRY
+    from ..utils.trace import trace
+
     if r is None:
         r = 1 + secrets.randbelow(R - 1)
     if s is None:
         s = 1 + secrets.randbelow(R - 1)
-    _check_inferred_widths(dpk, witness, w_std=witness if _is_u64_witness(witness) else None)
-    acc = _prove_device(dpk, witness_to_device(witness))
-    a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (acc[0], acc[1], acc[3], acc[4]))
-    b2 = g2_jac_to_host(acc[2])[0]
-    return _assemble(dpk, (a, b1, b2, c, hq), r, s)
+    with trace("tpu/prove"):
+        _check_inferred_widths(dpk, witness, w_std=witness if _is_u64_witness(witness) else None)
+        acc = _prove_device(dpk, witness_to_device(witness))
+        a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (acc[0], acc[1], acc[3], acc[4]))
+        b2 = g2_jac_to_host(acc[2])[0]
+        proof = _assemble(dpk, (a, b1, b2, c, hq), r, s)
+    REGISTRY.counter("zkp2p_proves_total", {"prover": "tpu"}).inc()
+    return proof
 
 
 def h_evals_sharded(dpk: DeviceProvingKey, w_mont: jnp.ndarray, mesh, axis: str = "shard") -> jnp.ndarray:
@@ -946,28 +952,34 @@ def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -
     the last chunk pads by repeating its final witness) so device memory
     is bounded by the chunk, not the batch, and every chunk reuses the
     same compiled executable."""
-    for wit in witnesses:
-        _check_inferred_widths(dpk, wit, w_std=wit if _is_u64_witness(wit) else None)
-    n = len(witnesses)
-    chunk = _batch_chunk_size()
-    if chunk <= 0 or n <= chunk:
-        spans = [list(witnesses)]
-    else:
-        spans = [list(witnesses[i : i + chunk]) for i in range(0, n, chunk)]
-        spans[-1] += [spans[-1][-1]] * (chunk - len(spans[-1]))
-    parts = []
-    for span in spans:
-        # one batched to_mont per chunk (not one device dispatch per witness)
-        w = FR.to_mont(jnp.asarray(np.stack([_witness_std_limbs(wit) for wit in span])))
-        parts.append(_prove_device(dpk, w, batched=True))
-    accs = (
-        parts[0]
-        if len(parts) == 1
-        else jax.tree_util.tree_map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
-    )
-    a, b1, c, hq = (g1_jac_to_host(accs[i]) for i in (0, 1, 3, 4))
-    b2 = g2_jac_to_host(accs[2])
-    return [
-        _assemble(dpk, (a[i], b1[i], b2[i], c[i], hq[i]), 1 + secrets.randbelow(R - 1), 1 + secrets.randbelow(R - 1))
-        for i in range(len(witnesses))
-    ]
+    from ..utils.metrics import REGISTRY
+    from ..utils.trace import trace
+
+    with trace("tpu/prove_batch", n=len(witnesses)):
+        for wit in witnesses:
+            _check_inferred_widths(dpk, wit, w_std=wit if _is_u64_witness(wit) else None)
+        n = len(witnesses)
+        chunk = _batch_chunk_size()
+        if chunk <= 0 or n <= chunk:
+            spans = [list(witnesses)]
+        else:
+            spans = [list(witnesses[i : i + chunk]) for i in range(0, n, chunk)]
+            spans[-1] += [spans[-1][-1]] * (chunk - len(spans[-1]))
+        parts = []
+        for span in spans:
+            # one batched to_mont per chunk (not one device dispatch per witness)
+            w = FR.to_mont(jnp.asarray(np.stack([_witness_std_limbs(wit) for wit in span])))
+            parts.append(_prove_device(dpk, w, batched=True))
+        accs = (
+            parts[0]
+            if len(parts) == 1
+            else jax.tree_util.tree_map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+        )
+        a, b1, c, hq = (g1_jac_to_host(accs[i]) for i in (0, 1, 3, 4))
+        b2 = g2_jac_to_host(accs[2])
+        proofs = [
+            _assemble(dpk, (a[i], b1[i], b2[i], c[i], hq[i]), 1 + secrets.randbelow(R - 1), 1 + secrets.randbelow(R - 1))
+            for i in range(len(witnesses))
+        ]
+    REGISTRY.counter("zkp2p_proves_total", {"prover": "tpu"}).inc(len(witnesses))
+    return proofs

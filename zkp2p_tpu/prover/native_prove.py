@@ -425,16 +425,19 @@ def prove_native(
     if load_config().msm_overlap and threads > 1:
         from concurrent.futures import ThreadPoolExecutor
 
-        from ..utils.trace import adopt_stack, current_stack
+        from ..utils.trace import adopt_context, adopt_stack, current_context, current_stack
 
         # worker-thread trace records keep this thread's stage prefix
         # (e.g. bench.py's prove_native_N span) — without it the four
         # submitted MSMs log under a bare root and per-rep stage
-        # attribution in the bench trace is lost
+        # attribution in the bench trace is lost.  The ambient context
+        # (the service's request_id) rides along the same way.
         stack = current_stack()
+        ctx = current_context()
 
         def seeded(fn, *fargs):
             adopt_stack(stack)
+            adopt_context(ctx)
             return fn(*fargs)
 
         with ThreadPoolExecutor(max_workers=4) as ex:
@@ -454,4 +457,13 @@ def prove_native(
         b2_acc = msm_g2(dpk.b2_bases, np.ascontiguousarray(w_std[b_sel]), "b2")
         c_acc = msm_g1(dpk.c_bases, np.ascontiguousarray(w_std[c_sel]), "c")
         h_acc = msm_g1(dpk.h_bases, d_std, "h")
-    return _assemble(dpk, (a_acc, b1_acc, b2_acc, c_acc, h_acc), r, s)
+    proof = _assemble(dpk, (a_acc, b1_acc, b2_acc, c_acc, h_acc), r, s)
+    # publish into the process registry: prove count + a refresh of the
+    # native runtime's counter block (one ctypes read of ~20 slots —
+    # noise next to a prove), so a Prometheus scrape or the service's
+    # per-sweep flush always sees current MSM/pool stats
+    from ..utils.metrics import REGISTRY, publish_native_stats
+
+    REGISTRY.counter("zkp2p_proves_total", {"prover": "native"}).inc()
+    publish_native_stats()
+    return proof

@@ -53,6 +53,28 @@ def _opt_int(s: str) -> Optional[int]:
         return 1
 
 
+def _opt_port(s: str) -> Optional[int]:
+    # metrics exposition port: unset/empty/0/malformed all mean OFF —
+    # a typo must fail closed (no listener), never bind a random port
+    try:
+        v = int(s)
+    except ValueError:
+        return None
+    return v if 0 < v < 65536 else None
+
+
+def _pos_int(default: int):
+    # bounded positive int with a safe fallback (ring-buffer sizes):
+    # malformed keeps the committed default rather than crashing import
+    def parse(s: str) -> int:
+        try:
+            return max(1, int(s))
+        except ValueError:
+            return default
+
+    return parse
+
+
 KNOBS: Dict[str, Tuple[str, object, object]] = {
     # device (XLA/Pallas) prover MSM tiers — see prover.groth16_tpu
     "msm_window": ("ZKP2P_MSM_WINDOW", int, 4),
@@ -102,6 +124,19 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "no_cache": ("ZKP2P_NO_CACHE", _BOOL, False),
     # debug: native MSM phase counters (csrc zkp2p_msm_prof_dump)
     "msm_prof": ("ZKP2P_MSM_PROF", _BOOL, False),
+    # observability (utils.metrics / utils.trace): Prometheus exposition
+    # port (unset/0 = off), JSONL metrics-sink path ("" = the consumer's
+    # default: stderr for bench dumps, <spool>.metrics.jsonl for the
+    # service), and the trace ring-buffer bound (records kept in memory
+    # between dumps; overflow increments zkp2p_trace_dropped_total).
+    "metrics_port": ("ZKP2P_METRICS_PORT", _opt_port, None),
+    # bind address for the exposition endpoint: localhost by default —
+    # /metrics discloses host facts and knob config, so reaching it from
+    # another machine (a real Prometheus) is an explicit opt-in
+    # (ZKP2P_METRICS_ADDR=0.0.0.0)
+    "metrics_addr": ("ZKP2P_METRICS_ADDR", str, "127.0.0.1"),
+    "metrics_sink": ("ZKP2P_METRICS_SINK", str, ""),
+    "trace_max": ("ZKP2P_TRACE_MAX", _pos_int(65536), 65536),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -128,6 +163,10 @@ class ProverConfig:
     native_threads: Optional[int] = None
     no_cache: bool = False
     msm_prof: bool = False
+    metrics_port: Optional[int] = None
+    metrics_addr: str = "127.0.0.1"
+    metrics_sink: str = ""
+    trace_max: int = 65536
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 

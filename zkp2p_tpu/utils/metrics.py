@@ -1,0 +1,489 @@
+"""Process-wide metrics registry for the proving stack.
+
+The reference's observability is `console.time("zk-gen")` and a UI
+stopwatch (SURVEY.md §5); a proving *service* needs attributable
+numbers: counters/gauges/histograms that every layer (bench, native
+prover, device prover, pipeline service) publishes into, a run manifest
+(host facts + knob states + run_id) that makes each dump self-
+describing, a rotating JSONL sink for offline aggregation
+(tools/trace_report.py), and Prometheus text exposition behind
+ZKP2P_METRICS_PORT (default off).
+
+Design constraints:
+  - zero hard dependencies (stdlib + the already-present numpy-free
+    paths): importable everywhere trace.py is;
+  - instruments are cheap under the GIL (plain attribute updates; the
+    registry lock is only taken on get-or-create);
+  - histograms are FIXED-BUCKET and mergeable, so per-process snapshots
+    can be combined across service workers without raw-sample transfer.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+# Log-spaced millisecond buckets covering one MSM chunk (~1 ms) up to a
+# cold full-size prove (~minutes).  Upper bounds; +Inf is implicit.
+DEFAULT_MS_BUCKETS: Tuple[float, ...] = (
+    1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000, 60000, 180000,
+)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter.  inc() is a plain float add — atomic enough
+    under the GIL for the per-stage/per-request rates this tracks."""
+
+    kind = "counter"
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: _LabelKey = ()):  # noqa: D401
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+    def state(self) -> Dict:
+        return {"value": self.value}
+
+    def merge_state(self, st: Dict) -> None:
+        self.value += st["value"]
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (pool depth, knob arm...)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: _LabelKey = ()):  # noqa: D401
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def state(self) -> Dict:
+        return {"value": self.value}
+
+    def merge_state(self, st: Dict) -> None:
+        # merging gauges across processes keeps the max (peak semantics —
+        # the depth/arm gauges this registry uses are all peak-or-flag)
+        self.value = max(self.value, st["value"])
+
+
+class Histogram:
+    """Fixed-bucket histogram: counts per upper bound (+Inf last), sum,
+    count, max.  Mergeable ONLY across identical bucket layouts — the
+    point of fixing the layout process-wide."""
+
+    kind = "histogram"
+    __slots__ = ("name", "labels", "buckets", "counts", "sum", "count", "max")
+
+    def __init__(self, name: str, labels: _LabelKey = (), buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.labels = labels
+        self.buckets = tuple(buckets) if buckets else DEFAULT_MS_BUCKETS
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.sum += v
+        self.count += 1
+        if v > self.max:
+            self.max = v
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (upper bound of the bucket
+        holding the q-th sample) — for quick in-process reads; exact
+        percentiles come from the raw JSONL records via trace_report."""
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.buckets[i] if i < len(self.buckets) else self.max
+        return self.max
+
+    def state(self) -> Dict:
+        return {
+            "buckets": list(self.buckets),
+            "counts": list(self.counts),
+            "sum": self.sum,
+            "count": self.count,
+            "max": self.max,
+        }
+
+    def merge_state(self, st: Dict) -> None:
+        if tuple(st["buckets"]) != self.buckets:
+            raise ValueError(f"histogram {self.name}: bucket layout mismatch")
+        for i, c in enumerate(st["counts"]):
+            self.counts[i] += c
+        self.sum += st["sum"]
+        self.count += st["count"]
+        self.max = max(self.max, st["max"])
+
+
+class Registry:
+    """Get-or-create instrument store.  One process-wide instance
+    (REGISTRY) backs trace(), the service, and the provers; fresh
+    instances exist for tests and for merging foreign snapshots."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, str, _LabelKey], object] = {}
+        # bumped by reset(): callers holding instrument references
+        # (trace.py's per-stage cache) re-fetch when it moves, so a
+        # reset never silently severs their exposition
+        self.generation = 0
+
+    def _get(self, cls, name: str, labels: Optional[Dict[str, str]], **kw):
+        key = (cls.kind, name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = cls(name, key[2], **kw)
+            return m
+
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(
+        self, name: str, labels: Optional[Dict[str, str]] = None,
+        buckets: Optional[Tuple[float, ...]] = None,
+    ) -> Histogram:
+        return self._get(Histogram, name, labels, buckets=buckets)
+
+    def snapshot(self) -> List[Dict]:
+        """JSON-able state of every instrument (mergeable elsewhere)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return [
+            {"kind": m.kind, "name": m.name, "labels": dict(m.labels), **m.state()}
+            for m in metrics
+        ]
+
+    def merge(self, snapshot: List[Dict]) -> None:
+        """Fold a snapshot() from another process/registry into this one."""
+        for rec in snapshot:
+            cls = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}[rec["kind"]]
+            kw = {"buckets": tuple(rec["buckets"])} if rec["kind"] == "histogram" else {}
+            self._get(cls, rec["name"], rec["labels"], **kw).merge_state(rec)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self.generation += 1
+
+    # ------------------------------------------------------- exposition
+
+    def to_prometheus(self) -> str:
+        """Prometheus text format (0.0.4).  Metric names are used as
+        registered (the zkp2p_ prefix convention lives at call sites)."""
+
+        def fmt_labels(labels: _LabelKey, extra: str = "") -> str:
+            parts = [f'{k}="{_esc(v)}"' for k, v in labels]
+            if extra:
+                parts.append(extra)
+            return "{" + ",".join(parts) + "}" if parts else ""
+
+        def _esc(v: str) -> str:
+            return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+        def _num(v: float) -> str:
+            # %g truncates to 6 significant digits — a requests counter
+            # past 1e6 (or a ns gauge in the billions) would stop
+            # visibly incrementing between scrapes; emit integral values
+            # exactly and floats at full precision
+            if float(v).is_integer():
+                return str(int(v))
+            return repr(float(v))
+
+        with self._lock:
+            metrics = list(self._metrics.values())
+        by_name: Dict[Tuple[str, str], List] = {}
+        for m in metrics:
+            by_name.setdefault((m.name, m.kind), []).append(m)
+        out: List[str] = []
+        for (name, kind), ms in sorted(by_name.items()):
+            out.append(f"# TYPE {name} {kind}")
+            for m in ms:
+                if kind == "histogram":
+                    cum = 0
+                    for ub, c in zip(m.buckets, m.counts):
+                        cum += c
+                        le = 'le="%g"' % ub
+                        out.append(f"{name}_bucket{fmt_labels(m.labels, le)} {cum}")
+                    cum += m.counts[-1]
+                    le_inf = 'le="+Inf"'
+                    out.append(f"{name}_bucket{fmt_labels(m.labels, le_inf)} {cum}")
+                    out.append(f"{name}_sum{fmt_labels(m.labels)} {_num(m.sum)}")
+                    out.append(f"{name}_count{fmt_labels(m.labels)} {m.count}")
+                else:
+                    out.append(f"{name}{fmt_labels(m.labels)} {_num(m.value)}")
+        return "\n".join(out) + "\n"
+
+
+REGISTRY = Registry()
+
+# ---------------------------------------------------------------------------
+# Run manifest: every dump carries WHO produced it (run_id + pid), WHERE
+# (host facts — PR 2's unattributable 3.28-3.68 s spread is why), and
+# HOW (every knob state + provenance), so a trace file read weeks later
+# is self-describing.
+
+_run_id: Optional[str] = None
+
+
+def run_id() -> str:
+    """Stable per-process run identifier (12 hex chars)."""
+    global _run_id
+    if _run_id is None:
+        _run_id = uuid.uuid4().hex[:12]
+    return _run_id
+
+
+def host_facts() -> Dict:
+    """Host facts that explain run-to-run spread: the RESOLVED native
+    worker count (ZKP2P_NATIVE_THREADS else core count — the same rule
+    the C pool and prover apply), CPU identity, and IFMA availability.
+    Shared by bench.py's BENCH record and the run manifest."""
+    from .config import load_config
+
+    cpu_model = "unknown"
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    cpu_model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    ifma = 0
+    try:
+        from ..native.lib import get_lib
+
+        lib = get_lib()
+        if lib is not None:
+            ifma = int(lib.zkp2p_ifma_available())
+    except Exception:  # noqa: BLE001 — attribution must not break a prove
+        pass
+    cfg = load_config()
+    return {
+        "native_threads": cfg.native_threads or (os.cpu_count() or 1),
+        "cpu_model": cpu_model,
+        "cpu_count": os.cpu_count() or 1,
+        "ifma": ifma,
+    }
+
+
+def run_manifest() -> Dict:
+    """{run_id, pid, ts, host facts, every knob + provenance}."""
+    from .config import KNOBS, load_config
+
+    cfg = load_config()
+    knobs = {}
+    for attr in KNOBS:
+        v = getattr(cfg, attr)
+        knobs[attr] = v if isinstance(v, (int, float, bool, str, type(None))) else str(v)
+    return {
+        "run_id": run_id(),
+        "pid": os.getpid(),
+        "ts": round(time.time(), 3),
+        "host": host_facts(),
+        "knobs": knobs,
+        "provenance": dict(cfg.provenance),
+    }
+
+
+def publish_native_stats(registry: Optional[Registry] = None) -> Optional[Dict]:
+    """Read the native runtime's counter block (native.lib
+    stats_snapshot) into `zkp2p_native_<field>` gauges; returns the raw
+    snapshot (None when the native lib is unavailable).  Gauges, not
+    counters: the C block is itself cumulative, so last-write-wins
+    mirrors it without double counting."""
+    try:
+        from ..native.lib import stats_snapshot
+
+        snap = stats_snapshot()
+    except Exception:  # noqa: BLE001 — numpy-less envs, stale .so:
+        return None    # observation must never fail the prove around it
+    if snap is None:
+        return None
+    reg = registry if registry is not None else REGISTRY
+    for field, v in snap.items():
+        reg.gauge(f"zkp2p_native_{field}").set(v)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# Rotating JSONL sink: the durable side of the registry.  One record per
+# line; each fresh file opens with a manifest line; every write is ONE
+# O_APPEND write() so interleaved service workers produce intact lines.
+# Rotation is guarded by an flock'd sidecar (<path>.lock) because the
+# advertised mode is MULTIPLE worker processes sharing one path — two
+# unsynchronized rotators would double-shift backups (losing records) or
+# let a writer land on a fresh file between size-check and open without
+# its manifest line.
+
+
+class JsonlSink:
+    def __init__(self, path: str, max_bytes: int = 16 << 20, backups: int = 3):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.backups = backups
+        self._lock = threading.Lock()
+        # Identity (st_dev, st_ino) of the file THIS instance last
+        # stamped its manifest into: a restarted service appending to an
+        # existing sub-cap sink must still stamp its run's manifest (new
+        # run_id, possibly new knob arms), and a rotation performed by a
+        # SIBLING process changes the identity under us — both cases
+        # re-stamp, or trace_report --runs/--diff loses the stage-span
+        # attribution for every run but the file's first.
+        self._stamped_id: Optional[Tuple[int, int]] = None
+
+    def _rotate_locked(self) -> None:
+        for i in range(self.backups - 1, 0, -1):
+            src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        if os.path.exists(self.path):
+            os.replace(self.path, f"{self.path}.1")
+
+    def write(self, record: Dict) -> None:
+        self.write_many([record])
+
+    def write_many(self, records: List[Dict]) -> None:
+        if not records:
+            return
+        payload = "".join(json.dumps(r, default=str) + "\n" for r in records)
+        with self._lock:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            lock_fd = -1
+            try:
+                import fcntl
+
+                lock_fd = os.open(self.path + ".lock", os.O_CREAT | os.O_WRONLY, 0o644)
+                fcntl.flock(lock_fd, fcntl.LOCK_EX)
+            except Exception:  # noqa: BLE001 — no flock (exotic fs): in-process lock only
+                if lock_fd >= 0:
+                    os.close(lock_fd)
+                    lock_fd = -1
+            try:
+                try:
+                    st = os.stat(self.path)
+                    size, cur_id = st.st_size, (st.st_dev, st.st_ino)
+                except OSError:
+                    size, cur_id = -1, None  # fresh file
+                if size >= 0 and size + len(payload) > self.max_bytes:
+                    self._rotate_locked()
+                    size, cur_id = -1, None
+                if size < 0 or cur_id != self._stamped_id:
+                    payload = json.dumps({"type": "manifest", **run_manifest()}) + "\n" + payload
+                fd = os.open(self.path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+                try:
+                    os.write(fd, payload.encode())
+                    fst = os.fstat(fd)
+                    self._stamped_id = (fst.st_dev, fst.st_ino)
+                finally:
+                    os.close(fd)
+            finally:
+                if lock_fd >= 0:
+                    os.close(lock_fd)  # releases the flock
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: a tiny stdlib HTTP endpoint, default OFF
+# (ZKP2P_METRICS_PORT unset).  One server per process, daemon thread —
+# observation must never keep a prover alive.
+
+_server = None
+_server_lock = threading.Lock()
+
+
+def maybe_start_metrics_server(port: Optional[int] = None, registry: Optional[Registry] = None):
+    """Start (idempotently) the /metrics HTTP endpoint when a port is
+    configured; returns the server or None when exposition is off.
+    Binds ZKP2P_METRICS_ADDR (default localhost — the payload discloses
+    host facts and knob config; 0.0.0.0 is an explicit opt-in)."""
+    global _server
+    reg = registry if registry is not None else REGISTRY
+    from .config import load_config
+
+    if port is None:
+        port = load_config().metrics_port
+    if not port:
+        return None
+    addr = load_config().metrics_addr or "127.0.0.1"
+    with _server_lock:
+        if _server is not None:
+            return _server
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API
+                if self.path.rstrip("/") in ("", "/metrics".rstrip("/")):
+                    publish_native_stats(reg)  # scrape-time native refresh
+                    body = reg.to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def log_message(self, *_a):  # scrapes must not spam stderr
+                pass
+
+        try:
+            srv = ThreadingHTTPServer((addr, int(port)), Handler)
+        except OSError as e:
+            # EADDRINUSE from a sibling worker sharing the port, a
+            # privileged port, ... — observation must never fail a
+            # prove: degrade to no endpoint, loudly
+            import sys
+
+            print(f"[metrics] endpoint on :{port} unavailable ({e}); exposition off", file=sys.stderr)
+            return None
+        threading.Thread(target=srv.serve_forever, daemon=True, name="zkp2p-metrics").start()
+        _server = srv
+        return srv
+
+
+def stop_metrics_server() -> None:
+    """Tear down the exposition endpoint (tests; service shutdown)."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            srv = _server
+            _server = None
+            srv.shutdown()
+            srv.server_close()

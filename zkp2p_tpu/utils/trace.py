@@ -10,23 +10,80 @@ xprof when JAX_TRACE_DIR is set.
         with trace("h_poly"):
             ...
     dump_trace()  ->  [{"stage": "prove", "ms": ..., "batch": 16, ...}]
+
+Every closed span also feeds the process metrics registry
+(utils.metrics REGISTRY, `zkp2p_stage_ms{stage=...}` histograms), so a
+Prometheus scrape sees stage latencies without any dump.
+
+Records are held in a bounded ring (ZKP2P_TRACE_MAX, default 64k): a
+service loop tracing forever stays at a fixed memory footprint and the
+overflow is COUNTED (`zkp2p_trace_dropped_total` + the dump manifest),
+never silent.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional
 
-_records: List[Dict[str, Any]] = []
+
+def _ring_capacity() -> int:
+    from .config import load_config
+
+    return load_config().trace_max
+
+
+_records: Deque[Dict[str, Any]] = collections.deque(maxlen=_ring_capacity())
+_dropped = 0  # lifetime count of ring-overflow evictions (GIL-guarded)
 # Stage nesting is PER THREAD (the service overlaps a witness thread with
 # the proving thread; a shared stack would interleave their frames and
 # pop across threads).  Appends to _records are atomic under the GIL.
 _tls = threading.local()
+
+# stage-path -> histogram, cached so the registry lock is not taken per
+# span close (get-or-create only on first sight of a stage).  Keyed by
+# the registry GENERATION too: REGISTRY.reset() orphans instruments, and
+# feeding an orphan would silently blank exposition for cached stages.
+_stage_hists: Dict[str, Any] = {}
+_stage_hists_gen = -1
+
+
+def _observe_stage(path: str, ms: float) -> None:
+    global _stage_hists_gen
+    from .metrics import REGISTRY
+
+    if REGISTRY.generation != _stage_hists_gen:
+        _stage_hists.clear()
+        _stage_hists_gen = REGISTRY.generation
+    h = _stage_hists.get(path)
+    if h is None:
+        h = _stage_hists[path] = REGISTRY.histogram("zkp2p_stage_ms", {"stage": path})
+    h.observe(ms)
+
+
+_append_lock = threading.Lock()
+
+
+def _append(rec: Dict[str, Any]) -> None:
+    # Locked: two threads both seeing len == maxlen-1 would each append
+    # (one eviction) yet neither count the drop — and the drop counter's
+    # whole contract is "overflow counted, never silent".
+    global _dropped
+    with _append_lock:
+        dropped = _records.maxlen is not None and len(_records) == _records.maxlen
+        if dropped:
+            _dropped += 1
+        _records.append(rec)
+    if dropped:
+        from .metrics import REGISTRY
+
+        REGISTRY.counter("zkp2p_trace_dropped_total").inc()
 
 
 @contextlib.contextmanager
@@ -40,7 +97,14 @@ def trace(stage: str, **attrs):
     try:
         yield
     finally:
-        _records.append({"stage": path, "ms": round((time.perf_counter() - t0) * 1e3, 3), **attrs})
+        ms = round((time.perf_counter() - t0) * 1e3, 3)
+        ctx = getattr(_tls, "ctx", None)
+        rec = {"stage": path, "ms": ms}
+        if ctx:
+            rec.update(ctx)
+        rec.update(attrs)
+        _append(rec)
+        _observe_stage(path, ms)
         stack.pop()
 
 
@@ -56,21 +120,97 @@ def adopt_stack(stack: List[str]) -> None:
     _tls.stack = list(stack)
 
 
+def set_context(**attrs) -> None:
+    """Merge ambient attributes into every record THIS thread closes
+    (request_id through witness -> prove -> emit; a None value removes
+    the key).  Context rides the same per-thread rail as the stack —
+    current_context()/adopt_context() hand it across worker pools."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        ctx = _tls.ctx = {}
+    for k, v in attrs.items():
+        if v is None:
+            ctx.pop(k, None)
+        else:
+            ctx[k] = v
+
+
+def clear_context() -> None:
+    _tls.ctx = {}
+
+
+def current_context() -> Dict[str, Any]:
+    return dict(getattr(_tls, "ctx", None) or {})
+
+
+def adopt_context(ctx: Dict[str, Any]) -> None:
+    _tls.ctx = dict(ctx)
+
+
+def _resize_ring(capacity: int) -> None:
+    """Swap the ring for a new bound, keeping the newest records (tests;
+    long-lived services retuning ZKP2P_TRACE_MAX without a restart)."""
+    global _records
+    _records = collections.deque(_records, maxlen=max(1, capacity))
+
+
 def records() -> List[Dict[str, Any]]:
     return list(_records)
 
 
+def dropped() -> int:
+    return _dropped
+
+
 def reset() -> None:
+    global _dropped
     _records.clear()
+    _dropped = 0
+
+
+def drain() -> List[Dict[str, Any]]:
+    """Atomically take every buffered record (the service's per-sweep
+    flush into its JSONL sink) — records appended concurrently after the
+    snapshot stay buffered for the next drain."""
+    out: List[Dict[str, Any]] = []
+    while True:
+        try:
+            out.append(_records.popleft())
+        except IndexError:
+            return out
 
 
 def dump_trace(path: Optional[str] = None) -> None:
-    out = "\n".join(json.dumps(r) for r in _records)
+    """Emit buffered records.  To a file: ONE atomic O_APPEND write —
+    safe for many service workers sharing a sink — with a manifest line
+    (run_id, pid, host facts, knob states, drop count) stamped first and
+    run_id/pid on every record line, so interleaved multi-process dumps
+    stay separable and self-describing.  Without a path: stderr.
+
+    Deliberately NOT routed through metrics.JsonlSink: that sink stamps
+    a manifest only on a fresh/rotated file, but a trace sink is shared
+    ACROSS processes and knob arms (the A/B workflow appends two bench
+    runs to one file), so every dump must carry its own manifest or
+    trace_report --runs loses the later runs' knob attribution.  The
+    trade-off: a process looping dump_trace on one path grows it
+    unboundedly — dump once per process, or point heavy loops at a
+    JsonlSink."""
+    from .metrics import run_id, run_manifest
+
+    recs = records()
     if path:
-        with open(path, "a") as f:
-            f.write(out + "\n")
+        rid, pid = run_id(), os.getpid()
+        manifest = {"type": "manifest", **run_manifest(), "trace_dropped": _dropped}
+        lines = [json.dumps(manifest)]
+        lines += [json.dumps({**r, "run_id": rid, "pid": pid}) for r in recs]
+        payload = ("\n".join(lines) + "\n").encode()
+        fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+        try:
+            os.write(fd, payload)
+        finally:
+            os.close(fd)
     else:
-        print(out, file=sys.stderr)
+        print("\n".join(json.dumps(r) for r in recs), file=sys.stderr)
 
 
 @contextlib.contextmanager
