@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow metrics-smoke driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow metrics-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -26,6 +26,15 @@ native-asan:
 # See docs/OBSERVABILITY.md.
 metrics-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_metrics_smoke.py -q
+
+# Execution-path preflight (docs/OBSERVABILITY.md §execution audit):
+# probe the backend, arm EVERY gate through its real resolver, print
+# the gate→arm table + execution digest, and warn loudly on mis-arms
+# (e.g. pallas forced on a CPU host).  Run this FIRST in every tunnel
+# window — it is the check that would have caught the round-2 silent
+# disarm in seconds.  Machine output: `python -m zkp2p_tpu doctor --json`.
+doctor:
+	python -m zkp2p_tpu doctor
 
 # env -u PALLAS_AXON_POOL_IPS: the axon sitecustomize dials the TPU relay
 # at interpreter start when the var is set, and that dial BLOCKS while any

@@ -43,13 +43,23 @@ def _init_backend():
     HANG rather than raise — so probe the TPU in a subprocess with a
     timeout first, and pin the platform to CPU through the config API
     when the probe fails.  The bench must always emit a JSON record."""
-    from zkp2p_tpu.utils.jaxcfg import enable_cache, tpu_probe_ok
+    from zkp2p_tpu.utils.jaxcfg import adopt_probe, enable_cache, tpu_probe_ok
 
     tpu_ok = False
     if os.environ.get("BENCH_TPU_INNER"):
         # the guard parent just proved the tunnel healthy — don't spend
-        # the child's compile budget re-proving it
+        # the child's compile budget re-proving it (the parent's
+        # structured probe record rides the env into this child's BENCH
+        # JSON / run manifest)
         tpu_ok = True
+        raw = os.environ.get("BENCH_TPU_PROBE_JSON")
+        if raw:
+            try:
+                rec = json.loads(raw)
+                if isinstance(rec, dict):  # junk env must never kill the bench
+                    adopt_probe(rec)
+            except ValueError:
+                pass
     elif not os.environ.get("BENCH_FORCE_CPU"):
         tpu_ok = tpu_probe_ok()
         if not tpu_ok:
@@ -210,6 +220,18 @@ def _native_fallback_bench(plat: str) -> bool:
             f"overlap={'on' if ov_on else 'off'} "
             f"threads={host['native_threads']} ifma={host['ifma']} cpu={host['cpu_model']}"
         )
+        # preflight (execution audit): arm every gate and warn loudly on
+        # mis-arms BEFORE spending minutes proving — a silently disarmed
+        # tier must never again be discovered from the numbers.  Pass the
+        # cfg resolved ABOVE (before this tier's bench-default env
+        # write-backs): a fresh load inside preflight would read the
+        # written ZKP2P_MSM_GLV=1 as operator intent and warn about the
+        # device-prover gate on every default run — alarm fatigue for
+        # exactly the warning class this exists for (the device prover
+        # never runs in this tier; prove_native re-reads the env).
+        from zkp2p_tpu.utils.audit import preflight
+
+        preflight(probe=False, workload=False, log=log, cfg=cfg)
         inputs = make_input(0)
         with trace("witness_gen"):
             w = cs.witness(inputs.public_signals, inputs.seed)
@@ -251,6 +273,8 @@ def _native_fallback_bench(plat: str) -> bool:
     # else stderr as before; the native counter snapshot rides the stderr
     # log either way so MSM fill/suffix/pool attribution is in the round
     # notes without an extra tool
+    from zkp2p_tpu.utils.audit import execution_digest
+    from zkp2p_tpu.utils.jaxcfg import last_probe
     from zkp2p_tpu.utils.metrics import publish_native_stats, run_id
 
     sink = _load_cfg().metrics_sink
@@ -276,6 +300,11 @@ def _native_fallback_bench(plat: str) -> bool:
                 "batch": 1,
                 # joins this record to its stage-trace dump in the sink
                 "run_id": run_id(),
+                # which arms actually executed (audit gate→arm hash) +
+                # the structured probe outcome — "TPU TUNNEL DOWN" is a
+                # queryable record now, not free text in the unit string
+                "execution_digest": execution_digest(),
+                "tpu_probe": last_probe(),
                 "msm_glv": bool(glv_on),
                 "msm_batch_affine": bool(ba_on),
                 "msm_overlap": bool(ov_on),
@@ -321,6 +350,10 @@ def _cpu_fallback_bench(plat: str):
     log(f"CPU fallback: amount circuit {cs.num_constraints} constraints, first={first:.1f}s steady={best:.1f}s")
     dump_trace()
     vs = ((1 / best) * cs.num_constraints / BASELINE_CONSTRAINTS) / BASELINE_PROOFS_PER_SEC
+    from zkp2p_tpu.utils.audit import execution_digest
+    from zkp2p_tpu.utils.jaxcfg import last_probe
+    from zkp2p_tpu.utils.metrics import run_id
+
     print(
         json.dumps(
             {
@@ -328,6 +361,9 @@ def _cpu_fallback_bench(plat: str):
                 "value": round(1 / best, 4),
                 "unit": f"proofs/s @ {cs.num_constraints}-constraint amount circuit (TPU TUNNEL DOWN, fallback on 1 {plat})",
                 "vs_baseline": round(vs, 4),
+                "run_id": run_id(),
+                "execution_digest": execution_digest(),
+                "tpu_probe": last_probe(),
             }
         )
     )
@@ -347,6 +383,10 @@ def _tpu_tier_guarded() -> bool:
 
     budget = int(os.environ.get("BENCH_TPU_BUDGET", "550"))
     env = dict(os.environ, BENCH_TPU_INNER="1")
+    from zkp2p_tpu.utils.jaxcfg import last_probe
+
+    if last_probe() is not None:
+        env["BENCH_TPU_PROBE_JSON"] = json.dumps(last_probe())
     # Own session so a timeout kills the WHOLE process group — a plain
     # child kill would orphan grandchildren (e.g. a hung probe) that
     # keep holding the single-chip tunnel.
@@ -411,6 +451,14 @@ def main():
             log("TPU probe failed (tunnel down?)")
             os.environ["BENCH_FORCE_CPU"] = "1"
 
+    # flight recorder: register the jit compile-event listener before
+    # the first compile, so a 20-minute cold XLA:CPU prover compile is
+    # attributed to its stage, not inferred from wall-clock gaps.
+    # (After the TPU-tier guard: the parent must not import jax — and
+    # risk the tunnel dial — before the guarded child has run.)
+    from zkp2p_tpu.utils.audit import install_compile_listener
+
+    install_compile_listener()
     devs, fell_back = _init_backend()
     log("devices:", devs)
     # Route on the PROBE RESULT, not env state (a stale BENCH_FALLBACK
@@ -447,6 +495,14 @@ def main():
         cfg.provenance["msm_window"] = "bench-default"
     cfg.apply_env()
     log(f"config: {cfg.describe()}")
+    # preflight (execution audit): report every gate's arm — on-chip this
+    # is where a plugin rename disarming the fast paths gets caught.
+    # Pass THIS cfg: apply_env just wrote every knob back into the env,
+    # so a fresh load inside preflight would read every provenance as
+    # "env" and warn about defaults nobody set.
+    from zkp2p_tpu.utils.audit import preflight
+
+    preflight(probe=False, workload=False, log=log, cfg=cfg)
     from zkp2p_tpu.prover.groth16_tpu import prove_tpu_batch
     from zkp2p_tpu.snark.groth16 import verify
     from zkp2p_tpu.utils.trace import dump_trace, trace
@@ -534,6 +590,10 @@ def main():
     mode = f"curve={CURVE_IMPL} w={MSM_WINDOW} glv={'on' if _glv() else 'off'}"
     if os.environ.get("BENCH_REEXECED"):
         mode += " PALLAS-FAILED-XLA-REEXEC"
+    from zkp2p_tpu.utils.audit import execution_digest
+    from zkp2p_tpu.utils.jaxcfg import last_probe
+    from zkp2p_tpu.utils.metrics import run_id
+
     print(
         json.dumps(
             {
@@ -545,6 +605,11 @@ def main():
                 # per-proof p50 latency == the batch wall-time median
                 "p50_s": round(med, 3),
                 "batch": BATCH,
+                "run_id": run_id(),
+                # the audited code-path hash + structured probe record —
+                # two BENCH rounds are comparable only on equal digests
+                "execution_digest": execution_digest(),
+                "tpu_probe": last_probe(),
             }
         )
     )

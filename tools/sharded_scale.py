@@ -102,9 +102,22 @@ def main() -> None:
     stage(f"prove_tpu_sharded done in {time.time() - t0:.1f}s (incl. compile)")
     assert proof == oracle, "sharded proof != native/host oracle proof"
     assert verify(vk, proof, [])
+    # Observability flush, wired the way bench.py's native tier is: the
+    # per-stage records (sharded/h_evals, sharded/msm_*) go to the
+    # configured JSONL sink (stderr when unset) with run_id/pid and the
+    # knob/gate manifest, so MULTICHIP runs are aggregatable and
+    # `trace_report --diff RID_A RID_B` works across dryrun rounds.
+    from zkp2p_tpu.utils.config import load_config
+    from zkp2p_tpu.utils.metrics import run_id
+    from zkp2p_tpu.utils.trace import dump_trace
+
+    sink = load_config().metrics_sink
+    dump_trace(sink or None)
+    if sink:
+        stage(f"stage trace appended to {sink} (run_id {run_id()})")
     stage(
         f"SHARDED == ORACLE and pairing-verified at {cs.num_constraints} constraints "
-        f"on the 8-device mesh — scale evidence recorded"
+        f"on the 8-device mesh — scale evidence recorded (run_id {run_id()})"
     )
 
 

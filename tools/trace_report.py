@@ -13,10 +13,17 @@ Modes:
                state summary when request records are present)
   --tree       stage-path tree (indented by "/" nesting) with the same
                percentiles per node
-  --runs       list the run_ids found (with knob arms) and exit
+  --runs       list the run_ids found (with knob arms + execution
+               digest) and exit
   --run RID    restrict aggregation to one run_id
   --diff A B   A/B: two files OR (with one file) two run_ids — per-stage
                p50 delta table, replacing eyeballed min-of-5 comparisons
+  --json       machine output: {"stages", "requests", "runs"} with the
+               per-stage aggregates, request-state aggregates, and each
+               run's knobs + gate arms + execution digest — so CI can
+               gate on digests/latencies instead of scraping text
+               tables.  Honors --run; with --diff, emits {"a","b"} of
+               per-stage aggregates instead.
 
 Exact percentiles from the raw records (the registry's histograms are
 bucket-resolution; this reads the records themselves).
@@ -153,19 +160,14 @@ def render_tree(agg: Dict[str, dict]) -> str:
 
 
 def render_requests(requests: List[dict], run: Optional[str] = None) -> str:
-    by_state: Dict[str, List[float]] = {}
-    for rec in requests:
-        if run and rec.get("run_id") != run:
-            continue
-        by_state.setdefault(rec.get("state", "?"), []).append(float(rec.get("ms") or 0.0))
-    if not by_state:
+    agg = _aggregate_requests(requests, run=run)
+    if not agg:
         return ""
     lines = ["request states:"]
-    for state, vals in sorted(by_state.items()):
-        vals.sort()
+    for state, a in sorted(agg.items()):
         lines.append(
-            f"  {state:<24} n={len(vals):<6} p50={_fmt_ms(_pct(vals, 0.5))} "
-            f"p95={_fmt_ms(_pct(vals, 0.95))} max={_fmt_ms(vals[-1] if vals else 0)}"
+            f"  {state:<24} n={a['n']:<6} p50={_fmt_ms(a['p50'])} "
+            f"p95={_fmt_ms(a['p95'])} max={_fmt_ms(a['max'])}"
         )
     return "\n".join(lines)
 
@@ -198,19 +200,76 @@ def render_diff(agg_a: Dict[str, dict], agg_b: Dict[str, dict], label_a: str, la
     return "\n".join(lines)
 
 
-def _runs_summary(stages: List[dict], manifests: List[dict]) -> str:
+def _aggregate_requests(requests: List[dict], run: Optional[str] = None) -> Dict[str, dict]:
+    """state -> {n, p50, p95, max} over request terminal records."""
+    by_state: Dict[str, List[float]] = {}
+    for rec in requests:
+        if run and rec.get("run_id") != run:
+            continue
+        by_state.setdefault(rec.get("state", "?"), []).append(float(rec.get("ms") or 0.0))
+    out: Dict[str, dict] = {}
+    for state, vals in by_state.items():
+        vals.sort()
+        out[state] = {
+            "n": len(vals),
+            "p50": _pct(vals, 0.50),
+            "p95": _pct(vals, 0.95),
+            "max": vals[-1] if vals else 0.0,
+        }
+    return out
+
+
+def _runs_detail(
+    stages: List[dict], requests: List[dict], manifests: List[dict],
+    run: Optional[str] = None,
+) -> List[dict]:
+    """One entry per run_id (restricted to `run` when given): record
+    count, knobs, gate arms, execution digest (from the newest manifest
+    carrying one — a process stamps a manifest per dump, and the latest
+    reflects its final arm map)."""
     counts: Dict[str, int] = {}
     for rec in stages:
         rid = rec.get("run_id", "?")
         counts[rid] = counts.get(rid, 0) + 1
-    knobs_by_run = {m.get("run_id"): m.get("knobs", {}) for m in manifests}
-    lines = []
+    for rec in requests:
+        # request records count too: a service run whose stage spans
+        # were dropped/drained before a dump still HAS data
+        rid = rec.get("run_id", "?")
+        counts[rid] = counts.get(rid, 0) + 1
+    if run:
+        counts = {rid: n for rid, n in counts.items() if rid == run}
+    man_by_run: Dict[str, dict] = {}
+    for m in manifests:  # later manifests win (file order = append order)
+        man_by_run[m.get("run_id")] = m
+    out = []
     for rid, n in sorted(counts.items()):
-        k = knobs_by_run.get(rid, {})
+        m = man_by_run.get(rid, {})
+        out.append(
+            {
+                "run_id": rid,
+                "records": n,
+                "knobs": m.get("knobs", {}),
+                "gates": m.get("gates", {}),
+                "execution_digest": m.get("execution_digest"),
+                "tpu_probe": m.get("tpu_probe"),
+            }
+        )
+    return out
+
+
+def _runs_summary(runs: List[dict]) -> str:
+    """Text render of _runs_detail — ONE aggregation behind both views,
+    so the text and --json listings can never disagree about which runs
+    exist or what their digests are."""
+    lines = []
+    for r in runs:
+        k = r["knobs"]
         arms = " ".join(
             f"{name}={k[name]}" for name in ("msm_glv", "msm_batch_affine", "msm_overlap") if name in k
         )
-        lines.append(f"{rid}: {n} records  {arms}")
+        if r["execution_digest"]:
+            arms = f"digest={r['execution_digest']}  {arms}"
+        lines.append(f"{r['run_id']}: {r['records']} records  {arms}")
     return "\n".join(lines) or "(no run_ids found)"
 
 
@@ -224,18 +283,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--diff", nargs=2, metavar=("A", "B"),
         help="two run_ids (single input) or ignored-with-two-files A/B p50 diff",
     )
+    ap.add_argument("--json", action="store_true", help="machine output (stages/requests/runs + digests)")
     args = ap.parse_args(argv)
 
     if args.diff and len(args.files) == 2:
         # file-vs-file diff: --diff labels the columns
         sa, _, _ = load_records([args.files[0]])
         sb, _, _ = load_records([args.files[1]])
-        print(render_diff(aggregate(sa), aggregate(sb), args.diff[0], args.diff[1]))
+        if args.json:
+            print(json.dumps({"a": aggregate(sa), "b": aggregate(sb)}))
+        else:
+            print(render_diff(aggregate(sa), aggregate(sb), args.diff[0], args.diff[1]))
         return 0
 
     stages, requests, manifests = load_records(args.files)
     if args.runs:
-        print(_runs_summary(stages, manifests))
+        runs = _runs_detail(stages, requests, manifests, run=args.run)
+        if args.json:
+            print(json.dumps({"runs": runs}))
+        else:
+            print(_runs_summary(runs))
         return 0
     if args.diff:
         agg_a = aggregate(stages, run=args.diff[0])
@@ -243,9 +310,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not agg_a or not agg_b:
             print(f"no records for run_id {args.diff[0] if not agg_a else args.diff[1]}", file=sys.stderr)
             return 1
-        print(render_diff(agg_a, agg_b, args.diff[0], args.diff[1]))
+        if args.json:
+            print(json.dumps({"a": agg_a, "b": agg_b}))
+        else:
+            print(render_diff(agg_a, agg_b, args.diff[0], args.diff[1]))
         return 0
     agg = aggregate(stages, run=args.run)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "stages": agg,
+                    "requests": _aggregate_requests(requests, run=args.run),
+                    "runs": _runs_detail(stages, requests, manifests, run=args.run),
+                }
+            )
+        )
+        return 0
     print(render_tree(agg) if args.tree else render_table(agg))
     req_view = render_requests(requests, run=args.run)
     if req_view:

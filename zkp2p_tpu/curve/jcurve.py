@@ -64,8 +64,13 @@ class JCurve:
         under jit).  TPU only: on other backends the kernels would run in
         interpret mode, which is orders of magnitude slower than the XLA
         path (the differential tests call the kernels directly with
-        interpret=True instead)."""
-        return CURVE_IMPL in ("pallas", "auto") and _on_tpu()
+        interpret=True instead).  Reports its arm to the execution audit
+        (trace-time record: the arm is baked into the executable)."""
+        from ..utils.audit import record_arm
+
+        v = CURVE_IMPL in ("pallas", "auto") and _on_tpu()
+        record_arm("curve_kernel", "pallas" if v else "xla")
+        return v
 
     # ------------------------------------------------------------ helpers
 

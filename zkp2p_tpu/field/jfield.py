@@ -119,10 +119,15 @@ FIELD_MUL_IMPL = _load_config().field_mul
 def field_mul_impl() -> str:
     """The RESOLVED field-mul implementation ("pallas" or "xla") — the
     one place the "auto" rule lives (mirror of JCurve._pallas; used by
-    JPrimeField.mul and by tools that label A/B arms)."""
-    if FIELD_MUL_IMPL == "pallas" or (FIELD_MUL_IMPL == "auto" and _on_tpu()):
-        return "pallas"
-    return "xla"
+    JPrimeField.mul and by tools that label A/B arms).  Reports its arm
+    to the execution audit at every consultation (trace-time: the arm is
+    baked into the compiled executable, so the record marks the trace
+    that chose it)."""
+    from ..utils.audit import record_arm
+
+    impl = "pallas" if (FIELD_MUL_IMPL == "pallas" or (FIELD_MUL_IMPL == "auto" and _on_tpu())) else "xla"
+    record_arm("field_mul", impl)
+    return impl
 
 
 def _mul_wide_limb_major(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

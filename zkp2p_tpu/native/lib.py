@@ -126,6 +126,19 @@ def stats_snapshot() -> Optional[dict]:
     return {name: int(buf[i]) for i, name in enumerate(STATS_FIELDS)}
 
 
+def ifma_available() -> bool:
+    """True when the loaded native lib's AVX512-IFMA 52-bit tier is
+    usable (hardware present AND not disabled via ZKP2P_NATIVE_IFMA —
+    `zkp2p_ifma_available` applies the C runtime's own gate, so this
+    mirrors exactly the arm the drivers will take).  False when the lib
+    is unavailable."""
+    lib = get_lib()
+    try:
+        return bool(lib is not None and lib.zkp2p_ifma_available())
+    except Exception:  # noqa: BLE001 — a stale pre-IFMA .so must not crash callers
+        return False
+
+
 def stats_reset() -> bool:
     """Zero the native counter block; False if the lib is unavailable
     (or predates the stats block — see stats_snapshot)."""

@@ -434,6 +434,68 @@ def cmd_serve(args):
         srv.shutdown()
 
 
+def cmd_doctor(args):
+    """Execution-path preflight: probe the backend, arm EVERY gate
+    through its real resolver, and report which arm each one took —
+    the tool that would have caught the round-2 silent disarm (plugin
+    renamed, every `default_backend()=="tpu"` gate quietly off) in one
+    run instead of a burned 50-minute tunnel window.
+
+    Machine output (`--json`) is one JSON object on stdout: backend,
+    structured tpu_probe, gate→arm map, knobs+provenance, warnings,
+    device memory (when the backend exposes it) and the execution
+    digest — the comparison key two runs must share before their
+    numbers are comparable."""
+    import json as _json
+
+    from ..utils.audit import preflight
+
+    # log=None: the text mode below prints rep["warnings"] itself —
+    # letting preflight log them too would show every mis-arm twice
+    rep = preflight(probe=not args.no_probe, workload=not args.no_workload)
+    if args.json:
+        print(_json.dumps(rep))
+    else:
+        probe = rep["tpu_probe"]
+        if probe.get("skipped"):
+            probe_s = "skipped"
+        elif probe.get("ok"):
+            probe_s = f"ok ({probe['seconds']}s, platform={probe.get('platform')})"
+        elif probe.get("timed_out"):
+            probe_s = f"TIMED OUT after {probe.get('timeout_s')}s (tunnel wedged?)"
+        else:
+            probe_s = f"down (rc={probe.get('rc')}, {probe.get('seconds')}s)"
+        _log(f"backend: {rep['backend']}   tpu probe: {probe_s}")
+        prov = rep["provenance"]
+        gate_knob = {  # gate -> the knob that steers it, for the listing
+            "field_mul": "field_mul", "curve_kernel": "curve_kernel",
+            "msm_unified": "msm_unified", "msm_affine": "msm_affine",
+            "msm_h": "msm_h", "msm_glv": "msm_glv", "batch_chunk": "batch_chunk",
+            "native_msm_glv": "msm_glv", "native_batch_affine": "msm_batch_affine",
+            "native_tier": "native_ifma",
+        }
+        _log("gates:")
+        for gate, arm in sorted(rep["gates"].items()):
+            src = f"  [{gate_knob[gate]}:{prov.get(gate_knob[gate])}]" if gate in gate_knob else ""
+            _log(f"  {gate:<22} = {arm}{src}")
+        if rep.get("workload_s") is not None:
+            _log(f"workload: tiny jit ran in {rep['workload_s']}s")
+        mem = rep.get("device_memory")
+        if mem:
+            _log(
+                f"device memory: {mem['bytes_in_use']/2**30:.2f} GiB in use, "
+                f"peak {mem['peak_bytes_in_use']/2**30:.2f} GiB"
+                + (f" of {mem['bytes_limit']/2**30:.2f} GiB" if mem.get("bytes_limit") else "")
+            )
+        _log(f"execution digest: {rep['execution_digest']}")
+        for w in rep["warnings"]:
+            _log(f"WARNING: {w}")
+        if not rep["warnings"]:
+            _log("no mis-armed gates detected")
+    if args.strict and rep["warnings"]:
+        sys.exit(1)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("zkp2p-tpu", description=__doc__)
     ap.add_argument("--build-dir", default=os.environ.get("BUILD_DIR", "build"))
@@ -500,6 +562,13 @@ def main(argv=None):
     s.add_argument("--beacon-hash", default="", help="public beacon value, hex (beacon)")
     s.add_argument("--iter-exp", type=int, default=10, help="beacon hash iterations = 2^n (beacon)")
     s.set_defaults(fn=cmd_ceremony)
+
+    s = sub.add_parser("doctor", help="execution-path preflight: arm every gate, report arms + digest")
+    s.add_argument("--json", action="store_true", help="machine-readable report on stdout")
+    s.add_argument("--no-probe", action="store_true", help="skip the subprocess TPU probe")
+    s.add_argument("--no-workload", action="store_true", help="skip the tiny jitted workload")
+    s.add_argument("--strict", action="store_true", help="exit 1 when any gate is mis-armed")
+    s.set_defaults(fn=cmd_doctor)
 
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")
     s.add_argument("--indir", required=True)

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from ..formats.proof_json import dump
+from ..utils.audit import execution_digest, preflight, sample_device_memory
 from ..utils.metrics import REGISTRY, JsonlSink, maybe_start_metrics_server, publish_native_stats, run_id, run_manifest
 from ..utils.trace import drain as drain_trace, set_context, trace
 
@@ -131,9 +132,23 @@ class ProvingService:
                 "state": state,
                 "ms": round((time.time() - req.t_claim) * 1e3, 3) if req.t_claim else None,
                 "knobs": knobs,
+                # which code paths this process has exercised (the audit
+                # gate→arm map hash): two requests are comparable only
+                # when their digests match — see docs/OBSERVABILITY.md
+                "execution_digest": execution_digest(),
             }
             if req.error:
                 rec["error"] = req.error[:500]
+            # flight recorder: HBM watermark at terminal time.  NOTE
+            # peak_bytes_in_use is the PROCESS-lifetime high-water mark
+            # (PJRT exposes no per-interval peak/reset), so the first
+            # record whose peak jumps names the request class that
+            # raised the ceiling; in_use is the live point sample.
+            # Absent on stats-less backends (XLA:CPU).
+            mem = sample_device_memory("service/request")
+            if mem is not None:
+                rec["hbm_peak_bytes"] = mem["peak_bytes_in_use"]
+                rec["hbm_bytes_in_use"] = mem["bytes_in_use"]
             self._sink(spool).write(rec)
         except Exception:  # noqa: BLE001 — observation must never fail a prove
             pass
@@ -429,6 +444,25 @@ class ProvingService:
         # scrape sees stage histograms, request-state counters, and a
         # scrape-time native counter refresh.
         maybe_start_metrics_server()
+        # Preflight (execution audit): arm every gate, warn LOUDLY when
+        # an expected arm failed to arm (pallas requested on a CPU
+        # backend, bucket-h without signed digits...) — the round-5
+        # silent-disarm class of failure must announce itself before the
+        # first request is claimed, not after a burned tunnel window.
+        try:
+            import sys
+
+            rep = preflight(
+                probe=False, workload=False,
+                log=lambda m: print(f"[service] {m}", file=sys.stderr, flush=True),
+            )
+            print(
+                f"[service] preflight: backend={rep['backend']} "
+                f"execution_digest={rep['execution_digest']}",
+                flush=True,
+            )
+        except Exception:  # noqa: BLE001 — observation must never stop the service
+            pass
         sweeps = 0
         while max_sweeps is None or sweeps < max_sweeps:
             stats = self.process_dir(spool)

@@ -77,6 +77,7 @@ from ..ops.ntt import coset_shift, intt, ntt
 # MSM_H: "windowed" or "bucket" (ops.msm_bucket sorted-prefix
 #   Pippenger) — hardware-gated like MSM_AFFINE.
 from ..utils.jaxcfg import on_tpu as _on_tpu
+from ..utils.audit import record_arm as _record_arm
 from ..utils.config import load_config as _load_config
 
 _CFG = _load_config()
@@ -92,22 +93,24 @@ H_BUCKET_WINDOW = 16
 from ..snark.groth16 import Proof, ProvingKey, coset_gen, domain_size_for, qap_rows
 from ..snark.r1cs import ConstraintSystem
 def _unified() -> bool:
-    return MSM_UNIFIED == "1" or (MSM_UNIFIED == "auto" and _on_tpu())
+    return _record_arm("msm_unified", MSM_UNIFIED == "1" or (MSM_UNIFIED == "auto" and _on_tpu()))
 
 
 def _affine() -> bool:
-    return MSM_AFFINE == "1" or (MSM_AFFINE == "auto" and _on_tpu())
+    return _record_arm("msm_affine", MSM_AFFINE == "1" or (MSM_AFFINE == "auto" and _on_tpu()))
 
 
 def _h_bucket() -> bool:
-    return MSM_SIGNED and (MSM_H == "bucket" or (MSM_H == "auto" and _on_tpu()))
+    v = MSM_SIGNED and (MSM_H == "bucket" or (MSM_H == "auto" and _on_tpu()))
+    _record_arm("msm_h", "bucket" if v else "windowed")
+    return v
 
 
 def _glv() -> bool:
     """GLV endomorphism decomposition for the G1 MSMs (ZKP2P_MSM_GLV).
     Rides the signed-digit machinery, so MSM_SIGNED off disables it —
     the unsigned path stays the byte-stable fallback."""
-    return MSM_GLV and MSM_SIGNED
+    return _record_arm("msm_glv", MSM_GLV and MSM_SIGNED)
 
 
 @dataclass
@@ -791,6 +794,7 @@ def prove_tpu(
     r: Optional[int] = None,
     s: Optional[int] = None,
 ) -> Proof:
+    from ..utils.audit import sample_device_memory
     from ..utils.metrics import REGISTRY
     from ..utils.trace import trace
 
@@ -799,11 +803,13 @@ def prove_tpu(
     if s is None:
         s = 1 + secrets.randbelow(R - 1)
     with trace("tpu/prove"):
+        sample_device_memory("tpu/prove")  # entry watermark (flight recorder)
         _check_inferred_widths(dpk, witness, w_std=witness if _is_u64_witness(witness) else None)
         acc = _prove_device(dpk, witness_to_device(witness))
         a, b1, c, hq = (g1_jac_to_host(p)[0] for p in (acc[0], acc[1], acc[3], acc[4]))
         b2 = g2_jac_to_host(acc[2])[0]
         proof = _assemble(dpk, (a, b1, b2, c, hq), r, s)
+        sample_device_memory("tpu/prove")  # exit watermark: per-prove HBM peak
     REGISTRY.counter("zkp2p_proves_total", {"prover": "tpu"}).inc()
     return proof
 
@@ -858,6 +864,7 @@ def prove_tpu_sharded(
     progress, when given, is called with a short string after each
     device stage (the dryrun's per-stage timestamps)."""
     from ..parallel.mesh import msm_sharded, pad_to_multiple
+    from ..utils.trace import trace
 
     if r is None:
         r = 1 + secrets.randbelow(R - 1)
@@ -871,12 +878,20 @@ def prove_tpu_sharded(
             arr.block_until_ready()
             progress(msg)
 
+    # Stage spans feed the same trace/metrics rails as the single-chip
+    # provers, so a MULTICHIP dryrun dumped to a sink is diffable with
+    # trace_report like any bench run.  With a progress callback each
+    # span brackets block_until_ready (true stage time); without one
+    # dispatch is async and spans measure enqueue latency only.
     n_dev = mesh.shape[axis]
-    w_mont = witness_to_device(witness)
-    h = h_evals_sharded(dpk, w_mont, mesh, axis)
-    note(h, "h_evals_sharded")
-    w_planes = digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW)
-    h_planes = digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW)
+    with trace("sharded/witness"):
+        w_mont = witness_to_device(witness)
+    with trace("sharded/h_evals"):
+        h = h_evals_sharded(dpk, w_mont, mesh, axis)
+        note(h, "h_evals_sharded")
+    with trace("sharded/planes"):
+        w_planes = digit_planes_from_limbs(FR.from_mont(w_mont), MSM_WINDOW)
+        h_planes = digit_planes_from_limbs(FR.from_mont(h), MSM_WINDOW)
     if unified:
         # One executable for ALL FOUR G1 MSMs needs identical input
         # LAYOUTS, not just shapes: h_planes inherits the NTT's shard-axis
@@ -909,9 +924,10 @@ def prove_tpu_sharded(
         # curve type), so it always keeps its minimal padded size — its
         # per-point cost is ~3x G1's.
         chunk = g1_chunk if curve is G1J else base_chunk
-        b, p = pad_to_multiple(bases, planes, chunk)
-        acc = msm_sharded(curve, b, p, mesh, axis=axis, lanes=lanes, window=MSM_WINDOW)
-        note(acc[0], f"msm {tag} ({b[0].shape[0]} bases)")
+        with trace(f"sharded/msm_{tag}"):
+            b, p = pad_to_multiple(bases, planes, chunk)
+            acc = msm_sharded(curve, b, p, mesh, axis=axis, lanes=lanes, window=MSM_WINDOW)
+            note(acc[0], f"msm {tag} ({b[0].shape[0]} bases)")
         return acc
 
     b_planes = jnp.take(w_planes, dpk.b_sel, axis=-1)
@@ -935,13 +951,17 @@ def _batch_chunk_size() -> int:
     under ~7 GB while reusing ONE compiled executable across chunks."""
     auto = 4 if _on_tpu() else 0
     if BATCH_CHUNK == "auto":
-        return auto
-    try:
-        return max(0, int(BATCH_CHUNK))
-    except ValueError:
-        # a malformed knob must not silently select the unchunked (OOM-
-        # prone) behavior the knob exists to prevent — keep the auto rule
-        return auto
+        v = auto
+    else:
+        try:
+            v = max(0, int(BATCH_CHUNK))
+        except ValueError:
+            # a malformed knob must not silently select the unchunked
+            # (OOM-prone) behavior the knob exists to prevent — keep the
+            # auto rule
+            v = auto
+    _record_arm("batch_chunk", str(v))
+    return v
 
 
 def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -> List[Proof]:
@@ -952,10 +972,12 @@ def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -
     the last chunk pads by repeating its final witness) so device memory
     is bounded by the chunk, not the batch, and every chunk reuses the
     same compiled executable."""
+    from ..utils.audit import sample_device_memory
     from ..utils.metrics import REGISTRY
     from ..utils.trace import trace
 
     with trace("tpu/prove_batch", n=len(witnesses)):
+        sample_device_memory("tpu/prove_batch")  # entry watermark
         for wit in witnesses:
             _check_inferred_widths(dpk, wit, w_std=wit if _is_u64_witness(wit) else None)
         n = len(witnesses)
@@ -970,6 +992,11 @@ def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -
             # one batched to_mont per chunk (not one device dispatch per witness)
             w = FR.to_mont(jnp.asarray(np.stack([_witness_std_limbs(wit) for wit in span])))
             parts.append(_prove_device(dpk, w, batched=True))
+            # sub-chunk HBM watermark: the batched pipeline's peak is
+            # linear in the vmapped chunk (r5: 15.75 G OOM at batch=16
+            # with no telemetry) — sample per chunk so the staircase is
+            # on record BEFORE the allocator walks off the top
+            sample_device_memory("tpu/prove_batch_chunk")
         accs = (
             parts[0]
             if len(parts) == 1
@@ -981,5 +1008,6 @@ def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -
             _assemble(dpk, (a[i], b1[i], b2[i], c[i], hq[i]), 1 + secrets.randbelow(R - 1), 1 + secrets.randbelow(R - 1))
             for i in range(len(witnesses))
         ]
+        sample_device_memory("tpu/prove_batch")  # exit watermark: batch HBM peak
     REGISTRY.counter("zkp2p_proves_total", {"prover": "tpu"}).inc(len(witnesses))
     return proofs

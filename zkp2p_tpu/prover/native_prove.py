@@ -185,15 +185,30 @@ def _g1_bases_glv_u64(bases) -> np.ndarray:
 
 
 def _use_glv() -> bool:
+    from ..utils.audit import record_arm
     from ..utils.config import load_config
 
-    return load_config().msm_glv
+    return record_arm("native_msm_glv", load_config().msm_glv)
 
 
 def _use_batch_affine() -> bool:
+    from ..utils.audit import record_arm
     from ..utils.config import load_config
 
-    return load_config().msm_batch_affine
+    return record_arm("native_batch_affine", load_config().msm_batch_affine)
+
+
+def _native_ifma_tier() -> bool:
+    """The 52-bit AVX512-IFMA batch-affine tier gate for G1 windows —
+    the native mirror of the device prover's impl gates, reported to the
+    execution audit per consultation (one per MSM via _pick_window).
+    False routes through the scalar Montgomery tier."""
+    from ..native.lib import ifma_available
+    from ..utils.audit import record_arm
+
+    v = _use_batch_affine() and ifma_available()
+    record_arm("native_tier", "ifma" if v else "scalar")
+    return v
 
 
 def _g2_bases_u64(bases) -> np.ndarray:
@@ -226,12 +241,7 @@ def _pick_window(n: int, g2: bool = False, threads: int = 1) -> int:
     purely from doubled batch-affine conflicts; the raised clamp lets
     the big domains reach c=17 while the bench shape keeps its
     measured-best c=15 (signed sweep at 2^19: c=15 6.3s, c=16 7.6s)."""
-    if (
-        not g2
-        and _use_batch_affine()  # jac-fill arm: wide-window curve n/a
-        and _lib() is not None
-        and _lib().zkp2p_ifma_available()
-    ):
+    if not g2 and _native_ifma_tier():  # batch-affine off: wide-window curve n/a
         # IFMA regime (G1 only) with the 8-lane vector suffix (csrc
         # g1_suffix8): the serial per-window reduction that clamped the
         # r5 sweep at c=14 is vectorized across windows, so wider
@@ -271,7 +281,7 @@ def _pick_window_glv(n: int, threads: int = 1) -> int:
     Multi-threaded keeps the same c=14 serial-suffix clamp as the plain
     curve (the vector suffix is gated off there)."""
     bl = (2 * n).bit_length()
-    if _use_batch_affine() and _lib() is not None and _lib().zkp2p_ifma_available():
+    if _native_ifma_tier():
         if bl >= 20:
             c = 15
         elif bl >= 14:

@@ -1,0 +1,385 @@
+"""Execution-path audit + device flight recorder.
+
+Round 5's two most expensive findings were not slowness but
+*invisibility*: every `default_backend() == "tpu"` fast-path gate had
+been silently disarmed on-chip since round 2 (the PJRT plugin renamed
+itself "axon"), and the batched prover OOM'd 15.75 G of HBM with no
+memory telemetry at all.  PR 3's metrics layer records how LONG stages
+took but not WHICH ARM executed — this module closes that blind spot:
+
+  1. **Arm recording** (`record_arm`): every backend/impl gate —
+     `jaxcfg.on_tpu`, the prover's `_unified`/`_affine`/`_h_bucket`/
+     `_glv`, the pallas-vs-XLA field mul and curve kernel, the native
+     GLV / batch-affine / IFMA-vs-scalar tiers — reports `(gate, arm)`
+     at its call site into `zkp2p_path_taken{gate,arm}` counters and a
+     process-wide gate→arm map.
+
+  2. **Execution digest** (`execution_digest`): a stable hash of the
+     sorted gate→arm map, stamped into the run manifest, every BENCH
+     JSON and every service request record — two runs whose digests
+     match are PROVEN to have exercised identical code paths before
+     their numbers are compared; a silently-disarmed run is one digest
+     diff away from being caught.
+
+  3. **Flight recorder**: HBM watermarks via `device.memory_stats()`
+     (`sample_device_memory`, gauges + per-request peak — the next OOM
+     is predicted, not discovered) and jit compile events (count +
+     seconds per trace stage via `jax.monitoring`; this box has
+     measured 20-minute XLA:CPU prover compiles).
+
+  4. **Preflight** (`preflight`): arm every gate, collect mis-arm
+     warnings ("pallas requested but interpreting on CPU"), and emit a
+     machine-readable report — the payload behind `zkp2p-tpu doctor`
+     and the bench/service startup hooks.
+
+Design constraints match utils.metrics: stdlib-only at import,
+observation must never fail the prove around it, and the hot-path cost
+(record_arm) is two dict operations + one counter add — measured on the
+native prove path as noise (see docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .metrics import REGISTRY
+
+# gate -> latest arm string (GIL-atomic dict writes; cumulative per
+# process, so a gate consulted only at jit-trace time keeps its arm in
+# the digest across later proves that reuse the compiled executable).
+_arms: Dict[str, str] = {}
+
+# (gate, arm) -> Counter, cached so the registry lock is only taken on
+# first sight of an arm; generation-keyed like trace._stage_hists so a
+# REGISTRY.reset() never feeds an orphaned instrument.
+_counters: Dict[Any, Any] = {}
+_counters_gen = -1
+
+
+def _arm_str(arm) -> str:
+    if isinstance(arm, bool):
+        return "on" if arm else "off"
+    return str(arm)
+
+
+def record_arm(gate: str, arm):
+    """Report that `gate` resolved to `arm` at its call site.
+
+    Returns `arm` unchanged so gate resolvers can
+    `return record_arm("msm_glv", value)`.  Cost: two dict ops + a
+    float add — cheap enough for resolvers consulted per-MSM or at
+    jit-trace time (thousands of calls per trace)."""
+    global _counters_gen
+    s = _arm_str(arm)
+    _arms[gate] = s
+    if REGISTRY.generation != _counters_gen:
+        _counters.clear()
+        _counters_gen = REGISTRY.generation
+    key = (gate, s)
+    c = _counters.get(key)
+    if c is None:
+        c = _counters[key] = REGISTRY.counter("zkp2p_path_taken", {"gate": gate, "arm": s})
+    c.inc()
+    return arm
+
+
+def gate_arms() -> Dict[str, str]:
+    """Snapshot of the gate→arm map observed so far this process."""
+    return dict(_arms)
+
+
+def execution_digest(arms: Optional[Dict[str, str]] = None) -> str:
+    """Stable 16-hex-char digest of the (sorted) gate→arm map.  Two
+    processes that resolved every gate to the same arm produce the same
+    digest regardless of resolution order; one flipped arm changes it."""
+    if arms is None:
+        arms = _arms
+    blob = json.dumps(sorted(arms.items()), separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def reset() -> None:
+    """Clear the gate→arm map (tests)."""
+    _arms.clear()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder, part 1: HBM watermarks.  `device.memory_stats()` is
+# a cheap C call on TPU and None on CPU — the device list is probed once
+# and a stats-less backend degrades to a no-op list scan per sample.
+
+_mem_devices: Optional[list] = None
+_peak_lock = threading.Lock()
+
+
+def _memory_devices() -> list:
+    global _mem_devices
+    if _mem_devices is None:
+        try:
+            import jax
+
+            devs = []
+            for d in jax.devices():
+                try:
+                    if d.memory_stats():
+                        devs.append(d)
+                except Exception:  # noqa: BLE001 — stats are optional per PJRT backend
+                    pass
+            _mem_devices = devs
+        except Exception:  # noqa: BLE001 — no backend at all
+            _mem_devices = []
+    return _mem_devices
+
+
+def sample_device_memory(stage: str = "") -> Optional[Dict]:
+    """Sample per-device HBM watermarks into gauges; returns the
+    highest-use device's `{device, bytes_in_use, peak_bytes_in_use,
+    bytes_limit}` (None when no device exposes memory stats — XLA:CPU).
+
+    Call sites bracket prove/batch/sub-chunk boundaries so the
+    `zkp2p_hbm_*` gauges track the allocation staircase a batched prove
+    climbs; `stage` additionally keeps a max-semantics per-stage peak
+    (`zkp2p_hbm_stage_peak_bytes{stage=...}`)."""
+    best = None
+    for i, d in enumerate(_memory_devices()):
+        try:
+            st = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — observation only
+            continue
+        used = int(st.get("bytes_in_use", 0))
+        peak = int(st.get("peak_bytes_in_use", used))
+        limit = int(st.get("bytes_limit", 0))
+        lab = {"device": str(i)}
+        REGISTRY.gauge("zkp2p_hbm_bytes_in_use", lab).set(used)
+        REGISTRY.gauge("zkp2p_hbm_peak_bytes", lab).set(peak)
+        if limit:
+            REGISTRY.gauge("zkp2p_hbm_bytes_limit", lab).set(limit)
+        if best is None or used > best["bytes_in_use"]:
+            best = {
+                "device": i,
+                "bytes_in_use": used,
+                "peak_bytes_in_use": peak,
+                "bytes_limit": limit,
+            }
+    if best is not None and stage:
+        g = REGISTRY.gauge("zkp2p_hbm_stage_peak_bytes", {"stage": stage})
+        # locked max-update: a bare read-then-set from two concurrent
+        # samplers of one stage label could regress the recorded peak
+        with _peak_lock:
+            g.set(max(g.value, best["peak_bytes_in_use"]))
+    return best
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder, part 2: compile events.  jax.monitoring publishes
+# '/jax/core/compile/backend_compile_duration' per XLA compile; the
+# listener attributes each to the calling thread's CURRENT trace stage
+# (compiles run synchronously inside the first dispatch), so a 20-minute
+# cold prover compile shows up as compile seconds under its stage
+# instead of silently inflating the stage's own latency histogram.
+
+_compile_installed = False
+
+
+def install_compile_listener() -> bool:
+    """Idempotently register the jit-compile event listener; False when
+    the jax.monitoring API is unavailable."""
+    global _compile_installed
+    if _compile_installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception:  # noqa: BLE001 — jax absent or too old
+        return False
+
+    from .trace import current_stack
+
+    def _on_event(name: str, secs: float, **_kw) -> None:
+        if not name.endswith("backend_compile_duration"):
+            return
+        try:
+            stack = current_stack()
+            stage = "/".join(stack) if stack else "(none)"
+            REGISTRY.counter("zkp2p_compile_events_total", {"stage": stage}).inc()
+            REGISTRY.counter("zkp2p_compile_seconds_total", {"stage": stage}).inc(secs)
+        except Exception:  # noqa: BLE001 — observation must never fail a compile
+            pass
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception:  # noqa: BLE001
+        return False
+    _compile_installed = True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Preflight: the doctor payload.  Arms every gate by calling the real
+# resolvers (the same functions the provers consult — no parallel
+# reimplementation that could drift), collects mis-arm warnings, and
+# returns a machine-readable report.
+
+
+def _mis_arm_warnings(cfg, backend: str, arms: Dict[str, str], native_ok: bool) -> List[str]:
+    """Config-vs-resolution contradictions: an operator asked for an arm
+    the gates did not (or could not) take.  Expected degradations (auto
+    gates off on a host backend) are NOT warnings."""
+    w: List[str] = []
+    tpu = arms.get("on_tpu") == "tpu"
+    if arms.get("field_mul") == "pallas" and not tpu:
+        w.append(
+            f"field_mul resolved to the pallas kernel on backend={backend}: pallas "
+            "runs in INTERPRET mode off-TPU (orders of magnitude slower) — unset "
+            "ZKP2P_FIELD_MUL or run on a TPU"
+        )
+    if cfg.curve_kernel == "pallas" and arms.get("curve_kernel") != "pallas":
+        w.append(
+            f"curve_kernel=pallas requested but the gate did not arm (backend={backend} "
+            "is not a TPU): running the XLA curve path"
+        )
+    # NOTE the device-prover gates read IMPORT-TIME knob snapshots (jit
+    # identities depend on them) while cfg re-reads the env — so these
+    # two warnings also catch a knob exported AFTER prover import, which
+    # silently has no effect on the device prover (the native prover
+    # re-reads the config and may still arm).
+    if cfg.msm_h == "bucket" and arms.get("msm_h") != "bucket":
+        w.append(
+            "msm_h=bucket requested but the device-prover gate did not arm "
+            "(msm_signed off, or ZKP2P_MSM_H was set after prover import — module "
+            "constants snapshot at import): running the windowed h MSM"
+        )
+    if cfg.msm_glv and arms.get("msm_glv") == "off":
+        w.append(
+            "msm_glv requested but the device-prover gate did not arm "
+            "(msm_signed off, or ZKP2P_MSM_GLV was set after prover import — module "
+            "constants snapshot at import): unsigned digit planes on the device "
+            "prover; the native prover re-reads the env and may still arm"
+        )
+    if not native_ok:
+        w.append(
+            "native library unavailable (csrc toolchain/build failed?): native prover "
+            "gates report 'unavailable'"
+        )
+    elif (
+        cfg.native_ifma
+        and cfg.provenance.get("native_ifma") != "default"
+        and arms.get("native_tier") == "scalar"
+    ):
+        # only when EXPLICITLY requested (env): the default-True knob on
+        # a non-IFMA host is an expected degradation, not a mis-arm —
+        # warning there would fail a --strict doctor gate on every
+        # healthy machine nobody configured for IFMA
+        w.append(
+            "native_ifma explicitly enabled but the 52-bit IFMA tier did not arm "
+            "(CPU lacks AVX512-IFMA, or msm_batch_affine=0 gates it off): scalar tier"
+        )
+    return w
+
+
+def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) -> Dict:
+    """Arm every gate, sample the backend, and return the preflight
+    report (the `zkp2p-tpu doctor` payload; also hooked into bench.py
+    and ProvingService.run so a mis-armed run warns before it burns a
+    tunnel window).
+
+    probe: run the subprocess TPU probe (jaxcfg.tpu_probe — seconds of
+    wall time; off for in-process hooks whose caller already probed).
+    workload: run one tiny jitted op so the backend is proven to
+    execute and the compile listener ticks (skipped by lightweight
+    startup hooks).
+    cfg: a pre-resolved ProverConfig — pass it when the caller has
+    already run cfg.apply_env() (bench's TPU tier): apply_env writes
+    every knob back into the env, so a fresh load here would see every
+    provenance as "env" and the explicit-request-only warning gates
+    would fire on defaults."""
+    from .config import load_config
+    from .jaxcfg import last_probe, on_tpu, tpu_probe
+    from .metrics import run_id
+    from .trace import trace
+
+    install_compile_listener()
+    if cfg is None:
+        cfg = load_config()
+    report: Dict = {"type": "doctor", "ts": round(time.time(), 3), "run_id": run_id()}
+
+    if probe:
+        report["tpu_probe"] = tpu_probe()
+    else:
+        report["tpu_probe"] = last_probe() or {"skipped": True}
+
+    backend = "unavailable"
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception as e:  # noqa: BLE001 — report it, don't die
+        report["backend_error"] = str(e)
+    report["backend"] = backend
+
+    # Arm every gate through its REAL resolver (each records itself).
+    on_tpu()
+    from ..curve.jcurve import G1J
+    from ..field.jfield import field_mul_impl
+    from ..prover.groth16_tpu import _affine, _batch_chunk_size, _glv, _h_bucket, _unified
+
+    field_mul_impl()
+    G1J._pallas()
+    _unified()
+    _affine()
+    _h_bucket()
+    _glv()
+    _batch_chunk_size()
+
+    from ..native.lib import get_lib
+    from ..prover.native_prove import _use_batch_affine, _use_glv
+
+    _use_glv()
+    _use_batch_affine()
+    native_ok = False
+    try:
+        native_ok = get_lib() is not None
+    except Exception:  # noqa: BLE001 — a broken toolchain is a finding, not a crash
+        pass
+    if native_ok:
+        from ..prover.native_prove import _native_ifma_tier
+
+        _native_ifma_tier()
+    else:
+        record_arm("native_tier", "unavailable")
+
+    if workload and backend != "unavailable":
+        # one tiny jitted op: proves the backend executes and ticks the
+        # compile listener.  Deliberately NOT a gated field mul — a
+        # forced-pallas arm on a host backend would drag the doctor
+        # through an interpret-mode compile; the warning below covers it.
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        with trace("doctor/workload"):
+            jax.jit(lambda x: x * 2 + 1)(jnp.arange(8)).block_until_ready()
+        report["workload_s"] = round(time.perf_counter() - t0, 3)
+
+    from .metrics import serialize_knobs
+
+    arms = gate_arms()
+    report["gates"] = arms
+    report["knobs"] = serialize_knobs(cfg)
+    report["provenance"] = dict(cfg.provenance)
+    report["warnings"] = _mis_arm_warnings(cfg, backend, arms, native_ok)
+    probe_rec = report["tpu_probe"]
+    if probe_rec.get("ok") and arms.get("on_tpu") != "tpu":
+        report["warnings"].append(
+            "TPU probe succeeded but the in-process backend is "
+            f"{backend}: gates armed for the fallback paths"
+        )
+    report["device_memory"] = sample_device_memory("preflight")
+    report["execution_digest"] = execution_digest()
+    if log is not None:
+        for msg in report["warnings"]:
+            log(f"PREFLIGHT WARNING: {msg}")
+    return report

@@ -70,17 +70,30 @@ def on_tpu() -> bool:
     rule tpu_probe_ok() uses, stable across plugin renames."""
     import jax
 
-    if jax.default_backend() == "tpu":
-        return True
-    try:
-        devs = jax.devices()
-    except Exception:
-        return False
-    return bool(devs) and getattr(devs[0], "platform", "") == "tpu"
+    v = jax.default_backend() == "tpu"
+    if not v:
+        try:
+            devs = jax.devices()
+            v = bool(devs) and getattr(devs[0], "platform", "") == "tpu"
+        except Exception:
+            v = False
+    # every backend gate in the tree funnels through here — the ONE
+    # record covers them all (lazy import: tools import this module
+    # before jax/numpy are safe to load)
+    from .audit import record_arm
+
+    record_arm("on_tpu", "tpu" if v else "host")
+    return v
 
 
-def tpu_probe_ok(timeout: int | None = None) -> bool:
-    """Probe the TPU in a SUBPROCESS with a timeout.
+# last structured probe outcome of this process (None = never probed):
+# stamped into the run manifest and the BENCH JSON so "TPU TUNNEL DOWN"
+# is a queryable record, not free text inside a unit string.
+_last_probe: dict | None = None
+
+
+def tpu_probe(timeout: int | None = None) -> dict:
+    """Probe the TPU in a SUBPROCESS with a timeout; structured result.
 
     The axon plugin force-selects its platform through jax.config
     (overriding JAX_PLATFORMS) and a wedged tunnel makes backend init
@@ -89,17 +102,51 @@ def tpu_probe_ok(timeout: int | None = None) -> bool:
     first and pins `jax.config.update("jax_platforms", "cpu")` when the
     probe fails.  Timeout from BENCH_TPU_PROBE_TIMEOUT (default 120 s).
     Matches on the device's platform attribute, not the repr (which has
-    changed across plugin versions)."""
+    changed across plugin versions).
+
+    Returns {"ok", "rc", "timed_out", "seconds", "platform",
+    "timeout_s"} and remembers it (`last_probe`)."""
     import subprocess
     import sys
+    import time
 
+    global _last_probe
     if timeout is None:
         timeout = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120"))
+    rec: dict = {
+        "ok": False, "rc": None, "timed_out": False,
+        "seconds": 0.0, "platform": None, "timeout_s": timeout,
+    }
+    t0 = time.perf_counter()
     try:
         probe = subprocess.run(
             [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, timeout=timeout, text=True,
         )
-        return probe.returncode == 0 and "tpu" in probe.stdout.lower()
+        rec["rc"] = probe.returncode
+        out = probe.stdout.strip()
+        rec["platform"] = out.splitlines()[-1] if out else None
+        rec["ok"] = probe.returncode == 0 and "tpu" in probe.stdout.lower()
     except subprocess.TimeoutExpired:
-        return False
+        rec["timed_out"] = True
+    rec["seconds"] = round(time.perf_counter() - t0, 3)
+    _last_probe = rec
+    return rec
+
+
+def last_probe() -> dict | None:
+    """The most recent tpu_probe() result this process (None if never)."""
+    return _last_probe
+
+
+def adopt_probe(rec: dict) -> None:
+    """Seed last_probe() from a PARENT process's probe result (the bench
+    guard probes in the parent and must not be re-run in the child — the
+    single-chip tunnel dial blocks while anyone holds the chip)."""
+    global _last_probe
+    _last_probe = dict(rec)
+
+
+def tpu_probe_ok(timeout: int | None = None) -> bool:
+    """Boolean view of tpu_probe() (the historical API)."""
+    return tpu_probe(timeout)["ok"]

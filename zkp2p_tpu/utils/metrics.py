@@ -301,23 +301,44 @@ def host_facts() -> Dict:
     }
 
 
+def serialize_knobs(cfg) -> Dict:
+    """Every knob as a JSON-able value — THE one serialization shared by
+    the run manifest and the doctor report (a divergent copy would let
+    the two disagree about knob values)."""
+    from .config import KNOBS
+
+    return {
+        attr: (v if isinstance(v, (int, float, bool, str, type(None))) else str(v))
+        for attr, v in ((a, getattr(cfg, a)) for a in KNOBS)
+    }
+
+
 def run_manifest() -> Dict:
-    """{run_id, pid, ts, host facts, every knob + provenance}."""
-    from .config import KNOBS, load_config
+    """{run_id, pid, ts, host facts, every knob + provenance, observed
+    gate arms + execution digest, last TPU probe}."""
+    from .audit import execution_digest, gate_arms
+    from .config import load_config
+    from .jaxcfg import last_probe
 
     cfg = load_config()
-    knobs = {}
-    for attr in KNOBS:
-        v = getattr(cfg, attr)
-        knobs[attr] = v if isinstance(v, (int, float, bool, str, type(None))) else str(v)
-    return {
+    knobs = serialize_knobs(cfg)
+    man = {
         "run_id": run_id(),
         "pid": os.getpid(),
         "ts": round(time.time(), 3),
         "host": host_facts(),
         "knobs": knobs,
         "provenance": dict(cfg.provenance),
+        # which arms actually executed (audit.record_arm call sites) —
+        # the digest is the comparison key: equal digests = provably
+        # identical code paths (docs/OBSERVABILITY.md §execution audit)
+        "gates": gate_arms(),
+        "execution_digest": execution_digest(),
     }
+    probe = last_probe()
+    if probe is not None:
+        man["tpu_probe"] = probe
+    return man
 
 
 def publish_native_stats(registry: Optional[Registry] = None) -> Optional[Dict]:
