@@ -211,6 +211,8 @@ def _native_fallback_bench(plat: str) -> bool:
         os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "1" if ba_on else "0"
         ov_on = cfg.msm_overlap
         os.environ["ZKP2P_MSM_OVERLAP"] = "1" if ov_on else "0"
+        mu_on = cfg.msm_multi
+        os.environ["ZKP2P_MSM_MULTI"] = "1" if mu_on else "0"
         host = _host_attribution(cfg)
         # label the MSM mode before the per-stage trace so the native
         # msm_a/b1/c/h stage times are attributable to the knob arms
@@ -218,6 +220,7 @@ def _native_fallback_bench(plat: str) -> bool:
             f"native msm mode: glv={'on' if glv_on else 'off'} "
             f"batch_affine={'on' if ba_on else 'off'} "
             f"overlap={'on' if ov_on else 'off'} "
+            f"multi={'on' if mu_on else 'off'} "
             f"threads={host['native_threads']} ifma={host['ifma']} cpu={host['cpu_model']}"
         )
         # preflight (execution audit): arm every gate and warn loudly on
@@ -268,6 +271,41 @@ def _native_fallback_bench(plat: str) -> bool:
         f"native fallback: venmo {cs.num_constraints} constraints, first={first:.1f}s "
         f"steady best={best:.1f}s p50-of-{len(steady)}={p50:.1f}s"
     )
+    # Batched arm: whole-batch proofs/s through prove_native_batch (the
+    # multi-column MSM fast path — one base sweep per G1 MSM family,
+    # batch_n scalar columns) next to the batch=1 number above.  Rides
+    # the same preflighted gates; ZKP2P_MSM_MULTI=0 measures the
+    # sequential fallback under the same label (the msm_multi field in
+    # the JSON names the arm).
+    batch_rec = {}
+    batch_n = int(os.environ.get("BENCH_NATIVE_BATCH", "4"))
+    if batch_n > 1:
+        try:
+            from zkp2p_tpu.prover.native_prove import prove_native_batch
+
+            bt = []
+            for i in range(int(os.environ.get("BENCH_NATIVE_BATCH_RUNS", "3"))):
+                with trace(f"prove_native_batch_{i + 1}", batch=batch_n):
+                    t0 = time.time()
+                    prove_native_batch(dpk, [w] * batch_n)
+                    bt.append(time.time() - t0)
+            b_best = min(bt)
+            b_p50 = sorted(bt)[(len(bt) - 1) // 2]
+            log(
+                f"native batch={batch_n}: wall best={b_best:.1f}s p50-of-{len(bt)}={b_p50:.1f}s "
+                f"-> {batch_n / b_best:.4f} proofs/s (batch=1 best {1 / best:.4f}; "
+                f"speedup {best * batch_n / b_best:.2f}x)"
+            )
+            batch_rec = {
+                "batch_value": round(batch_n / b_best, 4),
+                "batch_p50_s": round(b_p50, 3),
+                "batch_value_n": batch_n,
+            }
+        except Exception:  # noqa: BLE001 — the batch=1 record must still ship
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            log("native batch arm failed; recording batch=1 only")
     # stage trace: to the configured JSONL sink (run_id/pid-stamped, with
     # the knob/host manifest — trace_report.py aggregates or diffs it),
     # else stderr as before; the native counter snapshot rides the stderr
@@ -308,6 +346,10 @@ def _native_fallback_bench(plat: str) -> bool:
                 "msm_glv": bool(glv_on),
                 "msm_batch_affine": bool(ba_on),
                 "msm_overlap": bool(ov_on),
+                "msm_multi": bool(mu_on),
+                # the batched arm: aggregate proofs/s + per-proof p50
+                # when batch_n requests ride one multi-column prove
+                **batch_rec,
                 # host attribution: resolved thread count + CPU identity,
                 # so spread across identical reps has a suspect
                 **host,

@@ -84,6 +84,10 @@ enum StatSlot {
   ST_POOL_RUN_NS,             // task fn execution ns, summed per task
   ST_POOL_DEPTH_PEAK,         // max queued-region depth observed (gauge)
   ST_POOL_WORKERS,            // current worker-thread count (gauge)
+  ST_MSM_MULTI_CALLS,         // multi-column G1 driver entries (plain + GLV)
+  ST_MSM_MULTI_COLS,          // scalar columns summed over multi calls
+  ST_MSM_MULTI_COLS_LAST,     // S of the most recent multi call (gauge)
+  ST_MSM_MULTI_PREP_NS,       // per-column classify/ones/digit prep, summed
   ST_COUNT
 };
 static std::atomic<long long> g_stats[ST_COUNT];
@@ -4066,6 +4070,583 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
   }
 }
 
+// ===================================================================
+// Multi-column Pippenger: ONE sweep over a fixed base array fills S
+// independent bucket sets per window (bucket id = s * nbuckets + |d|),
+// so every batch-affine inversion round carries adds from ALL columns —
+// the inversion batch density rises ~S x exactly where the 52-bit and
+// scalar batch-affine tiers pay their per-round costs (the chunk
+// schedule, the one mont_inv per chunk, the SoA gather/transpose).  The
+// chunk-apply kernels (g1_chunk_apply_52, the scalar batch inversion)
+// run UNCHANGED: they address buckets through add_bkt and bases through
+// add_pt, and neither cares that the bucket space is S arrays long.
+// The amortized wins stack: the mont256 -> mont260 base conversion runs
+// once for S MSMs, every base cache line is touched once per window
+// instead of S times, and partially-filled chunks still ship full
+// inversion batches.
+//
+// A work item is (point i, column s) encoded as i*S + s, built i-outer
+// so the sweep stays base-sequential; digits come from per-column digit
+// arrays sds[s] (row-major over the shared compacted index space, with
+// all-zero rows for scalars another tier handled).  Column outputs are
+// the exact group elements of S sequential single-column MSMs — the
+// final affine canonicalization makes them byte-identical, so the
+// sequential driver stays the parity oracle.
+
+// Work-item encoding for the multi fills: (point i, column s) packed as
+// (i << sbits) | s — shift/mask decode, never a runtime division (the
+// schedule loop visits tens of millions of entries per MSM and S is not
+// a compile-time constant).
+static inline int multi_sbits(int S) {
+  int sb = 0;
+  while ((1 << sb) < S) ++sb;
+  return sb;
+}
+
+// Run fn(0..njobs-1) on the pool (width-capped) or inline — the multi
+// drivers' job runner (a job may span several output slots, unlike
+// run_window_sums' one-window-one-slot contract).
+static void run_indexed_jobs(long njobs, int n_threads,
+                             const std::function<void(long)> &fn) {
+  if (n_threads > 1 && njobs > 1) {
+    int w = (long)n_threads < njobs ? n_threads : (int)njobs;
+    work_pool().ensure(w);
+    work_pool().run(njobs, fn, w);
+  } else {
+    for (long j = 0; j < njobs; ++j) fn(j);
+  }
+}
+
+#if ZKP2P_HAVE_IFMA
+// 52-native multi-column window fill: the S-column mirror of
+// g1_window_sum_52.  bk_ext (caller-zeroed, S*nbuckets entries) defers
+// the suffix to the caller's 8-lane vector pass (lane id = wi*S + s);
+// returns true when it was filled, false when *outs was computed via a
+// fallback tier or the internal per-column suffix.
+static bool g1_window_sum_52_multi(const u64 *bases_xy, const Aff52 *b52,
+                                   const int32_t *const *sds, int S, long n,
+                                   int c, int nwin, int wi, G1Jac *outs,
+                                   Aff52 *bk_ext, int total_bits) {
+  Ifma52Field &F = fq52_field();
+  const long nbuckets = (1L << (c - 1)) + 1;
+  // Chunk size matches the single-column fill.  (Scaling it to 2048*S —
+  // per-column conflict parity, S x fewer inversion rounds — was tried
+  // and measured the whole batch ~12% SLOWER: the apply's SoA scratch
+  // grows with B and evicts the bucket lines the schedule loop just
+  // touched, costing a second miss per add at writeback.)
+  const long B = 2048;
+  int bits_here = total_bits - wi * c;
+  if (bits_here > c) bits_here = c;
+  if (bits_here < 1 || (1L << bits_here) < 4 * B) {
+    // small/top windows: per column through the same tiers the
+    // single-column driver takes (arm parity with the oracle path)
+    for (int s = 0; s < S; ++s) {
+      if (bits_here >= 0 && bits_here <= 8) {
+        g1_window_sum_small(bases_xy, sds[s], n, c, nwin, wi, bits_here, &outs[s]);
+      } else {
+        g1_window_sum_jac(bases_xy, sds[s], n, c, nwin, wi, &outs[s]);
+      }
+    }
+    return false;
+  }
+  const int sbits = multi_sbits(S);
+  const long smask = (1L << sbits) - 1;
+  Aff52 *bk = bk_ext ? bk_ext : new Aff52[(size_t)S * nbuckets]();
+  int *stamp = new int[(size_t)S * nbuckets];
+  memset(stamp, 0xff, (size_t)S * nbuckets * sizeof(int));
+  std::vector<long> cur, next;
+  cur.reserve((size_t)n * S);
+  // i-outer entry order: all S columns of one point are adjacent, so
+  // each base line is loaded once per window for the whole batch.  (A
+  // point-block x column tiling was tried for bucket locality — it
+  // kept each run inside one column's bucket set but quadrupled the
+  // same-bucket defers back to the sequential rate and measured
+  // net-slower; the prefetch below is the cheaper answer to the S-wide
+  // bucket block's misses.)
+  for (long i = 0; i < n; ++i) {
+    if (aff52_is_zero(b52[i].x) && aff52_is_zero(b52[i].y)) continue;
+    for (int s = 0; s < S; ++s)
+      if (sds[s][i * nwin + wi]) cur.push_back((i << sbits) | s);
+  }
+  long *add_bkt = new long[B];
+  long *add_pt = new long[B];
+  unsigned char *negf = new unsigned char[B];
+  u64 (*x3a)[5] = new u64[B][5];
+  u64 (*y3a)[5] = new u64[B][5];
+  unsigned char *dbl = new unsigned char[B];
+  u64 *scratch = new u64[(size_t)8 * 5 * B];
+  auto cleanup = [&]() {
+    if (!bk_ext) delete[] bk;
+    delete[] stamp;
+    delete[] add_bkt;
+    delete[] add_pt;
+    delete[] negf;
+    delete[] x3a;
+    delete[] y3a;
+    delete[] dbl;
+    delete[] scratch;
+  };
+  int chunk_id = 0;
+  long long n_dbl = 0, n_cancel = 0, n_defer = 0;
+  long long fl0 = prof_now_ns();
+  while (!cur.empty()) {
+    next.clear();
+    size_t processed = 0;
+    bool bail = false;
+    for (size_t lo = 0; lo < cur.size() && !bail; lo += B, ++chunk_id) {
+      size_t hi = lo + B < cur.size() ? lo + B : cur.size();
+      long m = 0;
+      for (size_t k = lo; k < hi; ++k) {
+        // prefetch the bucket line + stamp a few entries ahead: the
+        // S-wide bucket block (S x nbuckets x 80 B) outgrows L2, and a
+        // demand-missed bucket read stalls the whole schedule walk —
+        // this is where the first multi profile lost its S x win
+        if (k + 16 < hi) {
+          long e2 = cur[k + 16];
+          long i2 = e2 >> sbits;
+          int s2 = (int)(e2 & smask);
+          int32_t d2 = sds[s2][i2 * nwin + wi];
+          long pb2 = (long)s2 * nbuckets + (d2 < 0 ? -d2 : d2);
+          __builtin_prefetch(&stamp[pb2]);
+          __builtin_prefetch(&bk[pb2]);
+          __builtin_prefetch((const char *)&bk[pb2] + 64);
+        }
+        long e = cur[k];
+        long i = e >> sbits;
+        int s = (int)(e & smask);
+        int32_t dgt = sds[s][i * nwin + wi];
+        long bno = (long)s * nbuckets + (dgt < 0 ? -dgt : dgt);
+        if (stamp[bno] == chunk_id) {
+          next.push_back(e);
+          ++n_defer;
+          continue;
+        }
+        stamp[bno] = chunk_id;
+        u64 py[5];
+        if (dgt < 0) {
+          neg52(py, b52[i].y, F);
+        } else {
+          memcpy(py, b52[i].y, 40);
+        }
+        if (aff52_is_zero(bk[bno].x) && aff52_is_zero(bk[bno].y)) {
+          memcpy(bk[bno].x, b52[i].x, 40);
+          memcpy(bk[bno].y, py, 40);
+          continue;
+        }
+        if (memcmp(bk[bno].x, b52[i].x, 40) == 0) {
+          if (memcmp(bk[bno].y, py, 40) == 0) {
+            dbl[m] = 1;
+            ++n_dbl;
+          } else {
+            memset(&bk[bno], 0, sizeof(Aff52));  // P + (-P)
+            ++n_cancel;
+            continue;
+          }
+        } else {
+          dbl[m] = 0;
+        }
+        add_bkt[m] = bno;
+        add_pt[m] = i;
+        negf[m] = dgt < 0 ? 1 : 0;
+        ++m;
+      }
+      processed = hi;
+      if (!m) {
+        if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
+        continue;
+      }
+      long long ap0 = prof_now_ns();
+      g1_chunk_apply_52(bk, b52, add_bkt, add_pt, negf, dbl, m, x3a, y3a, scratch);
+      stat_add(ST_MSM_APPLY_NS, prof_now_ns() - ap0);
+      for (long j = 0; j < m; ++j) {
+        memcpy(bk[add_bkt[j]].x, x3a[j], 40);
+        memcpy(bk[add_bkt[j]].y, y3a[j], 40);
+      }
+      if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
+    }
+    if (bail || next.size() * 4 > cur.size()) {
+      stat_add(ST_MSM_FILL_NS, prof_now_ns() - fl0);
+      stat_add(ST_MSM_DBL_LANES, n_dbl);
+      stat_add(ST_MSM_CANCEL_LANES, n_cancel);
+      stat_add(ST_MSM_DEFER_HITS, n_defer);
+      long long bs0 = prof_now_ns();
+      G1Jac *jb = new G1Jac[(size_t)S * nbuckets];
+      memset(jb, 0, (size_t)S * nbuckets * sizeof(G1Jac));
+      next.insert(next.end(), cur.begin() + processed, cur.end());
+      for (long e : next) {
+        long i = e >> sbits;
+        int s = (int)(e & smask);
+        int32_t dgt = sds[s][i * nwin + wi];
+        long bno = (long)s * nbuckets + (dgt < 0 ? -dgt : dgt);
+        const u64 *x = bases_xy + 8 * i;
+        u64 ys[4];
+        signed_pt_y(ys, x + 4, dgt < 0);
+        jac_add_mixed(jb[bno], jb[bno], x, ys);
+      }
+      stat_add(ST_MSM_BAILFILL_NS, prof_now_ns() - bs0);
+      bs0 = prof_now_ns();
+      for (int s = 0; s < S; ++s) {
+        G1Jac run, wsum;
+        memset(&run, 0, sizeof(run));
+        memset(&wsum, 0, sizeof(wsum));
+        for (long d = nbuckets - 1; d >= 1; --d) {
+          g1_add_jac(run, jb[(long)s * nbuckets + d]);
+          const Aff52 &bd = bk[(long)s * nbuckets + d];
+          if (!(aff52_is_zero(bd.x) && aff52_is_zero(bd.y))) {
+            u64 bx[4], by[4];
+            limb52_to_mont256(bd.x, bx, F);
+            limb52_to_mont256(bd.y, by, F);
+            jac_add_mixed(run, run, bx, by);
+          }
+          g1_add_jac(wsum, run);
+        }
+        outs[s] = wsum;
+      }
+      stat_add(ST_MSM_SUFFIX_NS, prof_now_ns() - bs0);
+      delete[] jb;
+      cleanup();
+      return false;
+    }
+    cur.swap(next);
+  }
+  stat_add(ST_MSM_FILL_NS, prof_now_ns() - fl0);
+  stat_add(ST_MSM_DBL_LANES, n_dbl);
+  stat_add(ST_MSM_CANCEL_LANES, n_cancel);
+  stat_add(ST_MSM_DEFER_HITS, n_defer);
+  if (bk_ext) {
+    cleanup();
+    return true;  // caller reduces the S lanes through the vector suffix
+  }
+  long long sf0 = prof_now_ns();
+  for (int s = 0; s < S; ++s) {
+    G1Jac run, wsum;
+    memset(&run, 0, sizeof(run));
+    memset(&wsum, 0, sizeof(wsum));
+    for (long d = nbuckets - 1; d >= 1; --d) {
+      const Aff52 &bd = bk[(long)s * nbuckets + d];
+      if (!(aff52_is_zero(bd.x) && aff52_is_zero(bd.y))) {
+        u64 bx[4], by[4];
+        limb52_to_mont256(bd.x, bx, F);
+        limb52_to_mont256(bd.y, by, F);
+        jac_add_mixed(run, run, bx, by);
+      }
+      g1_add_jac(wsum, run);
+    }
+    outs[s] = wsum;
+  }
+  stat_add(ST_MSM_SUFFIX_NS, prof_now_ns() - sf0);
+  cleanup();
+  return false;
+}
+#endif  // ZKP2P_HAVE_IFMA
+
+// Scalar-Montgomery multi-column window fill: the S-column mirror of
+// g1_window_sum (the batch-affine tier on hosts without IFMA, or with
+// it disabled).  Same shared-chunk batch inversion over the S-wide
+// bucket space; num/den derive from the live bucket + base by index
+// (each bucket is touched once per chunk, so the bucket at derive time
+// IS its schedule-time state).  Internal per-column suffix.
+static void g1_window_sum_multi(const u64 *bases_xy, const int32_t *const *sds,
+                                int S, long n, int c, int nwin, int wi,
+                                G1Jac *outs, int total_bits) {
+  const long nbuckets = (1L << (c - 1)) + 1;
+  const long B = 2048;  // single-column chunk — see the 52-bit multi fill
+  int bits_here = total_bits - wi * c;
+  if (bits_here > c) bits_here = c;
+  if (bits_here < 1 || (1L << bits_here) < 4 * B) {
+    for (int s = 0; s < S; ++s)
+      g1_window_sum_jac(bases_xy, sds[s], n, c, nwin, wi, &outs[s]);
+    return;
+  }
+  const int sbits = multi_sbits(S);
+  const long smask = (1L << sbits) - 1;
+  AffPt *bk = new AffPt[(size_t)S * nbuckets]();
+  int *stamp = new int[(size_t)S * nbuckets];
+  memset(stamp, 0xff, (size_t)S * nbuckets * sizeof(int));
+  std::vector<long> cur, next;
+  cur.reserve((size_t)n * S);
+  // i-outer entry order — see the 52-bit multi fill
+  for (long i = 0; i < n; ++i) {
+    const u64 *x = bases_xy + 8 * i;
+    if (is_zero4(x) && is_zero4(x + 4)) continue;
+    for (int s = 0; s < S; ++s)
+      if (sds[s][i * nwin + wi]) cur.push_back((i << sbits) | s);
+  }
+  long *add_bkt = new long[B];
+  long *add_pt = new long[B];
+  unsigned char *negf = new unsigned char[B];
+  u64 (*den)[4] = new u64[B][4];
+  u64 (*num)[4] = new u64[B][4];
+  u64 (*prod)[4] = new u64[B][4];
+  unsigned char *dbl = new unsigned char[B];
+  auto cleanup = [&]() {
+    delete[] bk;
+    delete[] stamp;
+    delete[] add_bkt;
+    delete[] add_pt;
+    delete[] negf;
+    delete[] den;
+    delete[] num;
+    delete[] prod;
+    delete[] dbl;
+  };
+  int chunk_id = 0;
+  long long n_dbl = 0, n_cancel = 0, n_defer = 0;
+  long long fl0 = prof_now_ns();
+  while (!cur.empty()) {
+    next.clear();
+    size_t processed = 0;
+    bool bail = false;
+    for (size_t lo = 0; lo < cur.size() && !bail; lo += B, ++chunk_id) {
+      size_t hi = lo + B < cur.size() ? lo + B : cur.size();
+      long m = 0;
+      for (size_t k = lo; k < hi; ++k) {
+        if (k + 16 < hi) {  // see the 52-bit multi fill: hide the S-wide
+          long e2 = cur[k + 16];  // bucket block's L2 misses
+          long i2 = e2 >> sbits;
+          int s2 = (int)(e2 & smask);
+          int32_t d2 = sds[s2][i2 * nwin + wi];
+          long pb2 = (long)s2 * nbuckets + (d2 < 0 ? -d2 : d2);
+          __builtin_prefetch(&stamp[pb2]);
+          __builtin_prefetch(&bk[pb2]);
+        }
+        long e = cur[k];
+        long i = e >> sbits;
+        int s = (int)(e & smask);
+        int32_t dgt = sds[s][i * nwin + wi];
+        long bno = (long)s * nbuckets + (dgt < 0 ? -dgt : dgt);
+        if (stamp[bno] == chunk_id) {
+          next.push_back(e);
+          ++n_defer;
+          continue;
+        }
+        stamp[bno] = chunk_id;
+        const u64 *px = bases_xy + 8 * i;
+        u64 py[4];
+        signed_pt_y(py, px + 4, dgt < 0);
+        if (aff_is_empty(bk[bno])) {
+          memcpy(bk[bno].x, px, 32);
+          memcpy(bk[bno].y, py, 32);
+          continue;
+        }
+        if (memcmp(bk[bno].x, px, 32) == 0) {
+          if (memcmp(bk[bno].y, py, 32) == 0) {
+            dbl[m] = 1;
+            ++n_dbl;
+          } else {
+            memset(&bk[bno], 0, sizeof(AffPt));  // P + (-P)
+            ++n_cancel;
+            continue;
+          }
+        } else {
+          dbl[m] = 0;
+        }
+        add_bkt[m] = bno;
+        add_pt[m] = i;
+        negf[m] = dgt < 0 ? 1 : 0;
+        ++m;
+      }
+      processed = hi;
+      if (!m) {
+        if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
+        continue;
+      }
+      // shared batch inversion across ALL columns' adds in this chunk
+      u64 run[4];
+      memcpy(run, ONE_MONT, 32);
+      for (long j = 0; j < m; ++j) {
+        long b = add_bkt[j];
+        const u64 *px = bases_xy + 8 * add_pt[j];
+        if (dbl[j]) {
+          u64 xsq[4], t[4];
+          mont_sqr(xsq, bk[b].x);
+          add_mod(t, xsq, xsq);
+          add_mod(num[j], t, xsq);
+          add_mod(den[j], bk[b].y, bk[b].y);
+        } else {
+          u64 py[4];
+          signed_pt_y(py, px + 4, negf[j] != 0);
+          sub_mod(num[j], py, bk[b].y);
+          sub_mod(den[j], px, bk[b].x);
+        }
+        memcpy(prod[j], run, 32);
+        mont_mul(run, run, den[j]);
+      }
+      u64 inv_all[4];
+      mont_inv(inv_all, run);
+      for (long j = m - 1; j >= 0; --j) {
+        u64 dinv[4];
+        mont_mul(dinv, inv_all, prod[j]);
+        mont_mul(inv_all, inv_all, den[j]);
+        long b = add_bkt[j];
+        const u64 *px = bases_xy + 8 * add_pt[j];
+        u64 lam[4], lam2[4], x3[4], y3[4], t[4];
+        mont_mul(lam, num[j], dinv);
+        mont_sqr(lam2, lam);
+        sub_mod(x3, lam2, bk[b].x);
+        sub_mod(x3, x3, px);
+        sub_mod(t, bk[b].x, x3);
+        mont_mul(t, lam, t);
+        sub_mod(y3, t, bk[b].y);
+        memcpy(bk[b].x, x3, 32);
+        memcpy(bk[b].y, y3, 32);
+      }
+      if (next.size() * 2 > processed && processed >= (size_t)B) bail = true;
+    }
+    if (bail || next.size() * 4 > cur.size()) {
+      stat_add(ST_MSM_FILL_NS, prof_now_ns() - fl0);
+      stat_add(ST_MSM_DBL_LANES, n_dbl);
+      stat_add(ST_MSM_CANCEL_LANES, n_cancel);
+      stat_add(ST_MSM_DEFER_HITS, n_defer);
+      long long bs0 = prof_now_ns();
+      G1Jac *jb = new G1Jac[(size_t)S * nbuckets];
+      memset(jb, 0, (size_t)S * nbuckets * sizeof(G1Jac));
+      next.insert(next.end(), cur.begin() + processed, cur.end());
+      for (long e : next) {
+        long i = e >> sbits;
+        int s = (int)(e & smask);
+        int32_t dgt = sds[s][i * nwin + wi];
+        long bno = (long)s * nbuckets + (dgt < 0 ? -dgt : dgt);
+        const u64 *x = bases_xy + 8 * i;
+        u64 ys[4];
+        signed_pt_y(ys, x + 4, dgt < 0);
+        jac_add_mixed(jb[bno], jb[bno], x, ys);
+      }
+      stat_add(ST_MSM_BAILFILL_NS, prof_now_ns() - bs0);
+      bs0 = prof_now_ns();
+      for (int s = 0; s < S; ++s) {
+        G1Jac run, wsum;
+        memset(&run, 0, sizeof(run));
+        memset(&wsum, 0, sizeof(wsum));
+        for (long d = nbuckets - 1; d >= 1; --d) {
+          g1_add_jac(run, jb[(long)s * nbuckets + d]);
+          const AffPt &bd = bk[(long)s * nbuckets + d];
+          if (!aff_is_empty(bd)) jac_add_mixed(run, run, bd.x, bd.y);
+          g1_add_jac(wsum, run);
+        }
+        outs[s] = wsum;
+      }
+      stat_add(ST_MSM_SUFFIX_NS, prof_now_ns() - bs0);
+      delete[] jb;
+      cleanup();
+      return;
+    }
+    cur.swap(next);
+  }
+  stat_add(ST_MSM_FILL_NS, prof_now_ns() - fl0);
+  stat_add(ST_MSM_DBL_LANES, n_dbl);
+  stat_add(ST_MSM_CANCEL_LANES, n_cancel);
+  stat_add(ST_MSM_DEFER_HITS, n_defer);
+  long long sf0 = prof_now_ns();
+  for (int s = 0; s < S; ++s) {
+    G1Jac run, wsum;
+    memset(&run, 0, sizeof(run));
+    memset(&wsum, 0, sizeof(wsum));
+    for (long d = nbuckets - 1; d >= 1; --d) {
+      const AffPt &bd = bk[(long)s * nbuckets + d];
+      if (!aff_is_empty(bd)) jac_add_mixed(run, run, bd.x, bd.y);
+      g1_add_jac(wsum, run);
+    }
+    outs[s] = wsum;
+  }
+  stat_add(ST_MSM_SUFFIX_NS, prof_now_ns() - sf0);
+  cleanup();
+}
+
+// The multi-column Pippenger middle: window sums filled S columns at a
+// time (batch-affine tiers — the shared-inversion win) or per (window,
+// column) (the Jacobian A/B arm, which has no rounds to share and so
+// takes the wider parallel axis), Horner-folded per column into
+// accs[0..S) (caller-zeroed).
+static void g1_pippenger_core_multi(const u64 *pb, const int32_t *const *sds,
+                                    int S, long nr, int c, int nwin,
+                                    int n_threads, G1Jac *accs,
+                                    int total_bits = 254) {
+  const bool batch_affine = batch_affine_enabled();
+  G1Jac *wins = new G1Jac[(size_t)nwin * S];
+  if (!batch_affine) {
+    run_indexed_jobs((long)nwin * S, n_threads, [&](long j) {
+      int wi = (int)(j / S), s = (int)(j % S);
+      g1_window_sum_jac(pb, sds[s], nr, c, nwin, wi, &wins[(size_t)wi * S + s]);
+    });
+  } else {
+#if ZKP2P_HAVE_IFMA
+    if (ifma_enabled()) {
+      Aff52 *b52 = new Aff52[nr];  // ONE mont260 conversion for S columns
+      g1_bases_to_52(pb, nr, b52);
+      const long nbuckets52 = (1L << (c - 1)) + 1;
+      Aff52 *allbk = nullptr;
+      unsigned char *defer = nullptr;
+      // Deferred vector suffix single-threaded only, like the
+      // single-column core.  (Engaging it at n_threads > 1 was tried —
+      // a lone multi call DID win, the post-join vector pass beating
+      // two workers' serial walks — but in the real prove several
+      // concurrent multi calls each hold an nwin x S x nbuckets x 80 B
+      // lane block, ~300 MB of extra fill-write/suffix-read traffic
+      // that thrashed what per-window local bucket arrays keep
+      // cache-resident, and the whole batch measured ~15% slower.)
+      // Memory cap: S multiplies the single-column block.
+      if (n_threads <= 1 &&
+          (size_t)nwin * S * (size_t)nbuckets52 * sizeof(Aff52) <=
+              ((size_t)160 << 20)) {
+        allbk = new Aff52[(size_t)nwin * S * (size_t)nbuckets52]();
+        defer = new unsigned char[nwin]();
+      }
+      run_indexed_jobs(nwin, n_threads, [&](long wi) {
+        if (!allbk) {
+          g1_window_sum_52_multi(pb, b52, sds, S, nr, c, nwin, (int)wi,
+                                 &wins[(size_t)wi * S], nullptr, total_bits);
+          return;
+        }
+        defer[wi] =
+            g1_window_sum_52_multi(
+                pb, b52, sds, S, nr, c, nwin, (int)wi, &wins[(size_t)wi * S],
+                allbk + (size_t)wi * S * (size_t)nbuckets52, total_bits)
+                ? 1
+                : 0;
+      });
+      if (allbk) {
+        // one vector suffix over ALL deferred (window, column) lanes:
+        // lane id wi*S + s indexes allbk exactly like a window id
+        // indexes the single-column block, so g1_suffix8 runs unchanged
+        // — and S columns mean fuller 8-lane groups than nwin alone.
+        long long sf0 = prof_now_ns();
+        int lanes[SUFFIX_MAX_LANES], nl = 0;
+        G1Jac louts[SUFFIX_MAX_LANES];
+        const long nlanes = (long)nwin * S;
+        for (long ln = 0; ln <= nlanes; ++ln) {
+          if (ln < nlanes && defer[ln / S]) lanes[nl++] = (int)ln;
+          if (nl == SUFFIX_MAX_LANES || (ln == nlanes && nl > 0)) {
+            g1_suffix8(allbk, nbuckets52, lanes, nl, louts);
+            for (int k = 0; k < nl; ++k) wins[lanes[k]] = louts[k];
+            nl = 0;
+          }
+        }
+        stat_add(ST_MSM_SUFFIX_NS, prof_now_ns() - sf0);
+        delete[] allbk;
+        delete[] defer;
+      }
+      delete[] b52;
+    } else
+#endif
+    {
+      run_indexed_jobs(nwin, n_threads, [&](long wi) {
+        g1_window_sum_multi(pb, sds, S, nr, c, nwin, (int)wi,
+                            &wins[(size_t)wi * S], total_bits);
+      });
+    }
+  }
+  for (int s = 0; s < S; ++s) {
+    G1Jac &acc = accs[s];
+    for (int wi = nwin - 1; wi >= 0; --wi) {
+      if (wi != nwin - 1)
+        for (int k = 0; k < c; ++k) jac_double(acc, acc);
+      g1_add_jac(acc, wins[(size_t)wi * S + s]);
+    }
+  }
+  delete[] wins;
+}
+
 void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out_xy) {
   long long t0 = prof_now_ns();
@@ -4292,6 +4873,172 @@ void g1_msm_pippenger_glv_mt(const u64 *bases2_xy, const u64 *scalars, long n,
   }
   g1_add_jac(acc, ones_acc);
   g1_jac_out(acc, out_xy);
+  stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
+}
+
+// Multi-column variable-base Pippenger over G1: one fixed base array,
+// S scalar columns, S results (see the multi-column block above the
+// single-column drivers).  scalars: S consecutive column blocks of
+// n x 4 u64 STANDARD form (column s at scalars + s*n*4); out_xy: S x 8
+// u64 affine STANDARD-form rows, (0,0) = infinity.
+void g1_msm_pippenger_multi(const u64 *bases_xy, const u64 *scalars, long n,
+                            int S, int c, int n_threads, u64 *out_xy) {
+  if (S <= 0) return;
+  long long t0 = prof_now_ns();
+  stat_add(ST_MSM_MULTI_CALLS, 1);
+  stat_add(ST_MSM_MULTI_COLS, S);
+  stat_set(ST_MSM_MULTI_COLS_LAST, S);
+  stat_add(ST_MSM_G1_CALLS, 1);  // family counter, like the GLV multi's
+  stat_add(ST_MSM_POINTS, (long long)n * S);
+  stat_set(ST_MSM_WINDOW_LAST, c);
+  if (batch_affine_enabled()) stat_add(ST_MSM_BATCH_AFFINE_CALLS, 1);
+
+  std::vector<std::vector<long>> rest((size_t)S), ones((size_t)S);
+  std::vector<std::vector<unsigned char>> ones_neg((size_t)S);
+  std::vector<G1Jac> ones_acc((size_t)S);
+  // union of the columns' Pippenger index sets: ONE compacted base
+  // array serves every column (a column that stripped a point keeps
+  // all-zero digits at its row — the fill skips them)
+  std::vector<long> remap((size_t)n, -1);
+  for (int s = 0; s < S; ++s) {
+    classify_scalars(scalars + (size_t)4 * n * s, n, rest[s], ones[s], ones_neg[s]);
+    for (long i : rest[s]) remap[i] = 0;
+  }
+  std::vector<long> idx;
+  for (long i = 0; i < n; ++i)
+    if (remap[i] == 0) {
+      remap[i] = (long)idx.size();
+      idx.push_back(i);
+    }
+  long nr = (long)idx.size();
+
+  const u64 *pb = bases_xy;
+  u64 *cb = nullptr;
+  if (nr > 0 && nr != n) {
+    cb = new u64[(size_t)nr * 8];
+    for (long k = 0; k < nr; ++k) memcpy(cb + 8 * k, bases_xy + 8 * idx[k], 64);
+    pb = cb;
+  }
+  int nwin = (254 + c - 1) / c;
+  while ((long)nwin * c < 255) ++nwin;
+  int32_t *sd = nr > 0 ? new int32_t[(size_t)S * nr * nwin]() : nullptr;
+  // per-column prep: the +-1 tree sum and digit recode are column-local
+  // and independent -> pool-parallel across columns
+  run_indexed_jobs(S, n_threads, [&](long s) {
+    long long p0 = prof_now_ns();
+    g1_ones_tree_sum(bases_xy, ones[s], ones_neg[s], &ones_acc[s]);
+    const u64 *col = scalars + (size_t)4 * n * s;
+    int32_t *sdc = sd ? sd + (size_t)s * nr * nwin : nullptr;
+    for (long i : rest[s])
+      signed_digits(col + 4 * i, c, nwin, sdc + (size_t)remap[i] * nwin);
+    stat_add(ST_MSM_MULTI_PREP_NS, prof_now_ns() - p0);
+  });
+
+  std::vector<G1Jac> accs((size_t)S);
+  memset(accs.data(), 0, (size_t)S * sizeof(G1Jac));
+  if (nr > 0) {
+    std::vector<const int32_t *> sds((size_t)S);
+    for (int s = 0; s < S; ++s) sds[s] = sd + (size_t)s * nr * nwin;
+    g1_pippenger_core_multi(pb, sds.data(), S, nr, c, nwin, n_threads, accs.data());
+  }
+  for (int s = 0; s < S; ++s) {
+    g1_add_jac(accs[s], ones_acc[s]);
+    g1_jac_out(accs[s], out_xy + 8 * s);
+  }
+  delete[] sd;
+  delete[] cb;
+  stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
+}
+
+// GLV multi-column driver: the S-column mirror of
+// g1_msm_pippenger_glv_mt over the cached doubled base set
+// [P.., phi(P)..] (phi half at offset nb).  Each column's rest scalars
+// split per glv_split into rows k (k1 half) and nr+k (k2 half) of its
+// digit array; the shared core then sweeps the 2*nr-point compacted
+// base array ONCE for all S columns.
+void g1_msm_pippenger_glv_multi(const u64 *bases2_xy, const u64 *scalars,
+                                long n, long nb, int S, int c, int n_threads,
+                                const u64 *glv_consts, int glv_bits,
+                                u64 *out_xy) {
+  if (S <= 0) return;
+  long long t0 = prof_now_ns();
+  stat_add(ST_MSM_MULTI_CALLS, 1);
+  stat_add(ST_MSM_MULTI_COLS, S);
+  stat_set(ST_MSM_MULTI_COLS_LAST, S);
+  stat_add(ST_MSM_GLV_CALLS, 1);
+  stat_add(ST_MSM_POINTS, (long long)n * S);
+  stat_set(ST_MSM_WINDOW_LAST, c);
+  if (batch_affine_enabled()) stat_add(ST_MSM_BATCH_AFFINE_CALLS, 1);
+
+  std::vector<std::vector<long>> rest((size_t)S), ones((size_t)S);
+  std::vector<std::vector<unsigned char>> ones_neg((size_t)S);
+  std::vector<G1Jac> ones_acc((size_t)S);
+  std::vector<long> remap((size_t)n, -1);
+  for (int s = 0; s < S; ++s) {
+    classify_scalars(scalars + (size_t)4 * n * s, n, rest[s], ones[s], ones_neg[s]);
+    for (long i : rest[s]) remap[i] = 0;
+  }
+  std::vector<long> idx;
+  for (long i = 0; i < n; ++i)
+    if (remap[i] == 0) {
+      remap[i] = (long)idx.size();
+      idx.push_back(i);
+    }
+  long nr = (long)idx.size();
+
+  int nwin = (glv_bits + c - 1) / c;
+  while ((long)nwin * c < glv_bits + 1) ++nwin;  // top-window carry absorb
+  // Compact only when needed (the single-column driver's rule): with
+  // nothing stripped and n == nb the cached doubled array already has
+  // the [P.., phi(P)..] layout the core wants.
+  const bool compact = nr != n || n != nb;
+  const u64 *pb = bases2_xy;
+  u64 *cb = nullptr;
+  if (nr > 0 && compact) {
+    cb = new u64[(size_t)2 * nr * 8];
+    for (long k = 0; k < nr; ++k) {
+      memcpy(cb + 8 * k, bases2_xy + 8 * idx[k], 64);
+      memcpy(cb + 8 * (nr + k), bases2_xy + 8 * (nb + idx[k]), 64);
+    }
+    pb = cb;
+  }
+  int32_t *sd = nr > 0 ? new int32_t[(size_t)S * 2 * nr * nwin]() : nullptr;
+  run_indexed_jobs(S, n_threads, [&](long s) {
+    long long p0 = prof_now_ns();
+    g1_ones_tree_sum(bases2_xy, ones[s], ones_neg[s], &ones_acc[s]);  // +-1: plain P_i half
+    const u64 *col = scalars + (size_t)4 * n * s;
+    int32_t *sdc = sd ? sd + (size_t)s * 2 * nr * nwin : nullptr;
+    for (long i : rest[s]) {
+      long k = remap[i];
+      u64 k1[4], k2[4];
+      int neg1, neg2;
+      glv_split(col + 4 * i, glv_consts, k1, &neg1, k2, &neg2);
+      int32_t *d1 = sdc + (size_t)k * nwin;
+      int32_t *d2 = sdc + (size_t)(nr + k) * nwin;
+      signed_digits(k1, c, nwin, d1);
+      signed_digits(k2, c, nwin, d2);
+      if (neg1)
+        for (int w = 0; w < nwin; ++w) d1[w] = -d1[w];
+      if (neg2)
+        for (int w = 0; w < nwin; ++w) d2[w] = -d2[w];
+    }
+    stat_add(ST_MSM_MULTI_PREP_NS, prof_now_ns() - p0);
+  });
+
+  std::vector<G1Jac> accs((size_t)S);
+  memset(accs.data(), 0, (size_t)S * sizeof(G1Jac));
+  if (nr > 0) {
+    std::vector<const int32_t *> sds((size_t)S);
+    for (int s = 0; s < S; ++s) sds[s] = sd + (size_t)s * 2 * nr * nwin;
+    g1_pippenger_core_multi(pb, sds.data(), S, 2 * nr, c, nwin, n_threads,
+                            accs.data(), glv_bits);
+  }
+  for (int s = 0; s < S; ++s) {
+    g1_add_jac(accs[s], ones_acc[s]);
+    g1_jac_out(accs[s], out_xy + 8 * s);
+  }
+  delete[] sd;
+  delete[] cb;
   stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
 }
 

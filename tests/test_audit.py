@@ -128,14 +128,21 @@ def test_native_gates(monkeypatch):
 
     monkeypatch.setenv("ZKP2P_MSM_GLV", "1")
     monkeypatch.setenv("ZKP2P_MSM_BATCH_AFFINE", "0")
+    monkeypatch.setenv("ZKP2P_MSM_MULTI", "0")
     assert npv._use_glv() is True
     assert npv._use_batch_affine() is False
+    assert npv._use_msm_multi() is False
     # batch-affine off gates the IFMA tier off regardless of hardware
     assert npv._native_ifma_tier() is False
     arms = audit.gate_arms()
     assert arms["native_msm_glv"] == "on"
     assert arms["native_batch_affine"] == "off"
+    assert arms["native_msm_multi"] == "off"
     assert arms["native_tier"] == "scalar"
+    # default arm: multi ON (the _not_zero rule — off only on a leading '0')
+    monkeypatch.delenv("ZKP2P_MSM_MULTI", raising=False)
+    assert npv._use_msm_multi() is True
+    assert audit.gate_arms()["native_msm_multi"] == "on"
 
 
 # ------------------------------------------------------------- digest
@@ -239,7 +246,7 @@ def test_preflight_reports_every_gate_and_is_stable():
     for gate in (
         "on_tpu", "field_mul", "curve_kernel", "msm_unified", "msm_affine",
         "msm_h", "msm_glv", "batch_chunk", "native_msm_glv",
-        "native_batch_affine", "native_tier",
+        "native_batch_affine", "native_msm_multi", "native_tier",
     ):
         assert rep["gates"].get(gate), f"gate {gate} reported no arm"
     assert re.fullmatch(r"[0-9a-f]{16}", rep["execution_digest"])

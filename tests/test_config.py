@@ -38,6 +38,7 @@ def test_env_overrides_every_knob():
         "ZKP2P_MSM_GLV": "1",
         "ZKP2P_MSM_OVERLAP": "0",
         "ZKP2P_MSM_BATCH_AFFINE": "0",
+        "ZKP2P_MSM_MULTI": "0",
         "ZKP2P_BATCH_CHUNK": "8",
         "ZKP2P_FIELD_CONV": "limb_major",
         "ZKP2P_FIELD_MUL": "pallas",
@@ -57,6 +58,7 @@ def test_env_overrides_every_knob():
     assert cfg.msm_glv is True
     assert cfg.msm_overlap is False
     assert cfg.msm_batch_affine is False
+    assert cfg.msm_multi is False
     assert cfg.batch_chunk == "8"
     assert cfg.field_conv == "limb_major" and cfg.field_mul == "pallas" and cfg.curve_kernel == "xla"
     assert cfg.native_ifma is False and cfg.native_threads == 7 and cfg.no_cache is True

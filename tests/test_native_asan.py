@@ -109,6 +109,46 @@ for ba in ("1", "0"):
         gc.ctypes.data_as(u64p), GLV_MAX_BITS, out.ctypes.data_as(u64p))
     check(f"glv ba={ba}", out)
 
+# multi-column drivers (plain + GLV): 3 scalar columns — the original
+# vector, an all-zero column, and a shuffled-support column — over the
+# same base set; every column diffed against its own host-oracle MSM.
+# The S-wide bucket/stamp blocks, the shared-chunk inversion scratch,
+# and the lane-encoded defer lists are the new-allocation risk here.
+lib.g1_msm_pippenger_multi.argtypes = [
+    u64p, u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_int, u64p,
+]
+lib.g1_msm_pippenger_glv_multi.argtypes = [
+    u64p, u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+    ctypes.c_int, u64p, ctypes.c_int, u64p,
+]
+cols = [scalars, [0] * n, list(reversed(scalars))]
+cols[2][5] = 0
+cols[2][6] = 1
+wants = [g1_msm(pts, col) for col in cols]
+scm = np.ascontiguousarray(np.stack([_scalars_to_u64(col) for col in cols]))
+
+def check_multi(tag, got):
+    for s in range(3):
+        x = int.from_bytes(got[s, :4].tobytes(), "little")
+        y = int.from_bytes(got[s, 4:].tobytes(), "little")
+        g = None if x == 0 and y == 0 else (x, y)
+        assert g == wants[s], (tag, s)
+    print("ok", tag, flush=True)
+
+for ba in ("1", "0"):
+    os.environ["ZKP2P_MSM_BATCH_AFFINE"] = ba
+    for c, threads in ((14, 1), (14, 2)):
+        outm = np.zeros((3, 8), dtype=np.uint64)
+        lib.g1_msm_pippenger_multi(
+            bm.ctypes.data_as(u64p), scm.ctypes.data_as(u64p), n, 3, c, threads,
+            outm.ctypes.data_as(u64p))
+        check_multi(f"multi ba={ba} c={c} t={threads}", outm)
+    outm = np.zeros((3, 8), dtype=np.uint64)
+    lib.g1_msm_pippenger_glv_multi(
+        b2.ctypes.data_as(u64p), scm.ctypes.data_as(u64p), n, n, 3, 14, 2,
+        gc.ctypes.data_as(u64p), GLV_MAX_BITS, outm.ctypes.data_as(u64p))
+    check_multi(f"glv multi ba={ba}", outm)
+
 lib.zkp2p_pool_shutdown()
 print("ASAN-PARITY-GREEN", flush=True)
 """

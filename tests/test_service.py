@@ -73,8 +73,10 @@ def test_spool_processing(world, tmp_path):
 @pytest.fixture(scope="module")
 def batched_world(world):
     """Same circuit, service wired through the vectorized witness tier
-    (inputs_fn + witness_batch) and a sequential native prover."""
-    from zkp2p_tpu.prover.native_prove import prove_native
+    (inputs_fn + witness_batch) and the multi-column native batch
+    prover — the service fast path (whole claimed batches ride one
+    base sweep per G1 MSM family)."""
+    from zkp2p_tpu.prover.native_prove import prove_native_batch
 
     cs = world.cs
     # wire ids from the module fixture's circuit: x=2, y=3 (out=1, z=4)
@@ -90,14 +92,16 @@ def batched_world(world):
         public_fn=world.public_fn,
         batch_size=2,
         inputs_fn=inputs_fn,
-        prover_fn=lambda dpk, wits: [prove_native(dpk, w) for w in wits],
+        prover_fn=prove_native_batch,
         prefetch=2,
     )
 
 
 def test_batched_service_with_native_prover(batched_world, tmp_path):
-    """witness_batch tier + per-request bad-input isolation + sequential
-    native proving, end to end through the spool."""
+    """witness_batch tier + per-request bad-input isolation + the
+    multi-column native batch prover, end to end through the spool —
+    and every prove-terminal record carries its batch_index/batch_n
+    attribution."""
     spool = str(tmp_path)
     for i, (xv, yv) in enumerate([(3, 5), (2, 7), (6, 6), (9, 2), (5, 5)]):
         with open(os.path.join(spool, f"r{i}.req.json"), "w") as f:
@@ -108,6 +112,21 @@ def test_batched_service_with_native_prover(batched_world, tmp_path):
     stats = batched_world.process_dir(spool)
     assert stats["done"] == 5
     assert stats["error-bad-input"] == 1
+    recs = []
+    with open(spool.rstrip("/") + ".metrics.jsonl") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "request":
+                recs.append(rec)
+    done = [r for r in recs if r["state"] == "done"]
+    assert len(done) == 5
+    # batch_size=2 over 5 good requests -> batches of 2/2/1 (the bad
+    # one drops at witness time, shrinking its batch)
+    assert all("batch_index" in r and "batch_n" in r for r in done)
+    assert all(0 <= r["batch_index"] < r["batch_n"] for r in done)
+    assert sorted(r["batch_n"] for r in done) == [1, 2, 2, 2, 2]
+    bad = [r for r in recs if r["state"] == "error-bad-input"]
+    assert bad and all("batch_index" not in r for r in bad)
 
     from zkp2p_tpu.formats.proof_json import load, proof_from_json
     from zkp2p_tpu.snark.groth16 import verify

@@ -23,6 +23,13 @@ batch-affine bucket knob is A/B-able there:
   python tools/msm_hwbench.py --native --n 524288 --glv --batch-affine
   python tools/msm_hwbench.py --native --n 524288 --glv --no-batch-affine
 
+`--columns S` (native arm) benches the cross-proof multi-column kernel —
+one base sweep filling S independent bucket sets, batch-affine inversion
+rounds shared across columns — against S sequential MSMs, min-of-reps,
+with a result-hash parity echo:
+
+  python tools/msm_hwbench.py --native --n 131072 --columns 4 [--glv]
+
 Each arm runs in its own process anyway (import-time constants on the
 JAX side; one clean env per arm on the native side).
 """
@@ -88,6 +95,9 @@ def _native_bench(args):
     sc = np.ascontiguousarray(_scalars_to_u64([py_rng.randrange(R) for _ in range(n)]))
     out = np.zeros(8, dtype=np.uint64)
     reps = args.reps
+    if args.columns > 1:
+        _native_multi_bench(args, lib, bm, threads)
+        return
     if args.glv:
         c = args.window if args.window is not None else _pick_window_glv(n, threads=threads)
         phi = np.zeros_like(bm)
@@ -119,6 +129,93 @@ def _native_bench(args):
     )
 
 
+def _native_multi_bench(args, lib, bm, threads):
+    """--columns S sweep: the multi-column kernel (one base sweep, S
+    scalar columns) vs S sequential single-column MSMs — min-of-reps
+    wall per arm, speedup ratio, and a result-hash parity check (the
+    sequential driver is the byte oracle)."""
+    import ctypes
+    import hashlib
+    import random
+
+    import numpy as np
+
+    from zkp2p_tpu.field.bn254 import GLV_MAX_BITS, R
+    from zkp2p_tpu.native.lib import _scalars_to_u64
+    from zkp2p_tpu.prover.native_prove import (
+        _glv_consts,
+        _p,
+        _pick_window,
+        _pick_window_glv,
+    )
+
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    n, S, reps = bm.shape[0], args.columns, args.reps
+    py_rng = random.Random(13)
+    cols = [[py_rng.randrange(R) for _ in range(n)] for _ in range(S)]
+    sc = np.ascontiguousarray(np.stack([_scalars_to_u64(col) for col in cols]))
+    out_multi = np.zeros((S, 8), dtype=np.uint64)
+    out_seq = np.zeros((S, 8), dtype=np.uint64)
+    if args.glv:
+        c = args.window if args.window is not None else _pick_window_glv(n, threads=threads)
+        phi = np.zeros_like(bm)
+        lib.g1_glv_phi_bases.argtypes = [u64p, ctypes.c_long, u64p, u64p]
+        lib.g1_glv_phi_bases(_p(bm), n, _p(_glv_consts()), _p(phi))
+        b2 = np.ascontiguousarray(np.concatenate([bm, phi]))
+        lib.g1_msm_pippenger_glv_multi.argtypes = [
+            u64p, u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, u64p, ctypes.c_int, u64p,
+        ]
+
+        def run_multi():
+            lib.g1_msm_pippenger_glv_multi(
+                _p(b2), _p(sc), n, n, S, c, threads, _p(_glv_consts()),
+                GLV_MAX_BITS, _p(out_multi),
+            )
+
+        def run_seq():
+            for s in range(S):
+                col = np.ascontiguousarray(sc[s])
+                lib.g1_msm_pippenger_glv_mt(
+                    _p(b2), _p(col), n, n, c, threads, _p(_glv_consts()),
+                    GLV_MAX_BITS, _p(out_seq[s]),
+                )
+    else:
+        c = args.window if args.window is not None else _pick_window(n, threads=threads)
+        lib.g1_msm_pippenger_multi.argtypes = [
+            u64p, u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_int, u64p,
+        ]
+
+        def run_multi():
+            lib.g1_msm_pippenger_multi(_p(bm), _p(sc), n, S, c, threads, _p(out_multi))
+
+        def run_seq():
+            for s in range(S):
+                col = np.ascontiguousarray(sc[s])
+                lib.g1_msm_pippenger_mt(_p(bm), _p(col), n, c, threads, _p(out_seq[s]))
+
+    t_multi, t_seq = [], []
+    for _ in range(reps):
+        t0 = time.time()
+        run_multi()
+        t_multi.append(time.time() - t0)
+        t0 = time.time()
+        run_seq()
+        t_seq.append(time.time() - t0)
+    bm_multi, bm_seq = min(t_multi), min(t_seq)
+    parity = "OK" if np.array_equal(out_multi, out_seq) else "MISMATCH"
+    h = hashlib.sha256(out_multi.tobytes()).hexdigest()[:16]
+    tag = "glv" if args.glv else "plain"
+    print(
+        f"native msm multi[{tag}]: n={n} S={S} c={c} reps={reps} "
+        f"multi min={bm_multi*1e3:.0f} ms vs {S}x sequential min={bm_seq*1e3:.0f} ms "
+        f"-> {bm_seq/bm_multi:.2f}x ({S*n/bm_multi/1e6:.3f} M col-pts/s) "
+        f"parity={parity} result_hash={h}",
+        flush=True,
+    )
+    assert parity == "OK", "multi-column result diverged from the sequential oracle"
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1 << 17)
@@ -138,6 +235,11 @@ def main():
         "omit --window (or pass 0) for the prover's _pick_window choice",
     )
     ap.add_argument("--reps", type=int, default=5, help="native arm: min-of-reps (noisy box)")
+    ap.add_argument(
+        "--columns", type=int, default=1,
+        help="native arm: S > 1 benches the multi-column kernel (one base sweep, "
+        "S scalar columns) against S sequential MSMs, with a parity hash",
+    )
     glv_grp = ap.add_mutually_exclusive_group()
     glv_grp.add_argument(
         "--glv", action="store_true",

@@ -165,9 +165,22 @@ def render_requests(requests: List[dict], run: Optional[str] = None) -> str:
         return ""
     lines = ["request states:"]
     for state, a in sorted(agg.items()):
+        if state.startswith("_"):
+            continue
         lines.append(
             f"  {state:<24} n={a['n']:<6} p50={_fmt_ms(a['p50'])} "
             f"p95={_fmt_ms(a['p95'])} max={_fmt_ms(a['max'])}"
+        )
+    b = agg.get("_batched")
+    if b:
+        # batched-prove attribution (records carrying batch_index/batch_n):
+        # mean fill names the latency-vs-batch-fill tradeoff the service
+        # batch_size knob sets; the amortized p50 divides each request's
+        # claim->terminal ms by its batch width — the per-proof share of
+        # a multi-column batch prove that one request's `ms` conflates.
+        lines.append(
+            f"  batched proves:          n={b['n']:<6} mean_fill={b['mean_fill']:.2f} "
+            f"p50_amortized={_fmt_ms(b['p50_amortized'])}"
         )
     return "\n".join(lines)
 
@@ -201,12 +214,17 @@ def render_diff(agg_a: Dict[str, dict], agg_b: Dict[str, dict], label_a: str, la
 
 
 def _aggregate_requests(requests: List[dict], run: Optional[str] = None) -> Dict[str, dict]:
-    """state -> {n, p50, p95, max} over request terminal records."""
+    """state -> {n, p50, p95, max} over request terminal records; plus a
+    `_batched` pseudo-state over records carrying batch_index/batch_n
+    (mean batch fill + amortized-per-proof latency p50)."""
     by_state: Dict[str, List[float]] = {}
+    batched: List[dict] = []
     for rec in requests:
         if run and rec.get("run_id") != run:
             continue
         by_state.setdefault(rec.get("state", "?"), []).append(float(rec.get("ms") or 0.0))
+        if rec.get("batch_n"):
+            batched.append(rec)
     out: Dict[str, dict] = {}
     for state, vals in by_state.items():
         vals.sort()
@@ -215,6 +233,20 @@ def _aggregate_requests(requests: List[dict], run: Optional[str] = None) -> Dict
             "p50": _pct(vals, 0.50),
             "p95": _pct(vals, 0.95),
             "max": vals[-1] if vals else 0.0,
+        }
+    if batched:
+        amortized = sorted(
+            float(r.get("ms") or 0.0) / max(1, int(r["batch_n"])) for r in batched
+        )
+        # mean fill counts each BATCH once (its index-0 record), not each
+        # request — averaging batch_n over per-request records would weight
+        # every batch by its own width and inflate the mean toward full
+        # batches (a 4-batch plus a 1-batch is fill 2.5, not 3.4)
+        heads = [int(r["batch_n"]) for r in batched if int(r.get("batch_index", 0)) == 0]
+        out["_batched"] = {
+            "n": len(batched),
+            "mean_fill": (sum(heads) / len(heads)) if heads else float(batched[0]["batch_n"]),
+            "p50_amortized": _pct(amortized, 0.50),
         }
     return out
 

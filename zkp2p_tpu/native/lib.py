@@ -102,6 +102,10 @@ STATS_FIELDS = (
     "pool_run_ns",
     "pool_depth_peak",
     "pool_workers",
+    "msm_multi_calls",
+    "msm_multi_cols",
+    "msm_multi_cols_last",
+    "msm_multi_prep_ns",
 )
 
 
@@ -238,6 +242,52 @@ def g1_msm(points: Sequence, scalars: Sequence[int]) -> Optional[object]:
     lib.g1_msm_pippenger(bm.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, c, out.ctypes.data_as(u64p))
     x, y = _u64x4_to_int(out[:4]), _u64x4_to_int(out[4:])
     return None if x == 0 and y == 0 else (x, y)
+
+
+def g1_msm_multi(points: Sequence, scalar_cols: Sequence[Sequence[int]]) -> Optional[object]:
+    """Multi-column native MSM: ONE sweep over the shared base array, S
+    scalar columns, S results (csrc g1_msm_pippenger_multi).  Columns
+    shorter than the base set are zero-padded (a zero scalar contributes
+    nothing, so the result matches the truncated sequential MSM).
+    Returns a list of affine (x, y) tuples — None entries for infinity
+    columns — or the "sentinel False" when the lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    S = len(scalar_cols)
+    if S == 0:
+        return []
+    if not points:
+        return [None] * S  # every column of an empty MSM is infinity
+    n = len(points)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.fp_to_mont.argtypes = [u64p, u64p, ctypes.c_int]
+    lib.g1_msm_pippenger_multi.argtypes = [
+        u64p, u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_int, u64p,
+    ]
+    bases = _pack_affine(points)
+    bm = np.zeros_like(bases)
+    lib.fp_to_mont(bases.ctypes.data_as(u64p), bm.ctypes.data_as(u64p), 2 * n)
+    sc = np.zeros((S, n, 4), dtype=np.uint64)
+    for s, col in enumerate(scalar_cols):
+        if len(col) > n:
+            raise ValueError(f"g1_msm_multi: column {s} has {len(col)} scalars for {n} points")
+        if col:
+            sc[s, : len(col)] = _scalars_to_u64([int(k) for k in col])
+    sc = np.ascontiguousarray(sc)
+    out = np.zeros((S, 8), dtype=np.uint64)
+    from ..prover.native_prove import _pick_window
+
+    c = _pick_window(n)
+    lib.g1_msm_pippenger_multi(
+        bm.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, S, c, 1,
+        out.ctypes.data_as(u64p),
+    )
+    res = []
+    for s in range(S):
+        x, y = _u64x4_to_int(out[s, :4]), _u64x4_to_int(out[s, 4:])
+        res.append(None if x == 0 and y == 0 else (x, y))
+    return res
 
 
 def _scalars_to_u64(scalars: Sequence[int]) -> np.ndarray:

@@ -302,10 +302,10 @@ def cmd_batch(args):
     from ..prover.groth16_tpu import device_pk_from_zkey, prove_tpu_batch
 
     if getattr(args, "prover", "tpu") == "native":
-        from ..prover.native_prove import prove_native
-
-        def prove_tpu_batch(dpk, wits):  # noqa: F811 — CPU-box batch tier
-            return [prove_native(dpk, w) for w in wits]
+        # multi-column CPU batch tier: ONE base sweep per G1 MSM family
+        # across the whole batch (ZKP2P_MSM_MULTI=0 falls back to
+        # sequential per-proof proves inside)
+        from ..prover.native_prove import prove_native_batch as prove_tpu_batch  # noqa: F811
 
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
     zk = _load_zkey(args)
@@ -354,10 +354,10 @@ def cmd_service(args):
     params, lay = meta
     prover_fn = None
     if getattr(args, "prover", "tpu") == "native":
-        from ..prover.native_prove import prove_native
-
-        def prover_fn(dpk_in, wits):  # sequential native batch on CPU hosts
-            return [prove_native(dpk_in, w) for w in wits]
+        # the service fast path: whole claimed batches feed the native
+        # multi-column prover (one base sweep, S scalar columns per G1
+        # MSM family) instead of a per-request prove loop
+        from ..prover.native_prove import prove_native_batch as prover_fn  # noqa: F811
 
     if args.circuit == "venmo":
         svc = ProvingService.for_venmo(

@@ -67,8 +67,10 @@ class ProvingService:
         `witness_batch` tier (r1cs BlockHooks) and falls back to
         per-request scalar witnessing if the batch evaluation fails.
         prover_fn (optional): (dpk, [witness]) -> [Proof]; defaults to
-        the vmapped device `prove_tpu_batch` — pass a sequential
-        `prove_native` wrapper on chip-less hosts.
+        the vmapped device `prove_tpu_batch` — on chip-less hosts pass
+        `prover.native_prove.prove_native_batch` (the multi-column fast
+        path: whole claimed batches ride ONE base sweep per G1 MSM
+        family; ZKP2P_MSM_MULTI=0 degrades it to sequential proves).
         prefetch: ready-batch queue depth (witness ∥ prove overlap
         window; 1 = classic double buffering).
         stale_claim_s: concurrent workers sweeping one spool partition
@@ -121,7 +123,15 @@ class ProvingService:
                 s = self._sinks[path] = JsonlSink(path)
             return s
 
-    def _emit_record(self, spool: str, req: Request, state: str, knobs: Dict) -> None:
+    def _emit_record(
+        self,
+        spool: str,
+        req: Request,
+        state: str,
+        knobs: Dict,
+        batch_index: Optional[int] = None,
+        batch_n: Optional[int] = None,
+    ) -> None:
         try:
             rec = {
                 "type": "request",
@@ -137,6 +147,14 @@ class ProvingService:
                 # when their digests match — see docs/OBSERVABILITY.md
                 "execution_digest": execution_digest(),
             }
+            # batched-prove attribution: which slot of which batch this
+            # request rode, so trace_report can split a batch's prove
+            # latency across its requests (a batch=4 multi-column prove
+            # is ONE service/prove span covering four terminal records)
+            if batch_index is not None:
+                rec["batch_index"] = batch_index
+            if batch_n is not None:
+                rec["batch_n"] = batch_n
             if req.error:
                 rec["error"] = req.error[:500]
             # flight recorder: HBM watermark at terminal time.  NOTE
@@ -358,7 +376,7 @@ class ProvingService:
                 sample_pub = self.public_fn(batch[0].witness)
                 if not verify(self.vk, proofs[0], sample_pub):
                     raise RuntimeError("sample proof failed verification")
-                for req, proof in zip(batch, proofs):
+                for bi, (req, proof) in enumerate(zip(batch, proofs)):
                     set_context(request_id=req.rid)
                     try:
                         with trace("service/emit"):
@@ -367,7 +385,7 @@ class ProvingService:
                     finally:
                         set_context(request_id=None)
                     self._release_claim(req.path)
-                    self._emit_record(spool, req, "done", knobs)
+                    self._emit_record(spool, req, "done", knobs, batch_index=bi, batch_n=len(batch))
                     completed.add(req.rid)
                     stats["done"] += 1
             except Exception as e:  # noqa: BLE001
@@ -376,12 +394,15 @@ class ProvingService:
                 # a second counter bump) onto requests whose proofs were
                 # already emitted as done — one terminal state per
                 # request is what the per-request attribution rides on.
-                for req in batch:
+                for bi, req in enumerate(batch):
                     if req.rid in completed:
                         continue
                     req.error = f"error-failed-to-prove: {e}"
                     self._emit_error(req, "error-failed-to-prove", e)
-                    self._emit_record(spool, req, "error-failed-to-prove", knobs)
+                    self._emit_record(
+                        spool, req, "error-failed-to-prove", knobs,
+                        batch_index=bi, batch_n=len(batch),
+                    )
                     stats["error-failed-to-prove"] += 1
         producer.join()
         if producer_error:

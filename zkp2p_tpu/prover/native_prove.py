@@ -70,6 +70,13 @@ def _lib():
             _u64p, _u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int, ctypes.c_int,
             _u64p, ctypes.c_int, _u64p,
         ]
+        lib.g1_msm_pippenger_multi.argtypes = [
+            _u64p, _u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,
+        ]
+        lib.g1_msm_pippenger_glv_multi.argtypes = [
+            _u64p, _u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, _u64p, ctypes.c_int, _u64p,
+        ]
         # Self-test the Fr multiplier before trusting proofs to it (the
         # same covenant native/lib.py applies to the Fq side).
         a, b = R - 987654321, 0xFEDCBA9876543210 << 128 | 0x42
@@ -198,6 +205,18 @@ def _use_batch_affine() -> bool:
     return record_arm("native_batch_affine", load_config().msm_batch_affine)
 
 
+def _use_msm_multi() -> bool:
+    """Cross-proof multi-column MSM gate (ZKP2P_MSM_MULTI, default ON):
+    prove_native_batch issues each G1 MSM family as ONE multi-column
+    Pippenger call across the batch; =0 falls back to sequential
+    per-proof proves — the byte-parity oracle arm.  Fresh-read per batch
+    and record_arm-audited, so A/B digests distinguish the arms."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("native_msm_multi", load_config().msm_multi)
+
+
 def _native_ifma_tier() -> bool:
     """The 52-bit AVX512-IFMA batch-affine tier gate for G1 windows —
     the native mirror of the device prover's impl gates, reported to the
@@ -290,6 +309,19 @@ def _pick_window_glv(n: int, threads: int = 1) -> int:
             c = max(4, bl - 5)
         return min(c, 14) if threads > 1 else c
     return max(4, min(17, bl - 5))
+
+
+def _pick_window_multi(n: int, S: int, threads: int, glv: bool) -> int:
+    """Window for the MULTI-COLUMN drivers.  The single-column curves
+    apply unchanged: the S-wide bucket block (S x nbuckets x 80 B per
+    window) argues for NARROWER windows, the shared inversion rounds
+    for wider ones, and the interleaved prove A/B measured the existing
+    threads-clamped curves best on the driver box (a wide-window sweep
+    with the t=1 curve + vector suffix at threads=2 regressed the
+    whole batch ~15% — see the csrc multi-core comment).  Kept as a
+    separate hook so a box with a bigger LLC can retune multi alone."""
+    del S
+    return _pick_window_glv(n, threads=threads) if glv else _pick_window(n, threads=threads)
 
 
 def _n_threads() -> int:
@@ -477,3 +509,196 @@ def prove_native(
     REGISTRY.counter("zkp2p_proves_total", {"prover": "native"}).inc()
     publish_native_stats()
     return proof
+
+
+def prove_native_batch(
+    dpk: DeviceProvingKey,
+    witnesses: Sequence[Sequence[int]],
+    rs: Optional[Sequence[int]] = None,
+    ss: Optional[Sequence[int]] = None,
+) -> list:
+    """Prove a whole batch with the native runtime, amortizing the fixed
+    proving-key bases across proofs: witness-convert / matvec / H-ladder
+    run per proof, but each of the four G1 MSM families (a, b1, c, h) is
+    issued as ONE multi-column Pippenger call — one base sweep, S scalar
+    columns, batch-affine inversion rounds shared across columns (csrc
+    g1_msm_pippenger_multi).  The G2 b2 MSM stays per proof (no
+    multi-column G2 tier yet).  Gated by ZKP2P_MSM_MULTI (default ON);
+    off — or S <= 1 — falls back to sequential `prove_native` calls,
+    which remain the byte-parity oracle: every proof here is
+    byte-identical to its sequential counterpart for the same
+    (witness, r, s), pinned by tests/test_msm_multi.py."""
+    from ..utils.trace import trace
+
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable (csrc build failed?)")
+    S = len(witnesses)
+    if S == 0:
+        return []
+    rs = list(rs) if rs is not None else [1 + secrets.randbelow(R - 1) for _ in range(S)]
+    ss = list(ss) if ss is not None else [1 + secrets.randbelow(R - 1) for _ in range(S)]
+    if len(rs) != S or len(ss) != S:
+        raise ValueError(f"prove_native_batch: {S} witnesses but {len(rs)}/{len(ss)} blinds")
+    if not _use_msm_multi() or S == 1:
+        return [prove_native(dpk, w, r=r, s=s) for w, r, s in zip(witnesses, rs, ss)]
+
+    m = 1 << dpk.log_m
+    threads = _n_threads()
+    glv = _use_glv()
+    b_sel = np.asarray(dpk.b_sel)
+    c_sel = np.asarray(dpk.c_sel)
+
+    # Phase 1: witness conversion for EVERY proof first — it is cheap
+    # and unlocks all three witness-column multi MSMs (a/b1/c) plus the
+    # per-proof b2 G2 MSMs, which the overlap arm below launches before
+    # the expensive per-proof matvec/H-ladder work runs on this thread.
+    w_cols, w_monts = [], []
+    for witness in witnesses:
+        with trace("native/witness_convert"):
+            w_std = np.ascontiguousarray(_scalars_to_u64([w % R for w in witness]))
+            n_wires = w_std.shape[0]
+            _check_inferred_widths(dpk, witness, w_std=w_std)
+            w_mont = np.zeros_like(w_std)
+            lib.fr_to_mont_batch(_p(w_std), _p(w_mont), n_wires)
+        w_cols.append(w_std)
+        w_monts.append(w_mont)
+
+    def ladder_cols():
+        # per proof: A/B matvecs, Cz = Az . Bz, H ladder -> d column
+        # (evaluation buffers freed proof-by-proof)
+        d_cols = []
+        for w_mont in w_monts:
+            a_ev = np.zeros((m, 4), dtype=np.uint64)
+            b_ev = np.zeros((m, 4), dtype=np.uint64)
+            c_ev = np.zeros((m, 4), dtype=np.uint64)
+            with trace("native/matvec"):
+                def matvec(coeff, wire, row, out):
+                    cf = _bases_memo(
+                        (coeff, coeff),
+                        lambda b: np.ascontiguousarray(_limbs16_to_u64(np.asarray(b[0]))),
+                    )
+                    wi = np.ascontiguousarray(np.asarray(wire, dtype=np.uint32))
+                    ro = np.ascontiguousarray(np.asarray(row, dtype=np.uint32))
+                    lib.fr_matvec(_p(cf), _p32(wi), _p32(ro), cf.shape[0], _p(w_mont), m, _p(out))
+
+                jobs = [
+                    (dpk.a_coeff, dpk.a_wire, dpk.a_row, a_ev),
+                    (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
+                ]
+                if threads > 1:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    with ThreadPoolExecutor(max_workers=2) as mex:
+                        for f in [mex.submit(matvec, *j) for j in jobs]:
+                            f.result()
+                else:
+                    for j in jobs:
+                        matvec(*j)
+                lib.fr_mul_batch(_p(a_ev), _p(b_ev), _p(c_ev), m)
+            with trace("native/h_ladder"):
+                d = np.zeros((m, 4), dtype=np.uint64)
+                w_root = _scalars_to_u64([fr_domain_root(dpk.log_m)]).copy()
+                g_cos = _scalars_to_u64([coset_gen(dpk.log_m)]).copy()
+                lib.fr_h_ladder(_p(a_ev), _p(b_ev), _p(c_ev), m, _p(w_root), _p(g_cos), _p(d))
+                d_std = np.zeros_like(d)
+                lib.fr_from_mont_batch(_p(d), _p(d_std), m)
+            d_cols.append(d_std)
+            del a_ev, b_ev, c_ev
+        return d_cols
+
+    # Phase 2: the MSMs.  a/b1/c/h each ride ONE multi-column call over
+    # the fixed (memoized) bases; b2 stays a per-proof G2 MSM.
+    def msm_g1_multi(bases, cols, tag: str):
+        with trace(f"native/msm_{tag}", cols=len(cols)):
+            out = np.zeros((S, 8), dtype=np.uint64)
+            if glv:
+                b = _g1_bases_glv_u64(bases)
+                nb = b.shape[0] // 2
+                n = min(nb, cols[0].shape[0])
+                sc = np.ascontiguousarray(np.stack([np.asarray(col[:n]) for col in cols]))
+                c = _pick_window_multi(n, S, threads, glv=True)
+                lib.g1_msm_pippenger_glv_multi(
+                    _p(b), _p(sc), n, nb, S, c, threads,
+                    _p(_glv_consts()), GLV_MAX_BITS, _p(out),
+                )
+            else:
+                b = _g1_bases_u64(bases)
+                n = min(b.shape[0], cols[0].shape[0])
+                sc = np.ascontiguousarray(np.stack([np.asarray(col[:n]) for col in cols]))
+                lib.g1_msm_pippenger_multi(
+                    _p(b), _p(sc), n, S, _pick_window_multi(n, S, threads, glv=False),
+                    threads, _p(out)
+                )
+        res = []
+        for s in range(S):
+            x, y = _u64x4_to_int_arr(out[s].reshape(2, 4))
+            res.append(None if x == 0 and y == 0 else (x, y))
+        return res
+
+    def msm_g2_one(bases, scalars: np.ndarray, tag: str):
+        with trace(f"native/msm_{tag}"):
+            b = _g2_bases_u64(bases)
+            n = min(b.shape[0], scalars.shape[0])
+            sc = np.ascontiguousarray(scalars[:n])
+            out = np.zeros(16, dtype=np.uint64)
+            lib.g2_msm_pippenger_mt(_p(b), _p(sc), n, _pick_window(n, g2=True), threads, _p(out))
+        xc0, xc1, yc0, yc1 = _u64x4_to_int_arr(out.reshape(4, 4))
+        if xc0 == xc1 == yc0 == yc1 == 0:
+            return None
+        return (Fq2(xc0, xc1), Fq2(yc0, yc1))
+
+    b_cols = [np.ascontiguousarray(w[b_sel]) for w in w_cols]
+    c_cols = [np.ascontiguousarray(w[c_sel]) for w in w_cols]
+    from ..utils.config import load_config
+
+    if load_config().msm_overlap and threads > 1:
+        # Same stage task-graph contract as prove_native, one level up:
+        # everything witness-dependent — the three witness-column multi
+        # MSMs and the S per-proof G2 MSMs — runs on worker threads
+        # (ctypes releases the GIL; the C pool's region width caps bound
+        # window concurrency) while THIS thread grinds the per-proof
+        # matvec/H-ladder pipeline and then the h multi MSM, which sits
+        # behind it.  Assembly order stays fixed, so proof bytes match
+        # the sequential schedule.
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..utils.trace import adopt_context, adopt_stack, current_context, current_stack
+
+        stack = current_stack()
+        ctx = current_context()
+
+        def seeded(fn, *fargs):
+            adopt_stack(stack)
+            adopt_context(ctx)
+            return fn(*fargs)
+
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            fut_a = ex.submit(seeded, msm_g1_multi, dpk.a_bases, w_cols, "a")
+            fut_b1 = ex.submit(seeded, msm_g1_multi, dpk.b1_bases, b_cols, "b1")
+            fut_b2 = ex.submit(
+                seeded, lambda: [msm_g2_one(dpk.b2_bases, col, "b2") for col in b_cols]
+            )
+            fut_c = ex.submit(seeded, msm_g1_multi, dpk.c_bases, c_cols, "c")
+            d_cols = ladder_cols()
+            h_accs = msm_g1_multi(dpk.h_bases, d_cols, "h")
+            a_accs, b1_accs, b2_accs, c_accs = (
+                fut_a.result(), fut_b1.result(), fut_b2.result(), fut_c.result()
+            )
+    else:
+        d_cols = ladder_cols()
+        a_accs = msm_g1_multi(dpk.a_bases, w_cols, "a")
+        b1_accs = msm_g1_multi(dpk.b1_bases, b_cols, "b1")
+        b2_accs = [msm_g2_one(dpk.b2_bases, col, "b2") for col in b_cols]
+        c_accs = msm_g1_multi(dpk.c_bases, c_cols, "c")
+        h_accs = msm_g1_multi(dpk.h_bases, d_cols, "h")
+
+    proofs = [
+        _assemble(dpk, (a_accs[s], b1_accs[s], b2_accs[s], c_accs[s], h_accs[s]), rs[s], ss[s])
+        for s in range(S)
+    ]
+    from ..utils.metrics import REGISTRY, publish_native_stats
+
+    REGISTRY.counter("zkp2p_proves_total", {"prover": "native_batch"}).inc(S)
+    publish_native_stats()
+    return proofs

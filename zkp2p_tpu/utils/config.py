@@ -106,6 +106,14 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     # Jacobian fill — the honest A/B arm.  The C runtime re-reads the env
     # per MSM (csrc batch_affine_enabled), so flips apply immediately.
     "msm_batch_affine": ("ZKP2P_MSM_BATCH_AFFINE", _not_zero, True),
+    # Cross-proof multi-column MSM in prove_native_batch: the a/b1/c/h
+    # G1 MSM families each ride ONE native Pippenger call per batch (one
+    # sweep over the fixed key bases, S scalar columns, batch-affine
+    # inversion rounds shared across columns).  Default ON; "0" falls
+    # back to sequential per-proof proves — the byte-parity oracle arm.
+    # Fresh-read per batch (the gate resolves through load_config at the
+    # prove_native_batch call site), so one process can A/B both arms.
+    "msm_multi": ("ZKP2P_MSM_MULTI", _not_zero, True),
     # proof-batch sub-chunking: "auto" (4 per chunk on a real TPU — the
     # 16 GB HBM budget; whole batch elsewhere), "0" (never chunk), or an
     # explicit chunk size.  r5 bench1 on-chip: the batched h-evals stage
@@ -141,7 +149,7 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
 # whitelist, promoted here so there is a single list).
-ARMABLE = ("msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap")
+ARMABLE = ("msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap", "msm_multi")
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
 
@@ -155,6 +163,7 @@ class ProverConfig:
     msm_glv: bool = False
     msm_overlap: bool = True
     msm_batch_affine: bool = True
+    msm_multi: bool = True
     batch_chunk: str = "auto"
     field_conv: str = "matmul"
     field_mul: str = "auto"
