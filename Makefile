@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow metrics-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -26,6 +26,27 @@ native-asan:
 # See docs/OBSERVABILITY.md.
 metrics-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_metrics_smoke.py -q
+
+# Fixed-base precomputed-table smoke (fast; tier-1 resident): build ->
+# persist -> reload -> identical proof on a tiny key, plus stale-cache
+# rejection — the cheap proof that the precomp cache layer works before
+# a cold service start spends minutes building bench-shape tables.
+precomp-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest \
+	  tests/test_msm_precomp.py -q -k "cache or stale or partial"
+
+# Pre-build the fixed-base tables for the bench-shape venmo key into
+# .bench_cache/ (same spirit as the .jax_cache pre-warm): ~50 s per G1
+# family cold, a no-op warm — run it before a driver/bench window so
+# the first prove loads tables instead of building them.
+precomp-cache: native
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python -c "\
+	import bench; \
+	from zkp2p_tpu.prover.precomp import precomputed_for, precomp_manifest; \
+	cs, lay, make_input = bench._build_venmo(); \
+	dpk, vk = bench.build_keys(cs); \
+	pk = precomputed_for(dpk); \
+	import json; print(json.dumps(precomp_manifest(), indent=1))"
 
 # Execution-path preflight (docs/OBSERVABILITY.md §execution audit):
 # probe the backend, arm EVERY gate through its real resolver, print

@@ -88,6 +88,10 @@ enum StatSlot {
   ST_MSM_MULTI_COLS,          // scalar columns summed over multi calls
   ST_MSM_MULTI_COLS_LAST,     // S of the most recent multi call (gauge)
   ST_MSM_MULTI_PREP_NS,       // per-column classify/ones/digit prep, summed
+  ST_MSM_FIXED_CALLS,         // fixed-base precomputed-table driver entries
+  ST_MSM_FIXED_PREP_NS,       // fixed-tier digit recode/scatter, summed
+  ST_PRECOMP_BUILD_NS,        // g1_precomp_build wall ns, summed
+  ST_PRECOMP_TABLE_BYTES,     // mont256 table bytes built this process, summed
   ST_COUNT
 };
 static std::atomic<long long> g_stats[ST_COUNT];
@@ -2933,6 +2937,21 @@ void fr_from_mont_batch(const u64 *in, u64 *out, long n) {
   static const u64 ONE_STD[4] = {1, 0, 0, 0};
   for (long i = 0; i < n; ++i) fr_mul(out + 4 * i, in + 4 * i, ONE_STD);
 }
+// In-place x mod r for n rows of 4 u64, any x < 2^256.  The witness
+// conversion hot loop (docs/NEXT.md lever 3): Python now serializes raw
+// int bytes and this replaces the per-element bigint `w % R`.  Since
+// 2^256 / r ~ 5.3 the loop runs at most 5 conditional subtracts, and
+// the common already-reduced row exits on the first compare — the pass
+// is memory-bound, so no vector tier applies (the IFMA build runs this
+// same scalar loop; a transposed 8-wide compare-subtract was modeled
+// and the limb shuffles alone exceed the subtract work).
+void fr_reduce_batch(u64 *inout, long n) {
+  for (long i = 0; i < n; ++i) {
+    u64 *v = inout + 4 * i;
+    while (geq(v, R_MOD)) sub_nored(v, v, R_MOD);
+  }
+}
+
 // Pointwise Montgomery product (c_ev = a_ev . b_ev).
 void fr_mul_batch(const u64 *a, const u64 *b, u64 *out, long n) {
   for (long i = 0; i < n; ++i) fr_mul(out + 4 * i, a + 4 * i, b + 4 * i);
@@ -3979,9 +3998,14 @@ static void g1_jac_out(const G1Jac &acc, u64 *out_xy) {
 // The window-parallel Pippenger middle shared by the plain and GLV G1
 // drivers: precomputed signed digits in (nr points x nwin windows),
 // window sums + Horner fold added into *acc (caller-zeroed).
+// b52_ext (opaque u64 rows of 10 = Aff52) lets the fixed-base tier pass
+// its PERSISTENT 52-limb table so the per-MSM mont256 -> mont260
+// conversion disappears from the hot loop; nullptr keeps the per-call
+// conversion the variable-base drivers have always paid.
 static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
                               int nwin, int n_threads, G1Jac *acc_out,
-                              int total_bits = 254) {
+                              int total_bits = 254,
+                              const u64 *b52_ext = nullptr) {
   G1Jac &acc = *acc_out;
   // ZKP2P_MSM_BATCH_AFFINE=0: every window through the mixed-Jacobian
   // fill — the A/B arm measuring what affine buckets + the shared batch
@@ -3991,12 +4015,18 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
   {
     G1Jac *wins = new G1Jac[nwin];
 #if ZKP2P_HAVE_IFMA
-    Aff52 *b52 = nullptr;
+    const Aff52 *b52 = nullptr;
+    Aff52 *b52_own = nullptr;
     if (ifma_enabled() && batch_affine) {
-      // one mont256 -> mont260 conversion per MSM; every window's fill
-      // then runs conversion-free (persistent 52-limb storage)
-      b52 = new Aff52[nr];
-      g1_bases_to_52(pb, nr, b52);
+      if (b52_ext) {
+        b52 = (const Aff52 *)b52_ext;
+      } else {
+        // one mont256 -> mont260 conversion per MSM; every window's fill
+        // then runs conversion-free (persistent 52-limb storage)
+        b52_own = new Aff52[nr];
+        g1_bases_to_52(pb, nr, b52_own);
+        b52 = b52_own;
+      }
     }
 #endif
 #if ZKP2P_HAVE_IFMA
@@ -4009,8 +4039,13 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
     unsigned char *defer = nullptr;
     // Defer only single-threaded: with worker threads each window's
     // serial suffix already runs CONCURRENTLY on its own worker, and a
-    // post-join vector pass would serialize that tail instead.
-    if (b52 && n_threads <= 1) {
+    // post-join vector pass would serialize that tail instead.  The
+    // size cap only matters for the fixed tier's wide windows (the
+    // variable-base sweep range never approaches it): past it the
+    // windows reduce serially rather than holding a multi-hundred-MB
+    // lane block.
+    if (b52 && n_threads <= 1 &&
+        (size_t)nwin * (size_t)nbuckets52 * sizeof(Aff52) <= ((size_t)256 << 20)) {
       allbk = new Aff52[(size_t)nwin * (size_t)nbuckets52]();
       defer = new unsigned char[nwin]();
     }
@@ -4059,7 +4094,7 @@ static void g1_pippenger_core(const u64 *pb, const int32_t *sd, long nr, int c,
     }
 #endif
 #if ZKP2P_HAVE_IFMA
-    delete[] b52;
+    delete[] b52_own;
 #endif
     for (int wi = nwin - 1; wi >= 0; --wi) {
       if (wi != nwin - 1)
@@ -4561,7 +4596,8 @@ static void g1_window_sum_multi(const u64 *bases_xy, const int32_t *const *sds,
 static void g1_pippenger_core_multi(const u64 *pb, const int32_t *const *sds,
                                     int S, long nr, int c, int nwin,
                                     int n_threads, G1Jac *accs,
-                                    int total_bits = 254) {
+                                    int total_bits = 254,
+                                    const u64 *b52_ext = nullptr) {
   const bool batch_affine = batch_affine_enabled();
   G1Jac *wins = new G1Jac[(size_t)nwin * S];
   if (!batch_affine) {
@@ -4572,8 +4608,14 @@ static void g1_pippenger_core_multi(const u64 *pb, const int32_t *const *sds,
   } else {
 #if ZKP2P_HAVE_IFMA
     if (ifma_enabled()) {
-      Aff52 *b52 = new Aff52[nr];  // ONE mont260 conversion for S columns
-      g1_bases_to_52(pb, nr, b52);
+      // the fixed tier's persistent table, else ONE conversion for S columns
+      Aff52 *b52_own = nullptr;
+      const Aff52 *b52 = (const Aff52 *)b52_ext;
+      if (!b52) {
+        b52_own = new Aff52[nr];
+        g1_bases_to_52(pb, nr, b52_own);
+        b52 = b52_own;
+      }
       const long nbuckets52 = (1L << (c - 1)) + 1;
       Aff52 *allbk = nullptr;
       unsigned char *defer = nullptr;
@@ -4626,7 +4668,7 @@ static void g1_pippenger_core_multi(const u64 *pb, const int32_t *const *sds,
         delete[] allbk;
         delete[] defer;
       }
-      delete[] b52;
+      delete[] b52_own;
     } else
 #endif
     {
@@ -5039,6 +5081,256 @@ void g1_msm_pippenger_glv_multi(const u64 *bases2_xy, const u64 *scalars,
   }
   delete[] sd;
   delete[] cb;
+  stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
+}
+
+// ===================================================================
+// Fixed-base precomputed-window MSM.  The proving key's G1 base arrays
+// are immutable for the life of a service, yet every prove re-ran the
+// GLV split, the mont256 -> mont260 conversion, and a full bucket fill
+// over them.  This tier trades that per-prove work for offline tables:
+//
+//   table level j holds  L_j[i] = 2^(j*q*c) * P_i   (affine Montgomery),
+//
+// built ONCE per (key, c, q, levels) by g1_precomp_build and persisted
+// by the Python side.  A 254-bit scalar recoded into W signed base-2^c
+// digits (W = ceil over 255 bits) then satisfies
+//
+//   k*P = sum_w d_w * 2^(w*c) * P
+//       = sum_{r<q} 2^(r*c) * sum_j d_{j*q+r} * L_j[P]
+//
+// — i.e. the whole MSM is EXACTLY a plain Pippenger run over the
+// "virtual" base array of levels*n table rows with only q windows
+// (virtual point j*n+i carries digit d_{j*q+r} in virtual window r).
+// g1_pippenger_core runs UNCHANGED on that framing: the batch-affine
+// chunk pipeline, the IFMA 52-limb tier (fed the PERSISTENT converted
+// table via b52_ext — no per-MSM conversion), the vector suffix, the
+// bail path and the Horner fold (c doublings between the q virtual
+// windows) all apply as-is.  What the hot loop no longer contains: the
+// GLV split (wide windows beat halved scalars once the doubling chain
+// is free), the base conversion, and (W - q) of the W per-window
+// suffix reductions.  q is the depth knob's dual: levels = ceil(W/q)
+// table copies cost levels*n*64 B (plus 80 B/row for the 52-limb form)
+// and buy a q-window hot loop; q >= n_threads keeps the window-level
+// parallel axis as wide as the pool.
+
+// Windows needed by the fixed tier at width c: ceil(254/c) bumped until
+// W*c >= 255 so the signed top-window carry is absorbed — the same rule
+// the variable-base drivers apply inline.
+static int fixed_nwin(int c) {
+  int W = (254 + c - 1) / c;
+  while ((long)W * c < 255) ++W;
+  return W;
+}
+
+// Jacobian -> affine MONTGOMERY normalization with one shared field
+// inversion per call (the Montgomery trick): the table-build tail.
+// Z = 0 rows write the (0,0) infinity hole.
+static void g1_jac_normalize_mont_batch(const G1Jac *in, long n, u64 *out_xy) {
+  u64 (*pref)[4] = new u64[n][4];
+  u64 run[4];
+  memcpy(run, ONE_MONT, 32);
+  for (long i = 0; i < n; ++i) {
+    memcpy(pref[i], run, 32);
+    if (!is_zero4(in[i].Z)) mont_mul(run, run, in[i].Z);
+  }
+  u64 inv[4];
+  mont_inv(inv, run);
+  for (long i = n - 1; i >= 0; --i) {
+    u64 *o = out_xy + 8 * i;
+    if (is_zero4(in[i].Z)) {
+      memset(o, 0, 64);
+      continue;
+    }
+    u64 zi[4], zi2[4], zi3[4];
+    mont_mul(zi, inv, pref[i]);       // 1/Z_i
+    mont_mul(inv, inv, in[i].Z);      // strip Z_i from the running inverse
+    mont_sqr(zi2, zi);
+    mont_mul(zi3, zi2, zi);
+    mont_mul(o, in[i].X, zi2);
+    mont_mul(o + 4, in[i].Y, zi3);
+  }
+  delete[] pref;
+}
+
+// Build the level tables: out_xy holds levels consecutive (n x 8 u64)
+// affine-Montgomery blocks, level 0 a verbatim copy of bases_xy.  Each
+// level is the previous one doubled q*c times — a Jacobian chain per
+// point with ONE batched inversion per (level, point-chunk), so the
+// per-point cost is ~q*c Jacobian doublings.  Pool-parallel over point
+// chunks; (0,0) infinity holes propagate as holes through every level.
+void g1_precomp_build(const u64 *bases_xy, long n, int c, int q, int levels,
+                      int n_threads, u64 *out_xy) {
+  long long t0 = prof_now_ns();
+  memcpy(out_xy, bases_xy, (size_t)n * 64);
+  if (levels > 1 && n > 0) {
+    const int shift = q * c;
+    const long CH = 2048;
+    const long njobs = (n + CH - 1) / CH;
+    run_indexed_jobs(njobs, n_threads, [&](long jb) {
+      long lo = jb * CH;
+      long hi = lo + CH < n ? lo + CH : n;
+      long cnt = hi - lo;
+      G1Jac *acc = new G1Jac[cnt];
+      for (long k = 0; k < cnt; ++k) {
+        const u64 *b = bases_xy + 8 * (lo + k);
+        if (is_zero4(b) && is_zero4(b + 4)) {
+          memset(&acc[k], 0, sizeof(G1Jac));
+        } else {
+          memcpy(acc[k].X, b, 32);
+          memcpy(acc[k].Y, b + 4, 32);
+          memcpy(acc[k].Z, ONE_MONT, 32);
+        }
+      }
+      for (int lv = 1; lv < levels; ++lv) {
+        for (long k = 0; k < cnt; ++k)
+          for (int b = 0; b < shift; ++b) jac_double(acc[k], acc[k]);
+        g1_jac_normalize_mont_batch(acc, cnt,
+                                    out_xy + ((size_t)lv * n + lo) * 8);
+      }
+      delete[] acc;
+    });
+  }
+  stat_add(ST_PRECOMP_BUILD_NS, prof_now_ns() - t0);
+  stat_add(ST_PRECOMP_TABLE_BYTES, (long long)levels * n * 64);
+}
+
+// Convert a built table to the persistent 52-limb form the IFMA fill
+// consumes (n_total rows of 10 u64 = one Aff52 each).  Returns 0 on a
+// non-IFMA build/host — the caller then passes NULL to the fixed
+// drivers and the scalar tier converts nothing (it reads mont256).
+int g1_precomp_to52(const u64 *table_xy, long n_total, u64 *out52) {
+#if ZKP2P_HAVE_IFMA
+  if (ifma_enabled()) {
+    g1_bases_to_52(table_xy, n_total, (Aff52 *)out52);
+    return 1;
+  }
+#endif
+  (void)table_xy;
+  (void)n_total;
+  (void)out52;
+  return 0;
+}
+
+// Scatter one scalar's W-digit recoding into the virtual digit matrix:
+// window w = j*q + r lands at virtual point j*n + i, virtual window r.
+static inline void fixed_scatter_digits(const int32_t *dg, int W, int q,
+                                        long n, long i, int32_t *sd) {
+  for (int w = 0; w < W; ++w) {
+    long v = (long)(w / q) * n + i;
+    sd[(size_t)v * q + (w % q)] = dg[w];
+  }
+}
+
+// Fixed-base precomputed-table Pippenger driver.  table_xy: the
+// g1_precomp_build output (levels x n x 8 u64 affine Montgomery);
+// table52: its g1_precomp_to52 form or NULL; scalars: nsc (<= n) rows
+// of 4 u64 STANDARD form; out_xy: 8 u64 affine STANDARD form.  The
+// result is the exact group element of the variable-base drivers for
+// the same (bases, scalars) — canonicalization makes it byte-identical,
+// so g1_msm_pippenger_mt stays the parity oracle.
+void g1_msm_pippenger_fixed(const u64 *table_xy, const u64 *table52,
+                            const u64 *scalars, long nsc, long n, int levels,
+                            int c, int q, int n_threads, u64 *out_xy) {
+  long long t0 = prof_now_ns();
+  stat_add(ST_MSM_FIXED_CALLS, 1);
+  stat_add(ST_MSM_G1_CALLS, 1);
+  stat_add(ST_MSM_POINTS, nsc);
+  stat_set(ST_MSM_WINDOW_LAST, c);
+  if (batch_affine_enabled()) stat_add(ST_MSM_BATCH_AFFINE_CALLS, 1);
+  const int W = fixed_nwin(c);
+  if (c < 4 || W > 64) abort();       // recode buffer bound (c >= 4 always)
+  if ((long)levels * q < W) abort();  // table cannot cover the digit span
+  std::vector<long> rest, ones;
+  std::vector<unsigned char> ones_neg;
+  classify_scalars(scalars, nsc, rest, ones, ones_neg);
+  G1Jac ones_acc;
+  g1_ones_tree_sum(table_xy, ones, ones_neg, &ones_acc);  // +-1: level 0
+  G1Jac acc;
+  memset(&acc, 0, sizeof(acc));
+  long nr = (long)rest.size();
+  if (nr > 0) {
+    const long nv = (long)levels * n;
+    // zero-initialized: non-rest virtual rows keep all-zero digits and
+    // the fill skips them — the table is NEVER compacted or copied
+    int32_t *sd = new int32_t[(size_t)nv * q]();
+    long long p0 = prof_now_ns();
+    const long CH = 8192;
+    run_indexed_jobs((nr + CH - 1) / CH, n_threads, [&](long jb) {
+      int32_t dg[64];  // W <= ceil(255/4) < 64 for every c >= 4
+      long hi = (jb + 1) * CH < nr ? (jb + 1) * CH : nr;
+      for (long k = jb * CH; k < hi; ++k) {
+        long i = rest[k];
+        signed_digits(scalars + 4 * i, c, W, dg);
+        fixed_scatter_digits(dg, W, q, n, i, sd);
+      }
+    });
+    stat_add(ST_MSM_FIXED_PREP_NS, prof_now_ns() - p0);
+    // total_bits = q*c: every virtual window carries full c-bit digits
+    // (middle real windows land in every lane), so no top-window
+    // narrowing applies inside the core.
+    g1_pippenger_core(table_xy, sd, nv, c, q, n_threads, &acc, q * c,
+                      table52);
+    delete[] sd;
+  }
+  g1_add_jac(acc, ones_acc);
+  g1_jac_out(acc, out_xy);
+  stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
+}
+
+// Multi-column fixed-base driver: S scalar columns over ONE table —
+// the batch path's gather/add mirror of g1_msm_pippenger_multi.
+// scalars: S consecutive column blocks of nsc x 4 u64 STANDARD form;
+// out_xy: S x 8 u64 affine STANDARD-form rows.  Column outputs equal S
+// sequential g1_msm_pippenger_fixed calls byte-for-byte.
+void g1_msm_pippenger_fixed_multi(const u64 *table_xy, const u64 *table52,
+                                  const u64 *scalars, long nsc, long n, int S,
+                                  int levels, int c, int q, int n_threads,
+                                  u64 *out_xy) {
+  if (S <= 0) return;
+  long long t0 = prof_now_ns();
+  stat_add(ST_MSM_FIXED_CALLS, 1);
+  stat_add(ST_MSM_MULTI_CALLS, 1);
+  stat_add(ST_MSM_MULTI_COLS, S);
+  stat_set(ST_MSM_MULTI_COLS_LAST, S);
+  stat_add(ST_MSM_G1_CALLS, 1);
+  stat_add(ST_MSM_POINTS, (long long)nsc * S);
+  stat_set(ST_MSM_WINDOW_LAST, c);
+  if (batch_affine_enabled()) stat_add(ST_MSM_BATCH_AFFINE_CALLS, 1);
+  const int W = fixed_nwin(c);
+  if (c < 4 || W > 64) abort();
+  if ((long)levels * q < W) abort();
+  const long nv = (long)levels * n;
+  std::vector<G1Jac> ones_acc((size_t)S);
+  int32_t *sd = new int32_t[(size_t)S * nv * q]();
+  // per-column prep (classify, +-1 tree sum, digit scatter) is
+  // column-local -> pool-parallel across columns, like the multi driver
+  run_indexed_jobs(S, n_threads, [&](long s) {
+    long long p0 = prof_now_ns();
+    const u64 *col = scalars + (size_t)4 * nsc * s;
+    std::vector<long> rest, ones;
+    std::vector<unsigned char> ones_neg;
+    classify_scalars(col, nsc, rest, ones, ones_neg);
+    g1_ones_tree_sum(table_xy, ones, ones_neg, &ones_acc[s]);
+    int32_t dg[64];
+    int32_t *sdc = sd + (size_t)s * nv * q;
+    for (long i : rest) {
+      signed_digits(col + 4 * i, c, W, dg);
+      fixed_scatter_digits(dg, W, q, n, i, sdc);
+    }
+    stat_add(ST_MSM_FIXED_PREP_NS, prof_now_ns() - p0);
+  });
+  std::vector<G1Jac> accs((size_t)S);
+  memset(accs.data(), 0, (size_t)S * sizeof(G1Jac));
+  std::vector<const int32_t *> sds((size_t)S);
+  for (int s = 0; s < S; ++s) sds[s] = sd + (size_t)s * nv * q;
+  g1_pippenger_core_multi(table_xy, sds.data(), S, nv, c, q, n_threads,
+                          accs.data(), q * c, table52);
+  for (int s = 0; s < S; ++s) {
+    g1_add_jac(accs[s], ones_acc[s]);
+    g1_jac_out(accs[s], out_xy + 8 * s);
+  }
+  delete[] sd;
   stat_add(ST_MSM_WALL_NS, prof_now_ns() - t0);
 }
 

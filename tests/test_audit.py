@@ -129,20 +129,27 @@ def test_native_gates(monkeypatch):
     monkeypatch.setenv("ZKP2P_MSM_GLV", "1")
     monkeypatch.setenv("ZKP2P_MSM_BATCH_AFFINE", "0")
     monkeypatch.setenv("ZKP2P_MSM_MULTI", "0")
+    monkeypatch.setenv("ZKP2P_MSM_PRECOMP", "0")
     assert npv._use_glv() is True
     assert npv._use_batch_affine() is False
     assert npv._use_msm_multi() is False
+    assert npv._use_msm_precomp() is False
     # batch-affine off gates the IFMA tier off regardless of hardware
     assert npv._native_ifma_tier() is False
     arms = audit.gate_arms()
     assert arms["native_msm_glv"] == "on"
     assert arms["native_batch_affine"] == "off"
     assert arms["native_msm_multi"] == "off"
+    assert arms["native_msm_precomp"] == "off"
     assert arms["native_tier"] == "scalar"
-    # default arm: multi ON (the _not_zero rule — off only on a leading '0')
+    # default arm: multi + precomp ON (the _not_zero rule — off only on
+    # a leading '0')
     monkeypatch.delenv("ZKP2P_MSM_MULTI", raising=False)
     assert npv._use_msm_multi() is True
     assert audit.gate_arms()["native_msm_multi"] == "on"
+    monkeypatch.delenv("ZKP2P_MSM_PRECOMP", raising=False)
+    assert npv._use_msm_precomp() is True
+    assert audit.gate_arms()["native_msm_precomp"] == "on"
 
 
 # ------------------------------------------------------------- digest

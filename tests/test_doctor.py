@@ -42,7 +42,8 @@ def test_doctor_report_parses_and_every_gate_reports_an_arm(doctor_report):
     for gate in (
         "on_tpu", "field_mul", "curve_kernel", "msm_unified", "msm_affine",
         "msm_h", "msm_glv", "batch_chunk", "native_msm_glv",
-        "native_batch_affine", "native_tier",
+        "native_batch_affine", "native_msm_multi", "native_msm_precomp",
+        "native_tier",
     ):
         assert rep["gates"].get(gate), f"gate {gate} reported no arm"
     assert rep["gates"]["on_tpu"] == "host"

@@ -149,6 +149,47 @@ for ba in ("1", "0"):
         gc.ctypes.data_as(u64p), GLV_MAX_BITS, outm.ctypes.data_as(u64p))
     check_multi(f"glv multi ba={ba}", outm)
 
+# fixed-base precomputed-table tier: build the level tables (the
+# Jacobian doubling chains + batched normalization are fresh allocation
+# surface), convert to the 52-limb form, and run the fixed single- and
+# multi-column drivers — each diffed against the same host oracles.
+# Covers both batch-affine arms and the scalar (p52=NULL) read path.
+lib.g1_precomp_build.argtypes = [u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_int, ctypes.c_int, u64p]
+lib.g1_precomp_to52.argtypes = [u64p, ctypes.c_long, u64p]
+lib.g1_precomp_to52.restype = ctypes.c_int
+lib.g1_msm_pippenger_fixed.argtypes = [u64p, u64p, u64p, ctypes.c_long, ctypes.c_long,
+                                       ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_int, u64p]
+lib.g1_msm_pippenger_fixed_multi.argtypes = [u64p, u64p, u64p, ctypes.c_long,
+                                             ctypes.c_long, ctypes.c_int, ctypes.c_int,
+                                             ctypes.c_int, ctypes.c_int, ctypes.c_int, u64p]
+cq, qq, Lq = 8, 4, 8
+table = np.zeros((Lq * n, 8), dtype=np.uint64)
+lib.g1_precomp_build(bm.ctypes.data_as(u64p), n, cq, qq, Lq, 2,
+                     table.ctypes.data_as(u64p))
+t52 = np.zeros((Lq * n, 10), dtype=np.uint64)
+has52 = lib.g1_precomp_to52(table.ctypes.data_as(u64p), Lq * n, t52.ctypes.data_as(u64p))
+for ba in ("1", "0"):
+    os.environ["ZKP2P_MSM_BATCH_AFFINE"] = ba
+    for threads in (1, 2):
+        out = np.zeros(8, dtype=np.uint64)
+        lib.g1_msm_pippenger_fixed(
+            table.ctypes.data_as(u64p), t52.ctypes.data_as(u64p) if has52 else None,
+            sc.ctypes.data_as(u64p), n, n, Lq, cq, qq, threads, out.ctypes.data_as(u64p))
+        check(f"fixed ba={ba} t={threads}", out)
+    outm = np.zeros((3, 8), dtype=np.uint64)
+    lib.g1_msm_pippenger_fixed_multi(
+        table.ctypes.data_as(u64p), t52.ctypes.data_as(u64p) if has52 else None,
+        scm.ctypes.data_as(u64p), n, n, 3, Lq, cq, qq, 2, outm.ctypes.data_as(u64p))
+    check_multi(f"fixed multi ba={ba}", outm)
+    # scalar read path (no 52-limb table)
+    out = np.zeros(8, dtype=np.uint64)
+    lib.g1_msm_pippenger_fixed(
+        table.ctypes.data_as(u64p), None, sc.ctypes.data_as(u64p), n, n, Lq, cq, qq, 1,
+        out.ctypes.data_as(u64p))
+    check(f"fixed no52 ba={ba}", out)
+
 lib.zkp2p_pool_shutdown()
 print("ASAN-PARITY-GREEN", flush=True)
 """

@@ -30,6 +30,16 @@ with a result-hash parity echo:
 
   python tools/msm_hwbench.py --native --n 131072 --columns 4 [--glv]
 
+`--precomp` (native arm) benches the fixed-base precomputed-table tier
+(csrc g1_msm_pippenger_fixed / _fixed_multi with --columns) against the
+variable-base oracle arm (--glv picks which), building the level tables
+in-process first; `--table-depth` sets the level count (q derives as
+ceil(W/depth)); parity hash echoed like the --columns convention:
+
+  python tools/msm_hwbench.py --native --n 524288 --precomp --glv
+  python tools/msm_hwbench.py --native --n 524288 --precomp --table-depth 4
+  python tools/msm_hwbench.py --native --n 131072 --precomp --columns 4
+
 Each arm runs in its own process anyway (import-time constants on the
 JAX side; one clean env per arm on the native side).
 """
@@ -95,6 +105,9 @@ def _native_bench(args):
     sc = np.ascontiguousarray(_scalars_to_u64([py_rng.randrange(R) for _ in range(n)]))
     out = np.zeros(8, dtype=np.uint64)
     reps = args.reps
+    if args.precomp:
+        _native_precomp_bench(args, lib, bm, sc, threads)
+        return
     if args.columns > 1:
         _native_multi_bench(args, lib, bm, threads)
         return
@@ -127,6 +140,111 @@ def _native_bench(args):
         f"result_x={x % (1 << 64):#x}",
         flush=True,
     )
+
+
+def _native_precomp_bench(args, lib, bm, sc, threads):
+    """--precomp arm: fixed-base precomputed-table drivers vs the
+    variable-base oracle (GLV when --glv, plain otherwise) — tables
+    built in-process at the prover's fixed-tier window, min-of-reps per
+    arm, speedup ratio, and a result-hash parity echo matching the
+    --columns convention.  --table-depth sets the level count (the
+    ZKP2P_MSM_PRECOMP_DEPTH dial); --columns S runs the _fixed_multi
+    driver against S sequential oracle MSMs."""
+    import hashlib
+    import random
+
+    import numpy as np
+
+    from zkp2p_tpu.field.bn254 import GLV_MAX_BITS, R
+    from zkp2p_tpu.native.lib import _scalars_to_u64
+    from zkp2p_tpu.prover.native_prove import (
+        _glv_consts,
+        _p,
+        _pick_window,
+        _pick_window_glv,
+    )
+    from zkp2p_tpu.prover.precomp import _resolve_geometry
+
+    n, S, reps = bm.shape[0], max(1, args.columns), args.reps
+    # the prover's own geometry resolver (uncapped budget: the bench
+    # measures the requested depth, the prover's RAM guard is its own
+    # concern) — so the tool can never drift from what the prover runs.
+    # No argtype declarations here: the `lib` handle comes from
+    # native_prove._lib(), which already configures the precomp ABI.
+    cf, q, levels = _resolve_geometry(n, args.table_depth, 1 << 62)
+    t0 = time.time()
+    table = np.zeros((levels * n, 8), dtype=np.uint64)
+    lib.g1_precomp_build(_p(bm), n, cf, q, levels, threads, _p(table))
+    t_build = time.time() - t0
+    table52 = np.zeros((levels * n, 10), dtype=np.uint64)
+    p52 = _p(table52) if lib.g1_precomp_to52(_p(table), levels * n, _p(table52)) else None
+    print(
+        f"precomp tables: c={cf} q={q} levels={levels} "
+        f"({table.nbytes + (table52.nbytes if p52 else 0):,} B resident) "
+        f"built in {t_build:.1f}s",
+        flush=True,
+    )
+
+    py_rng = random.Random(13)
+    if S > 1:
+        cols = [[py_rng.randrange(R) for _ in range(n)] for _ in range(S)]
+        scm = np.ascontiguousarray(np.stack([_scalars_to_u64(col) for col in cols]))
+    else:
+        scm = np.ascontiguousarray(sc.reshape(1, n, 4))
+    out_fixed = np.zeros((S, 8), dtype=np.uint64)
+    out_ref = np.zeros((S, 8), dtype=np.uint64)
+
+    def run_fixed():
+        if S > 1:
+            lib.g1_msm_pippenger_fixed_multi(
+                _p(table), p52, _p(scm), n, n, S, levels, cf, q, threads, _p(out_fixed)
+            )
+        else:
+            lib.g1_msm_pippenger_fixed(
+                _p(table), p52, _p(scm), n, n, levels, cf, q, threads, _p(out_fixed)
+            )
+
+    if args.glv:
+        c_ref = args.window if args.window is not None else _pick_window_glv(n, threads=threads)
+        phi = np.zeros_like(bm)
+        lib.g1_glv_phi_bases(_p(bm), n, _p(_glv_consts()), _p(phi))
+        b2 = np.ascontiguousarray(np.concatenate([bm, phi]))
+
+        def run_ref():
+            for s in range(S):
+                col = np.ascontiguousarray(scm[s])
+                lib.g1_msm_pippenger_glv_mt(
+                    _p(b2), _p(col), n, n, c_ref, threads, _p(_glv_consts()),
+                    GLV_MAX_BITS, _p(out_ref[s]),
+                )
+    else:
+        c_ref = args.window if args.window is not None else _pick_window(n, threads=threads)
+
+        def run_ref():
+            for s in range(S):
+                col = np.ascontiguousarray(scm[s])
+                lib.g1_msm_pippenger_mt(_p(bm), _p(col), n, c_ref, threads, _p(out_ref[s]))
+
+    t_fixed, t_ref = [], []
+    for _ in range(reps):
+        t0 = time.time()
+        run_fixed()
+        t_fixed.append(time.time() - t0)
+        t0 = time.time()
+        run_ref()
+        t_ref.append(time.time() - t0)
+    bf, br = min(t_fixed), min(t_ref)
+    parity = "OK" if np.array_equal(out_fixed, out_ref) else "MISMATCH"
+    h = hashlib.sha256(out_fixed.tobytes()).hexdigest()[:16]
+    tag = "glv" if args.glv else "plain"
+    print(
+        f"native msm precomp[vs {tag}]: n={n} S={S} c={cf} q={q} L={levels} reps={reps} "
+        f"fixed min={bf*1e3:.0f} ms vs oracle(c={c_ref}) min={br*1e3:.0f} ms "
+        f"-> {br/bf:.2f}x ({S*n/bf/1e6:.3f} M col-pts/s) "
+        f"parity={parity} result_hash={h}",
+        flush=True,
+    )
+    assert parity == "OK", "precomp result diverged from the variable-base oracle"
 
 
 def _native_multi_bench(args, lib, bm, threads):
@@ -249,6 +367,22 @@ def main():
     glv_grp.add_argument(
         "--no-glv", action="store_true",
         help="explicit non-GLV arm (the default; named so A/B run logs are self-labelling)",
+    )
+    pc_grp = ap.add_mutually_exclusive_group()
+    pc_grp.add_argument(
+        "--precomp", action="store_true",
+        help="native arm: fixed-base precomputed-table tier (tables built "
+        "in-process) vs the variable-base oracle, with a parity hash",
+    )
+    pc_grp.add_argument(
+        "--no-precomp", action="store_true",
+        help="explicit variable-base arm (the default; named so A/B run logs "
+        "are self-labelling)",
+    )
+    ap.add_argument(
+        "--table-depth", type=int, default=8,
+        help="--precomp: table levels per family (the ZKP2P_MSM_PRECOMP_DEPTH "
+        "dial; q = ceil(W/depth) hot-loop windows remain)",
     )
     ba_grp = ap.add_mutually_exclusive_group()
     ba_grp.add_argument(

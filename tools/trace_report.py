@@ -284,6 +284,11 @@ def _runs_detail(
                 "gates": m.get("gates", {}),
                 "execution_digest": m.get("execution_digest"),
                 "tpu_probe": m.get("tpu_probe"),
+                # fixed-base table accounting (family geometry + resident
+                # bytes + built-vs-cache provenance) — so a cold start's
+                # precomp_build cost in the stage table is attributable
+                # to the tables it produced
+                "precomp": m.get("precomp"),
             }
         )
     return out
@@ -297,10 +302,19 @@ def _runs_summary(runs: List[dict]) -> str:
     for r in runs:
         k = r["knobs"]
         arms = " ".join(
-            f"{name}={k[name]}" for name in ("msm_glv", "msm_batch_affine", "msm_overlap") if name in k
+            f"{name}={k[name]}"
+            for name in ("msm_glv", "msm_batch_affine", "msm_overlap", "msm_precomp")
+            if name in k
         )
         if r["execution_digest"]:
             arms = f"digest={r['execution_digest']}  {arms}"
+        pm = r.get("precomp")
+        if pm:
+            built = sum(1 for f in pm.get("families", {}).values() if f.get("source") == "built")
+            arms += (
+                f"  precomp_tables={len(pm.get('families', {}))}"
+                f" ({pm.get('total_bytes', 0) / 1e6:.0f} MB, {built} built)"
+            )
         lines.append(f"{r['run_id']}: {r['records']} records  {arms}")
     return "\n".join(lines) or "(no run_ids found)"
 

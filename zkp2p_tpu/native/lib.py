@@ -106,6 +106,10 @@ STATS_FIELDS = (
     "msm_multi_cols",
     "msm_multi_cols_last",
     "msm_multi_prep_ns",
+    "msm_fixed_calls",
+    "msm_fixed_prep_ns",
+    "precomp_build_ns",
+    "precomp_table_bytes",
 )
 
 

@@ -77,6 +77,22 @@ def _lib():
             _u64p, _u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, _u64p, ctypes.c_int, _u64p,
         ]
+        lib.fr_reduce_batch.argtypes = [_u64p, ctypes.c_long]
+        # fixed-base precomputed-window tier (prover.precomp)
+        lib.g1_precomp_build.argtypes = [
+            _u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, _u64p,
+        ]
+        lib.g1_precomp_to52.argtypes = [_u64p, ctypes.c_long, _u64p]
+        lib.g1_precomp_to52.restype = ctypes.c_int
+        lib.g1_msm_pippenger_fixed.argtypes = [
+            _u64p, _u64p, _u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,
+        ]
+        lib.g1_msm_pippenger_fixed_multi.argtypes = [
+            _u64p, _u64p, _u64p, ctypes.c_long, ctypes.c_long, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,
+        ]
         # Self-test the Fr multiplier before trusting proofs to it (the
         # same covenant native/lib.py applies to the Fq side).
         a, b = R - 987654321, 0xFEDCBA9876543210 << 128 | 0x42
@@ -215,6 +231,36 @@ def _use_msm_multi() -> bool:
     from ..utils.config import load_config
 
     return record_arm("native_msm_multi", load_config().msm_multi)
+
+
+def _use_msm_precomp() -> bool:
+    """Fixed-base precomputed-window MSM gate (ZKP2P_MSM_PRECOMP,
+    default ON): the frozen G1 families prove from offline level tables
+    (prover.precomp) instead of re-running the GLV split + base
+    conversion + variable-base fill; =0 falls back to the existing
+    drivers — the byte-parity oracle arm.  Fresh-read per prove and
+    record_arm-audited, so A/B digests distinguish the arms."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("native_msm_precomp", load_config().msm_precomp)
+
+
+def _witness_std_u64(lib, witness: Sequence[int]) -> np.ndarray:
+    """Witness ints -> standard-form (n, 4) u64 MSM scalars, reduced
+    mod r IN THE NATIVE LIBRARY (docs/NEXT.md lever 3): raw 256-bit
+    serialization here, `fr_reduce_batch` there — the per-element
+    Python `w % R` this replaces was ~half the witness_convert stage.
+    Values a 256-bit window cannot hold (negative or >= 2^256 — no
+    in-tree witness builder emits them) fall back to the exact Python
+    reduction."""
+    try:
+        buf = b"".join(int(w).to_bytes(32, "little") for w in witness)
+    except (OverflowError, ValueError):
+        return np.ascontiguousarray(_scalars_to_u64([w % R for w in witness]))
+    arr = np.frombuffer(buf, dtype="<u8").reshape(len(witness), 4).copy()
+    lib.fr_reduce_batch(_p(arr), arr.shape[0])
+    return np.ascontiguousarray(arr)
 
 
 def _native_ifma_tier() -> bool:
@@ -358,7 +404,7 @@ def prove_native(
 
     # Witness: standard-form u64x4 (MSM scalars) + Montgomery (matvec).
     with trace("native/witness_convert"):
-        w_std = np.ascontiguousarray(_scalars_to_u64([w % R for w in witness]))
+        w_std = _witness_std_u64(lib, witness)
         n_wires = w_std.shape[0]
         # inferred-width guard, vectorized over the limb view
         _check_inferred_widths(dpk, witness, w_std=w_std)
@@ -405,11 +451,27 @@ def prove_native(
     threads = _n_threads()
 
     glv = _use_glv()
+    # Fixed-base precomputed tables for the frozen G1 families: resolved
+    # ONCE per key (built or cache-loaded on first prove), then each
+    # family's MSM is pure digit scatter + gather/add — the GLV split
+    # and base conversion leave the hot loop entirely.  Families the
+    # budget guard skipped fall through to the variable-base path below.
+    from .precomp import precomputed_for
+
+    ptables = precomputed_for(dpk) if _use_msm_precomp() else None
 
     def msm_g1(bases, scalars: np.ndarray, tag: str):
+        fam = ptables.families.get(tag) if ptables is not None else None
         with trace(f"native/msm_{tag}"):
             out = np.zeros(8, dtype=np.uint64)
-            if glv:
+            if fam is not None:
+                n = min(fam.n, scalars.shape[0])
+                sc = np.ascontiguousarray(scalars[:n])
+                lib.g1_msm_pippenger_fixed(
+                    _p(fam.table), fam.p52(), _p(sc), n, fam.n, fam.levels,
+                    fam.c, fam.q, threads, _p(out),
+                )
+            elif glv:
                 b = _g1_bases_glv_u64(bases)
                 nb = b.shape[0] // 2  # phi half offset in the cached doubled set
                 n = min(nb, scalars.shape[0])
@@ -556,7 +618,7 @@ def prove_native_batch(
     w_cols, w_monts = [], []
     for witness in witnesses:
         with trace("native/witness_convert"):
-            w_std = np.ascontiguousarray(_scalars_to_u64([w % R for w in witness]))
+            w_std = _witness_std_u64(lib, witness)
             n_wires = w_std.shape[0]
             _check_inferred_widths(dpk, witness, w_std=w_std)
             w_mont = np.zeros_like(w_std)
@@ -608,11 +670,26 @@ def prove_native_batch(
         return d_cols
 
     # Phase 2: the MSMs.  a/b1/c/h each ride ONE multi-column call over
-    # the fixed (memoized) bases; b2 stays a per-proof G2 MSM.
+    # the fixed (memoized) bases; b2 stays a per-proof G2 MSM.  With
+    # precomp armed, a family's call is the fixed-table multi driver —
+    # S digit scatters over ONE persistent table, sharing the same
+    # batch-affine inversion rounds the variable-base multi path built.
+    from .precomp import precomputed_for
+
+    ptables = precomputed_for(dpk) if _use_msm_precomp() else None
+
     def msm_g1_multi(bases, cols, tag: str):
+        fam = ptables.families.get(tag) if ptables is not None else None
         with trace(f"native/msm_{tag}", cols=len(cols)):
             out = np.zeros((S, 8), dtype=np.uint64)
-            if glv:
+            if fam is not None:
+                n = min(fam.n, cols[0].shape[0])
+                sc = np.ascontiguousarray(np.stack([np.asarray(col[:n]) for col in cols]))
+                lib.g1_msm_pippenger_fixed_multi(
+                    _p(fam.table), fam.p52(), _p(sc), n, fam.n, S, fam.levels,
+                    fam.c, fam.q, threads, _p(out),
+                )
+            elif glv:
                 b = _g1_bases_glv_u64(bases)
                 nb = b.shape[0] // 2
                 n = min(nb, cols[0].shape[0])

@@ -1,0 +1,432 @@
+"""Fixed-base precomputed-window tables for the frozen proving key.
+
+The proving key's G1 base arrays (a/b1/c/h) are immutable for the life
+of a service, yet every prove re-ran the GLV split, the mont256 ->
+mont260 base conversion, and a full Pippenger bucket fill over them.
+This module trades that per-prove work for offline tables (the standard
+fixed-key-server move — rapidsnark-style servers; SZKP / if-ZKP in
+PAPERS.md schedule their accelerators around exactly this):
+
+  level j of a family's table holds  L_j[i] = 2^(j*q*c) * P_i
+
+built ONCE per (key, window c, stride q, depth levels) by the native
+`g1_precomp_build`, persisted under `.bench_cache/` keyed by the family
+key hash + geometry, and converted once per process to the persistent
+52-limb form the IFMA fill consumes.  The per-prove MSM is then pure
+digit scatter + table gather + batch-affine bucket adds
+(csrc g1_msm_pippenger_fixed / _fixed_multi) — no GLV split, no base
+conversion, no multiple recomputation in the hot loop.
+
+Geometry: a 254-bit scalar recodes into W = ceil-over-255-bits signed
+base-2^c digits; `levels = ceil(W / q)` table copies buy a hot loop of
+only q windows.  Depth is the RAM dial — each level costs n * 64 B on
+disk and n * 144 B resident on the IFMA tier (mont256 + 52-limb;
+n * 64 B on scalar-tier hosts, which keep no 52-limb form) — bounded
+by the
+`ZKP2P_MSM_PRECOMP_MAX_MB` budget guard: a family that cannot fit even
+one level falls through to the existing variable-base path.  All four
+G1 families are eligible by default, h included: the measured h arm
+(full-width ladder scalars) still beats the GLV variable-base driver
+~1.25x at the bench shape, and the witness families (0/1-heavy venmo
+wires) measure ~1.6x (docs/TUNING.md has the sweep).
+
+Cache invalidation is BY CONSTRUCTION: the family key hash (sha256 of
+the converted base bytes) and the (c, q, levels) geometry are part of
+the filename, so a retuned window, a different depth, or a different
+key resolves to a different file and triggers a fresh build.  At load,
+level 0 (a verbatim copy of the bases) is compared directly and the
+higher levels are spot-checked by walking the doubling chain for
+sampled points on the host curve — a corrupt, foreign, or bit-rotted
+file rebuilds instead of proving garbage.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+
+# The G1 MSM families of a DeviceProvingKey eligible for tables (b2 is
+# G2 — no fixed G2 tier).  Order fixed: the budget guard admits families
+# in this order, so under memory pressure the witness-heavy a query and
+# the dominant h query are the last to degrade.
+G1_FAMILIES = ("h", "a", "b1", "c")
+
+
+def fixed_nwin(c: int) -> int:
+    """Windows the fixed tier recodes into at width c: ceil(254/c)
+    bumped until W*c >= 255 (the signed top-window carry bit) — the
+    exact mirror of csrc fixed_nwin, asserted by the parity tests."""
+    W = (254 + c - 1) // c
+    while W * c < 255:
+        W += 1
+    return W
+
+
+def _pick_window_fixed(n: int, threads: int = 1) -> int:
+    """Window for the PRECOMPUTED tier.  Doublings are free (they live
+    in the tables) and only q windows of suffix remain, so the curve
+    sits wider than the variable-base pickers; the ceiling is the
+    per-window bucket block (2^(c-1) x 80 B) falling out of cache —
+    c=18 already measured BELOW the GLV baseline at 2^19.  Interleaved
+    min-of-5 sweep on the driver box (threads=2, distinct points):
+      full-width scalars: c16/q2 1.17-1.27x vs GLV, c15/q2 1.22x,
+                          c14/q2 1.04x, c17+ <= 1.08x
+      narrow 90/10 mix:   c16/q2 1.62-1.64x, c15/q2 1.61x
+    c=16 wins or ties both shapes at the bench scale; below sweep
+    coverage the variable-base heuristic (+2 for the free doublings)
+    applies."""
+    del threads  # q, not c, is the parallel-axis dial for this tier
+    bl = n.bit_length()
+    if bl >= 15:
+        return 16
+    return max(5, min(16, bl - 3))
+
+
+def _resolve_geometry(
+    n: int, depth: int, budget_bytes: int
+) -> Optional[Tuple[int, int, int]]:
+    """(c, q, levels) for a family of n points under the RAM budget, or
+    None when even a one-level table does not fit.  Depth caps levels;
+    q = ceil(W / levels) keeps levels * q >= W (the csrc cover bound).
+    Resident cost per row: mont256 64 B, plus the Aff52 80 B only where
+    the IFMA tier will actually keep a 52-limb form — charging 144 B on
+    a scalar-tier host would shallow or skip families at 2.25x their
+    real footprint."""
+    from ..native.lib import ifma_available
+
+    row_bytes = 144 if ifma_available() else 64
+    c = _pick_window_fixed(n)
+    W = fixed_nwin(c)
+    levels = max(1, min(depth, W))
+    q = (W + levels - 1) // levels
+    levels = (W + q - 1) // q
+    while levels > 1 and (levels * n) * row_bytes > budget_bytes:
+        q += 1
+        levels = (W + q - 1) // q
+    if (levels * n) * row_bytes > budget_bytes:
+        return None
+    return c, q, levels
+
+
+@dataclass
+class FamilyTable:
+    """One family's resident tables + geometry (a row of the manifest)."""
+
+    family: str
+    table: np.ndarray  # (levels*n, 8) u64, affine Montgomery
+    table52: Optional[np.ndarray]  # (levels*n, 10) u64 Aff52, or None
+    n: int
+    levels: int
+    c: int
+    q: int
+    source: str  # "built" | "cache"
+    key_hash: str
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.table.nbytes) + (
+            int(self.table52.nbytes) if self.table52 is not None else 0
+        )
+
+    def p52(self):
+        """ctypes pointer to the 52-limb table (NULL on scalar tier —
+        the C driver then reads mont256 and converts nothing)."""
+        return self.table52.ctypes.data_as(_u64p) if self.table52 is not None else None
+
+
+@dataclass
+class PrecomputedKey:
+    """All fixed-base tables attached to one DeviceProvingKey."""
+
+    families: Dict[str, FamilyTable]
+    skipped: Dict[str, str]  # family -> reason ("budget", ...)
+
+    def table_bytes(self) -> int:
+        return sum(f.nbytes for f in self.families.values())
+
+    def manifest(self) -> Dict:
+        """JSON-able summary for the run manifest / flight recorder."""
+        return {
+            "families": {
+                name: {
+                    "n": f.n,
+                    "levels": f.levels,
+                    "c": f.c,
+                    "q": f.q,
+                    "bytes": f.nbytes,
+                    "ifma52": f.table52 is not None,
+                    "source": f.source,
+                    "key_hash": f.key_hash,
+                }
+                for name, f in self.families.items()
+            },
+            "skipped": dict(self.skipped),
+            "total_bytes": self.table_bytes(),
+        }
+
+
+# One PrecomputedKey per DeviceProvingKey identity, lock-guarded like
+# native_prove._bases_memo (the overlap task-graph resolves tables from
+# worker threads); entries pin the dpk so an id() cannot be reused while
+# its entry is alive.  Small cap bounds test-suite churn.
+_pk_cache: Dict[int, Tuple[object, PrecomputedKey]] = {}
+_PK_CACHE_CAP = 4
+_pk_lock = threading.Lock()
+# serializes table RESOLUTION (build or disk load): two service threads
+# hitting the same cold key must not each run a multi-minute build —
+# the second waits and takes the first's memo entry.  Builds are
+# once-per-key rare, so one global lock (not per-key) is enough.
+_build_lock = threading.Lock()
+
+# live manifest of the newest resolution — the run-manifest hook
+# (utils.metrics.run_manifest) reads this without touching the cache
+_last_manifest: Optional[Dict] = None
+
+
+def precomp_manifest() -> Optional[Dict]:
+    """Manifest of the most recently resolved PrecomputedKey (None
+    until a precomp-armed prove ran) — stamped into run manifests so
+    table memory is attributable in every trace/bench artifact."""
+    return _last_manifest
+
+
+def reset() -> None:
+    """Drop memoized tables + manifest (tests)."""
+    global _last_manifest
+    with _pk_lock:
+        _pk_cache.clear()
+    _last_manifest = None
+
+
+def _cache_dir() -> Optional[str]:
+    """Table persistence root: ZKP2P_MSM_PRECOMP_CACHE, else the repo's
+    .bench_cache; "0" disables persistence (build-only, in-RAM)."""
+    from ..utils.config import load_config
+
+    v = load_config().precomp_cache
+    if v == "0":
+        return None
+    if v:
+        return v
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, ".bench_cache")
+
+
+def _family_bases_u64(dpk, family: str) -> np.ndarray:
+    from .native_prove import _g1_bases_u64
+
+    return _g1_bases_u64(getattr(dpk, f"{family}_bases"))
+
+
+def _key_hash(bases_u64: np.ndarray) -> str:
+    """sha256 over the FULL converted base bytes (16 hex chars).  Full,
+    not sampled: the hash is the cache-invalidation key, and a stale
+    table for a one-point-different key would prove garbage caught only
+    at verify.  ~0.2 s at the 2^19 bench shape, once per process."""
+    h = hashlib.sha256()
+    h.update(np.asarray(bases_u64.shape, dtype=np.int64).tobytes())
+    h.update(np.ascontiguousarray(bases_u64).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _cache_path(cache_dir: str, family: str, key_hash: str, c: int, q: int, levels: int) -> str:
+    return os.path.join(
+        cache_dir, f"precomp_g1_{family}_{key_hash}_c{c}q{q}L{levels}.npy"
+    )
+
+
+def _mont_row_to_point(row: np.ndarray):
+    """One affine-Montgomery table row (8 u64: x limbs, y limbs) ->
+    integer (x, y), or None for the (0, 0) infinity hole."""
+    from ..field.bn254 import from_mont
+
+    x_m = int.from_bytes(np.ascontiguousarray(row[:4]).tobytes(), "little")
+    y_m = int.from_bytes(np.ascontiguousarray(row[4:]).tobytes(), "little")
+    if x_m == 0 and y_m == 0:
+        return None
+    return (from_mont(x_m), from_mont(y_m))
+
+
+def _load_table(path: str, bases: np.ndarray, c: int, q: int, levels: int) -> Optional[np.ndarray]:
+    """Load + integrity-check a persisted table; None on any mismatch
+    (shape drift, foreign file, torn write, flipped bit) — the caller
+    rebuilds.  Level 0 is a verbatim copy of the bases and is compared
+    in FULL (the fill reads every level-0 row, so a sample is not
+    enough), and the HIGHER levels are spot-checked by walking the
+    doubling chain L_j = 2^(q*c) * L_{j-1} for a few sampled points on
+    the host curve ((levels-1)*q*c Python doublings per sample, tens of
+    ms per family) and comparing every level's row — a bit flipped
+    anywhere in a sampled column rebuilds instead of proving garbage.
+    Pure host math: no native calls, so a warm start keeps the
+    `precomp_build_ns == 0` accounting contract."""
+    from ..curve.host import g1_double
+
+    n = bases.shape[0]
+    try:
+        table = np.load(path)
+    except Exception:  # noqa: BLE001 — a corrupt cache must rebuild, not raise
+        return None
+    if table.shape != (levels * n, 8) or table.dtype != np.uint64:
+        return None
+    # full level-0 compare, not a sample: the fill reads EVERY level-0
+    # row, and the bases are already resident — ~10 ms at 2^19 rows
+    if not np.array_equal(table[:n], bases):
+        return None
+    for i in {0, n // 2, n - 1}:
+        pt = _mont_row_to_point(bases[i])
+        for lv in range(1, levels):
+            if pt is not None:
+                for _ in range(q * c):
+                    pt = g1_double(pt)
+            if _mont_row_to_point(table[lv * n + i]) != pt:
+                return None
+    return np.ascontiguousarray(table)
+
+
+def _persist_table(path: str, table: np.ndarray) -> None:
+    """Atomic write (tmp + rename): service workers may race a cold
+    start; a half-written file must never be loadable."""
+    tmp = f"{path}.tmp.{os.getpid()}.npy"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "wb") as f:
+            np.save(f, table)
+        os.replace(tmp, path)
+    except OSError:
+        # persistence is an optimization; the in-RAM table is already
+        # correct and the next cold start simply rebuilds
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+
+
+def _build_family(lib, dpk, family: str, geom, cache_dir, threads: int) -> FamilyTable:
+    from ..utils.trace import trace
+
+    c, q, levels = geom
+    bases = _family_bases_u64(dpk, family)
+    n = int(bases.shape[0])
+    kh = _key_hash(bases)
+    persist = cache_dir is not None and n >= _persist_min()
+    path = _cache_path(cache_dir, family, kh, c, q, levels) if persist else None
+
+    table = None
+    source = "cache"
+    if path is not None and os.path.exists(path):
+        with trace("native/precomp_load", family=family):
+            table = _load_table(path, bases, c, q, levels)
+    if table is None:
+        source = "built"
+        with trace("native/precomp_build", family=family):
+            table = np.zeros((levels * n, 8), dtype=np.uint64)
+            lib.g1_precomp_build(
+                bases.ctypes.data_as(_u64p), n, c, q, levels, threads,
+                table.ctypes.data_as(_u64p),
+            )
+        if path is not None:
+            _persist_table(path, table)
+
+    # the persistent 52-limb form (per process, never persisted: it is
+    # one cheap conversion pass — 0.4 s at 8 x 2^19 rows — and keying
+    # the disk cache by IFMA arm would double the files for no build
+    # savings).  Scalar tier: the C driver reads mont256 directly.
+    table52: Optional[np.ndarray] = np.zeros((levels * n, 10), dtype=np.uint64)
+    if not lib.g1_precomp_to52(
+        table.ctypes.data_as(_u64p), levels * n, table52.ctypes.data_as(_u64p)
+    ):
+        table52 = None
+    return FamilyTable(
+        family=family, table=table, table52=table52, n=n, levels=levels,
+        c=c, q=q, source=source, key_hash=kh,
+    )
+
+
+def _persist_min() -> int:
+    from ..utils.config import load_config
+
+    return load_config().precomp_persist_min
+
+
+def precomputed_for(dpk) -> Optional[PrecomputedKey]:
+    """The PrecomputedKey for this DeviceProvingKey — memoized per key
+    identity; built (or cache-loaded) on first use.  None when the
+    native library is unavailable.  Callers gate on ZKP2P_MSM_PRECOMP
+    (native_prove._use_msm_precomp) BEFORE calling: resolution is not
+    free the first time."""
+    from .native_prove import _lib
+
+    lib = _lib()
+    if lib is None:
+        return None
+    key = id(dpk)
+    with _pk_lock:
+        hit = _pk_cache.get(key)
+        if hit is not None and hit[0] is dpk:
+            return hit[1]
+
+    with _build_lock:
+        # re-check under the build lock: a concurrent caller may have
+        # finished the build while this thread waited
+        with _pk_lock:
+            hit = _pk_cache.get(key)
+            if hit is not None and hit[0] is dpk:
+                return hit[1]
+        return _resolve(lib, dpk, key)
+
+
+def _resolve(lib, dpk, key: int) -> PrecomputedKey:
+    global _last_manifest
+    from ..utils.config import load_config
+    from ..utils.metrics import REGISTRY
+    from .native_prove import _n_threads
+
+    cfg = load_config()
+    budget = int(cfg.precomp_max_mb) << 20
+    cache_dir = _cache_dir()
+    threads = _n_threads()
+    families: Dict[str, FamilyTable] = {}
+    skipped: Dict[str, str] = {}
+    for family in G1_FAMILIES:
+        if family not in [f.strip() for f in cfg.precomp_families.split(",") if f.strip()]:
+            skipped[family] = "config"
+            continue
+        bases = _family_bases_u64(dpk, family)
+        n = int(bases.shape[0])
+        if n == 0:
+            skipped[family] = "empty"
+            continue
+        geom = _resolve_geometry(n, int(cfg.precomp_depth), budget)
+        if geom is None:
+            skipped[family] = "budget"
+            continue
+        ft = _build_family(lib, dpk, family, geom, cache_dir, threads)
+        families[family] = ft
+        budget -= ft.nbytes
+
+    pk = PrecomputedKey(families=families, skipped=skipped)
+    with _pk_lock:
+        if len(_pk_cache) >= _PK_CACHE_CAP:
+            _pk_cache.pop(next(iter(_pk_cache)))
+        _pk_cache[key] = (dpk, pk)
+        live = [entry[1] for entry in _pk_cache.values()]
+    # memory accounting: the gauges cover ALL resident tables (the memo
+    # holds up to _PK_CACHE_CAP keys), summed per family across live
+    # entries and zeroed where no live key tables that family — a
+    # second key resolving must not understate what the first still
+    # pins, nor leave an evicted key's bytes on the board
+    for name in G1_FAMILIES:
+        nbytes = sum(p.families[name].nbytes for p in live if name in p.families)
+        REGISTRY.gauge("zkp2p_precomp_table_bytes", {"family": name}).set(nbytes)
+    REGISTRY.gauge("zkp2p_precomp_total_bytes").set(sum(p.table_bytes() for p in live))
+    _last_manifest = pk.manifest()
+    return pk

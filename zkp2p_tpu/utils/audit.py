@@ -335,11 +335,17 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     _batch_chunk_size()
 
     from ..native.lib import get_lib
-    from ..prover.native_prove import _use_batch_affine, _use_glv, _use_msm_multi
+    from ..prover.native_prove import (
+        _use_batch_affine,
+        _use_glv,
+        _use_msm_multi,
+        _use_msm_precomp,
+    )
 
     _use_glv()
     _use_batch_affine()
     _use_msm_multi()
+    _use_msm_precomp()
     native_ok = False
     try:
         native_ok = get_lib() is not None

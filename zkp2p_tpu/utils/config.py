@@ -114,6 +114,37 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     # Fresh-read per batch (the gate resolves through load_config at the
     # prove_native_batch call site), so one process can A/B both arms.
     "msm_multi": ("ZKP2P_MSM_MULTI", _not_zero, True),
+    # Fixed-base precomputed-window MSM tier (prover.precomp): the
+    # frozen proving-key G1 families resolve to offline level tables at
+    # first prove (persisted under .bench_cache/, keyed by key hash +
+    # geometry), and the per-prove hot loop becomes pure table gather +
+    # batch-affine bucket adds — no GLV split, no base conversion.
+    # Default ON (the measured-faster arm at the bench shape); "0"
+    # falls back to the variable-base drivers — the byte-parity oracle
+    # arm.  Fresh-read per prove, so one process can A/B both arms.
+    "msm_precomp": ("ZKP2P_MSM_PRECOMP", _not_zero, True),
+    # table depth: max level copies per family (levels = ceil(W/q);
+    # deeper tables = fewer hot-loop windows, more RAM — each level is
+    # n x 144 B resident / n x 64 B on disk per family).  Build COST is
+    # depth-invariant (~(W-q)*c doublings per point either way), so the
+    # dial trades only memory against hot-loop windows.
+    "precomp_depth": ("ZKP2P_MSM_PRECOMP_DEPTH", _pos_int(8), 8),
+    # RAM budget guard for the resident tables (mont256 + 52-limb forms,
+    # summed over families, in MiB).  A family that exceeds the budget
+    # degrades to a shallower table; one that cannot fit even one level
+    # falls through to the variable-base path and is recorded as
+    # "skipped: budget" in the run manifest.
+    "precomp_max_mb": ("ZKP2P_MSM_PRECOMP_MAX_MB", _pos_int(6144), 6144),
+    # persistence root for built tables ("" = <repo>/.bench_cache,
+    # "0" = never persist) and the minimum family size that persists at
+    # all — tiny test keys rebuild in microseconds and must not litter
+    # the shared cache dir.
+    "precomp_cache": ("ZKP2P_MSM_PRECOMP_CACHE", str, ""),
+    "precomp_persist_min": ("ZKP2P_MSM_PRECOMP_PERSIST_MIN", _pos_int(65536), 65536),
+    # which G1 families ride tables.  h included by default: the
+    # full-width ladder scalars still measure ~1.25x over the GLV
+    # variable-base arm at the bench shape (docs/TUNING.md sweep).
+    "precomp_families": ("ZKP2P_MSM_PRECOMP_FAMILIES", str, "a,b1,c,h"),
     # proof-batch sub-chunking: "auto" (4 per chunk on a real TPU — the
     # 16 GB HBM budget; whole batch elsewhere), "0" (never chunk), or an
     # explicit chunk size.  r5 bench1 on-chip: the batched h-evals stage
@@ -149,7 +180,10 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
 # whitelist, promoted here so there is a single list).
-ARMABLE = ("msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap", "msm_multi")
+ARMABLE = (
+    "msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap",
+    "msm_multi", "msm_precomp",
+)
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
 
@@ -164,6 +198,12 @@ class ProverConfig:
     msm_overlap: bool = True
     msm_batch_affine: bool = True
     msm_multi: bool = True
+    msm_precomp: bool = True
+    precomp_depth: int = 8
+    precomp_max_mb: int = 6144
+    precomp_cache: str = ""
+    precomp_persist_min: int = 65536
+    precomp_families: str = "a,b1,c,h"
     batch_chunk: str = "auto"
     field_conv: str = "matmul"
     field_mul: str = "auto"

@@ -338,6 +338,17 @@ def run_manifest() -> Dict:
     probe = last_probe()
     if probe is not None:
         man["tpu_probe"] = probe
+    # fixed-base precomputed-table memory accounting (prover.precomp):
+    # per-family geometry + resident bytes + build-vs-cache provenance,
+    # so table RAM is attributable in every trace/bench artifact
+    try:
+        from ..prover.precomp import precomp_manifest
+
+        pm = precomp_manifest()
+        if pm is not None:
+            man["precomp"] = pm
+    except Exception:  # noqa: BLE001 — attribution must not break a dump
+        pass
     return man
 
 
