@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -47,6 +47,15 @@ precomp-cache: native
 	dpk, vk = bench.build_keys(cs); \
 	pk = precomputed_for(dpk); \
 	import json; print(json.dumps(precomp_manifest(), indent=1))"
+
+# Chaos smoke (fast; tier-1 resident): 2 subprocess workers on one
+# spool, 1 SIGKILL landed mid-prove (victim chosen by reading the pid
+# out of a live .claim file), faults injected at 4 sites — then the
+# global invariant is asserted: every request in exactly one terminal
+# state, every proof pairing-verifies, no duplicate terminal records.
+# See docs/ROBUSTNESS.md §chaos harness; ~25 s on the 2-core box.
+chaos-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_chaos.py -q
 
 # Execution-path preflight (docs/OBSERVABILITY.md §execution audit):
 # probe the backend, arm EVERY gate through its real resolver, print

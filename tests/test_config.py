@@ -57,6 +57,11 @@ def test_env_overrides_every_knob():
         "ZKP2P_METRICS_ADDR": "0.0.0.0",
         "ZKP2P_METRICS_SINK": "/tmp/sink.jsonl",
         "ZKP2P_TRACE_MAX": "1024",
+        "ZKP2P_FAULTS": "prove:raise:p=0.5,emit:enospc:once",
+        "ZKP2P_DEADLINE_S": "30",
+        "ZKP2P_SPOOL_CAP": "256",
+        "ZKP2P_PROVE_RETRIES": "5",
+        "ZKP2P_RETRY_BACKOFF_S": "0.5",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -73,6 +78,9 @@ def test_env_overrides_every_knob():
     assert cfg.native_ifma is False and cfg.native_threads == 7 and cfg.no_cache is True
     assert cfg.metrics_port == 9464 and cfg.metrics_sink == "/tmp/sink.jsonl" and cfg.trace_max == 1024
     assert cfg.metrics_addr == "0.0.0.0"
+    assert cfg.faults == "prove:raise:p=0.5,emit:enospc:once"
+    assert cfg.deadline_s == 30.0 and cfg.spool_cap == 256
+    assert cfg.prove_retries == 5 and cfg.retry_backoff_s == 0.5
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -91,6 +99,15 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_METRICS_PORT": "9464"}).metrics_port == 9464
     # trace ring bound keeps the committed default on malformed input
     assert load_config(environ={"ZKP2P_TRACE_MAX": "junk"}).trace_max == 65536
+    # fault-tolerance seconds/count knobs: 0 is meaningful (disabled /
+    # unlimited / no retries), negatives clamp, malformed keeps defaults
+    assert load_config(environ={"ZKP2P_DEADLINE_S": "0"}).deadline_s == 0.0
+    assert load_config(environ={"ZKP2P_DEADLINE_S": "-3"}).deadline_s == 0.0
+    assert load_config(environ={"ZKP2P_DEADLINE_S": "junk"}).deadline_s == 0.0
+    assert load_config(environ={"ZKP2P_SPOOL_CAP": "junk"}).spool_cap == 0
+    assert load_config(environ={"ZKP2P_PROVE_RETRIES": "0"}).prove_retries == 0
+    assert load_config(environ={"ZKP2P_PROVE_RETRIES": "junk"}).prove_retries == 2
+    assert load_config(environ={"ZKP2P_RETRY_BACKOFF_S": "junk"}).retry_backoff_s == 0.25
 
 
 def test_armed_flags_whitelist_and_precedence(tmp_path):

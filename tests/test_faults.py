@@ -1,0 +1,170 @@
+"""Fault-injection framework (utils.faults): the ZKP2P_FAULTS grammar,
+deterministic firing, once/n/after accounting, the unset fast path, and
+the audit-gate arming that keeps chaos runs digest-distinguishable from
+clean ones.  docs/ROBUSTNESS.md §fault injection is the prose contract.
+"""
+
+import pytest
+
+from zkp2p_tpu.utils import faults
+from zkp2p_tpu.utils.faults import FaultInjected, fault_point, parse_faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan(monkeypatch):
+    """Every test starts with ZKP2P_FAULTS unset and no cached plan, and
+    leaves nothing armed behind for the rest of the suite."""
+    monkeypatch.delenv("ZKP2P_FAULTS", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ------------------------------------------------------------- grammar
+
+
+def test_parse_sites_actions_and_mods():
+    p = parse_faults("seed=7,prove:raise:p=0.2,emit:enospc:once,witness:hang=3,claim:raise:n=2:after=5")
+    assert p.seed == 7
+    assert sorted(p.by_site) == ["claim", "emit", "prove", "witness"]
+    (f,) = p.by_site["prove"]
+    assert f.action == "raise" and f.p == 0.2 and f.limit is None
+    (f,) = p.by_site["emit"]
+    assert f.action == "enospc" and f.limit == 1
+    (f,) = p.by_site["witness"]
+    assert f.action == "hang" and f.arg == 3.0
+    (f,) = p.by_site["claim"]
+    assert f.limit == 2 and f.after == 5
+    # digest is spec-stable and 8-hex
+    assert p.digest == parse_faults(p.spec).digest
+    assert len(p.digest) == 8 and int(p.digest, 16) >= 0
+
+
+def test_parse_empty_entries_and_multiple_faults_per_site():
+    p = parse_faults(",prove:raise, ,prove:enospc:once,")
+    assert len(p.by_site["prove"]) == 2
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "prove",                    # no action
+        "prove:explode",            # unknown action
+        "prove:raise:q=1",          # unknown modifier
+        "prove:raise:p=2",          # p out of [0,1]
+        "prove:raise:p=x",          # malformed float
+        "prove:hang=abc",           # malformed seconds
+        "prove:hang=-1",            # negative hang
+        "pr0ve:raise",              # bad site token
+        "seed=x",                   # malformed seed
+        "prove:raise:n=x",          # malformed n
+        "prove:raise:after=x",      # malformed after
+        "prove:raise:n=-1",         # negative n: a fault that can NEVER fire
+        "prove:raise:after=-2",     # negative after
+    ],
+)
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_faults(bad)
+
+
+def test_malformed_env_spec_fails_loudly(monkeypatch):
+    """A chaos run that silently injected nothing would 'prove' fault
+    tolerance it never tested — a bad spec must raise at the first
+    fault_point, not be swallowed."""
+    monkeypatch.setenv("ZKP2P_FAULTS", "prove:explode")
+    faults.reset()
+    with pytest.raises(ValueError):
+        fault_point("prove")
+
+
+# ------------------------------------------------------- fire semantics
+
+
+def test_unset_is_noop_and_unknown_site_is_noop(monkeypatch):
+    fault_point("prove")  # unset: must not raise
+    monkeypatch.setenv("ZKP2P_FAULTS", "emit:raise")
+    faults.reset()
+    fault_point("prove")  # armed, but a different site
+    with pytest.raises(FaultInjected):
+        fault_point("emit")
+
+
+def test_once_fires_exactly_once(monkeypatch):
+    monkeypatch.setenv("ZKP2P_FAULTS", "emit:enospc:once")
+    faults.reset()
+    with pytest.raises(OSError) as ei:
+        fault_point("emit")
+    import errno
+
+    assert ei.value.errno == errno.ENOSPC
+    for _ in range(10):
+        fault_point("emit")  # spent
+    assert faults.current_plan().counts()["emit"] == {"seen": 11, "fired": 1}
+
+
+def test_n_and_after_accounting():
+    p = parse_faults("prove:raise:n=2:after=3")
+    fired = []
+    for i in range(10):
+        try:
+            p.fire("prove")
+            fired.append(0)
+        except FaultInjected:
+            fired.append(1)
+    # skips the first 3 eligible hits, then fires exactly n=2 times
+    assert fired == [0, 0, 0, 1, 1, 0, 0, 0, 0, 0]
+
+
+def test_probability_is_deterministic_per_seed():
+    def pattern(spec, n=40):
+        p = parse_faults(spec)
+        out = []
+        for _ in range(n):
+            try:
+                p.fire("prove")
+                out.append(0)
+            except FaultInjected:
+                out.append(1)
+        return out
+
+    a = pattern("seed=3,prove:raise:p=0.3")
+    assert a == pattern("seed=3,prove:raise:p=0.3")  # reruns reproduce
+    assert 0 < sum(a) < 40                            # actually probabilistic
+    assert a != pattern("seed=4,prove:raise:p=0.3")   # seed matters
+
+
+def test_hang_delays_but_does_not_fail(monkeypatch):
+    import time
+
+    monkeypatch.setenv("ZKP2P_FAULTS", "witness:hang=0.05")
+    faults.reset()
+    t0 = time.monotonic()
+    fault_point("witness")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_spec_flip_reparses_and_resets_counters(monkeypatch):
+    monkeypatch.setenv("ZKP2P_FAULTS", "prove:raise:once")
+    faults.reset()
+    with pytest.raises(FaultInjected):
+        fault_point("prove")
+    fault_point("prove")  # spent under this spec
+    monkeypatch.setenv("ZKP2P_FAULTS", "prove:raise:once,seed=1")
+    with pytest.raises(FaultInjected):
+        fault_point("prove")  # fresh plan, fresh counters
+
+
+# ------------------------------------------------------------ auditing
+
+
+def test_faults_gate_armed_with_digest(monkeypatch):
+    from zkp2p_tpu.utils.audit import gate_arms
+
+    assert faults.faults_arm() == "off"
+    assert gate_arms().get("faults") == "off"
+    monkeypatch.setenv("ZKP2P_FAULTS", "prove:raise:p=0.5")
+    faults.reset()
+    arm = faults.faults_arm()
+    assert arm == parse_faults("prove:raise:p=0.5").digest
+    assert gate_arms().get("faults") == arm

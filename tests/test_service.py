@@ -58,7 +58,7 @@ def test_spool_processing(world, tmp_path):
 
     # idempotent: a second sweep finds nothing new
     stats2 = world.process_dir(spool)
-    assert stats2 == {"done": 0, "error-bad-input": 0, "error-failed-to-prove": 0}
+    assert not any(stats2.values())
 
     # emitted proofs verify via the public JSON path
     from zkp2p_tpu.formats.proof_json import load, proof_from_json
@@ -154,7 +154,7 @@ def test_service_restart_resumes_where_it_stopped(batched_world, tmp_path):
     assert stats["done"] == 1  # only the lost one is redone
     assert os.path.exists(os.path.join(spool, "r1.proof.json"))
     stats2 = batched_world.process_dir(spool)
-    assert stats2 == {"done": 0, "error-bad-input": 0, "error-failed-to-prove": 0}
+    assert not any(stats2.values())
 
 
 def _write_reqs(spool, pairs, prefix="r"):

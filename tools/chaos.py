@@ -1,0 +1,323 @@
+"""Chaos harness for the proving service (docs/ROBUSTNESS.md §chaos).
+
+Spawns N worker subprocesses sweeping ONE spool of requests, SIGKILLs
+some of them provably MID-PROVE (the victim is chosen by reading the
+pid out of a live `.claim` file — a worker that demonstrably owns
+in-flight work), injects faults via ZKP2P_FAULTS across the service's
+sites, waits for the survivors to drain the spool, then asserts the
+global invariant the service claims to provide:
+
+  1. every request reached EXACTLY ONE terminal state
+     (.proof.json xor .error.json — never both, never neither);
+  2. every emitted proof pairing-verifies against its public signals,
+     and the public signals match the request payload;
+  3. no request_id has duplicate terminal records in the metrics sink.
+
+Exit 0 = invariant holds; 1 = violated (details in the JSON report on
+stdout).  The circuit is the 2-constraint toy from the service tests —
+chaos exercises the SERVING layer's failure machinery, not the prover's
+arithmetic (the byte-parity suites own that).
+
+    python tools/chaos.py --workers 2 --kills 1 --requests 6 \
+        --faults "seed=7,witness:hang=0.2,prove:raise:p=0.2,emit:enospc:once,claim:raise:p=0.05"
+
+A worker process is this same file with --worker (it builds the
+deterministic toy world, then sweeps until the spool is fully terminal
+or --max-seconds expires).  `make chaos-smoke` runs the tier-1 shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TERMINAL_SUFFIXES = (".proof.json", ".error.json")
+
+
+# ----------------------------------------------------------- toy world
+
+
+def _build_world():
+    """The deterministic 2-constraint circuit (out = (x*y)^2) every
+    worker and the checker rebuild identically (setup seed pins the
+    keys, so a proof emitted by any worker verifies under the checker's
+    vk)."""
+    from zkp2p_tpu.field.bn254 import R
+    from zkp2p_tpu.prover.groth16_tpu import device_pk
+    from zkp2p_tpu.snark.groth16 import setup
+    from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+    cs = ConstraintSystem("chaos")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+    cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
+    cs.compute(z, lambda a, b: a * b % R, [x, y])
+    pk, vk = setup(cs, seed="chaos")
+    dpk = device_pk(pk, cs)
+
+    def witness_fn(payload):
+        xv, yv = int(payload["x"]), int(payload["y"])
+        return cs.witness([pow(xv * yv, 2, R)], {x: xv, y: yv})
+
+    return cs, dpk, vk, witness_fn
+
+
+def _spool_terminal(spool: str) -> bool:
+    for fn in os.listdir(spool):
+        if not fn.endswith(".req.json"):
+            continue
+        base = os.path.join(spool, fn[: -len(".req.json")])
+        if not any(os.path.exists(base + s) for s in TERMINAL_SUFFIXES):
+            return False
+    return True
+
+
+# -------------------------------------------------------------- worker
+
+
+def worker_main(args) -> int:
+    from zkp2p_tpu.pipeline.service import ProvingService
+    from zkp2p_tpu.prover.native_prove import prove_native_batch
+
+    cs, dpk, vk, witness_fn = _build_world()
+    svc = ProvingService(
+        cs, dpk, vk, witness_fn, public_fn=lambda w: [w[1]],
+        batch_size=args.batch,
+        prover_fn=prove_native_batch,
+        stale_claim_s=args.stale_claim_s,
+        retry_backoff_s=0.05,
+    )
+    print(f"[chaos-worker {os.getpid()}] up, sweeping {args.spool}", flush=True)
+    deadline = time.time() + args.max_seconds
+    while time.time() < deadline:
+        stats = svc.process_dir(args.spool)
+        if any(stats.values()):
+            print(f"[chaos-worker {os.getpid()}] {stats}", flush=True)
+        if _spool_terminal(args.spool):
+            print(f"[chaos-worker {os.getpid()}] spool terminal, exiting", flush=True)
+            return 0
+        time.sleep(args.poll_s)
+    print(f"[chaos-worker {os.getpid()}] max-seconds expired", flush=True)
+    return 2
+
+
+# ----------------------------------------------------------- invariant
+
+
+def check_invariants(spool: str, vk=None) -> dict:
+    """The global invariant (docs/ROBUSTNESS.md): returns a report dict
+    with `violations` (empty = invariant holds).  Standalone-callable on
+    any spool a chaos (or production) run left behind."""
+    from zkp2p_tpu.field.bn254 import R
+    from zkp2p_tpu.formats.proof_json import load, proof_from_json
+    from zkp2p_tpu.snark.groth16 import verify
+
+    if vk is None:
+        _, _, vk, _ = _build_world()
+    violations = []
+    states = {}
+    verified = 0
+    rids = []
+    for fn in sorted(os.listdir(spool)):
+        if not fn.endswith(".req.json"):
+            continue
+        rid = fn[: -len(".req.json")]
+        rids.append(rid)
+        base = os.path.join(spool, rid)
+        has_proof = os.path.exists(base + ".proof.json")
+        has_error = os.path.exists(base + ".error.json")
+        if has_proof and has_error:
+            violations.append(f"{rid}: BOTH proof and error artifacts")
+        elif not has_proof and not has_error:
+            violations.append(f"{rid}: NO terminal state")
+        states[rid] = "done" if has_proof else ("error" if has_error else "open")
+        if has_proof:
+            try:
+                proof = proof_from_json(load(base + ".proof.json"))
+                pub = [int(v) for v in load(base + ".public.json")]
+                with open(base + ".req.json") as f:
+                    payload = json.load(f)
+                want = [pow(int(payload["x"]) * int(payload["y"]), 2, R)]
+                if pub != want:
+                    violations.append(f"{rid}: public signals {pub} != payload-derived {want}")
+                elif not verify(vk, proof, pub):
+                    violations.append(f"{rid}: proof FAILED pairing verification")
+                else:
+                    verified += 1
+            except Exception as e:  # noqa: BLE001 — torn artifact = violation
+                violations.append(f"{rid}: unreadable proof artifacts ({e})")
+
+    # terminal records: at most one per rid across every worker's sink
+    # writes (the sink is shared, O_APPEND, line-atomic).  Missing
+    # records are legal (sink faults, SIGKILL between artifact and
+    # record) — duplicates are not.
+    rec_counts: dict = {}
+    sink = spool.rstrip("/") + ".metrics.jsonl"
+    for path in [sink] + [f"{sink}.{i}" for i in range(1, 4)]:
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    violations.append(f"{os.path.basename(path)}: torn sink line")
+                    continue
+                if rec.get("type") == "request":
+                    rec_counts[rec["request_id"]] = rec_counts.get(rec["request_id"], 0) + 1
+    for rid, n in sorted(rec_counts.items()):
+        if n > 1:
+            violations.append(f"{rid}: {n} terminal records (duplicate)")
+
+    counts: dict = {}
+    for s in states.values():
+        counts[s] = counts.get(s, 0) + 1
+    return {
+        "requests": len(rids),
+        "states": counts,
+        "proofs_verified": verified,
+        "terminal_records": sum(rec_counts.values()),
+        "violations": violations,
+    }
+
+
+# -------------------------------------------------------------- parent
+
+
+def _live_claim_pids(spool: str) -> list:
+    pids = []
+    for fn in os.listdir(spool):
+        if fn.endswith(".claim"):
+            try:
+                with open(os.path.join(spool, fn)) as f:
+                    pid = json.load(f).get("pid")
+                if pid:
+                    pids.append(int(pid))
+            except (OSError, ValueError):
+                continue
+    return pids
+
+
+def run_chaos(args) -> dict:
+    import random
+
+    os.makedirs(args.spool, exist_ok=True)
+    rng = random.Random(args.seed)
+    for i in range(args.requests):
+        with open(os.path.join(args.spool, f"q{i:03d}.req.json"), "w") as f:
+            json.dump({"x": rng.randrange(2, 50), "y": rng.randrange(2, 50)}, f)
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ZKP2P_FAULTS"] = args.faults
+    env.pop("ZKP2P_METRICS_SINK", None)  # per-spool sink = the shared record file
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--worker",
+        "--spool", args.spool,
+        "--batch", str(args.batch),
+        "--stale-claim-s", str(args.stale_claim_s),
+        "--max-seconds", str(args.max_seconds),
+        "--poll-s", str(args.poll_s),
+    ]
+    workers = [subprocess.Popen(cmd, env=env, cwd=REPO) for _ in range(args.workers)]
+    print(f"[chaos] {args.workers} workers up: {[w.pid for w in workers]}", flush=True)
+
+    # Kill phase: a victim must provably be MID-PROVE — we take the pid
+    # from a live .claim file.  Never kill the last standing worker (the
+    # invariant needs a survivor to drain the spool).
+    killed = []
+    deadline = time.time() + args.max_seconds
+    while len(killed) < args.kills and time.time() < deadline:
+        alive = [w for w in workers if w.poll() is None and w.pid not in killed]
+        if len(alive) <= 1:
+            break
+        candidates = [p for p in _live_claim_pids(args.spool)
+                      if p in {w.pid for w in alive}]
+        if candidates:
+            victim = candidates[0]
+            os.kill(victim, signal.SIGKILL)
+            killed.append(victim)
+            print(f"[chaos] SIGKILL {victim} (owned a live claim)", flush=True)
+        else:
+            time.sleep(0.02)
+
+    # Drain phase: wait for survivors to finish the spool.
+    rc = {}
+    for w in workers:
+        if w.pid in killed:
+            w.wait()
+            continue
+        remaining = max(1.0, deadline + 15.0 - time.time())
+        try:
+            rc[w.pid] = w.wait(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            w.kill()
+            rc[w.pid] = "timeout"
+
+    report = check_invariants(args.spool)
+    report.update({
+        "workers": args.workers,
+        "kills": len(killed),
+        "killed_pids": killed,
+        "worker_rc": rc,
+        "faults": args.faults,
+    })
+    if args.kills and not killed:
+        report["violations"].append(
+            f"harness: no mid-prove SIGKILL landed (wanted {args.kills})"
+        )
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--spool", default="/tmp/zkp2p_chaos_spool")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--kills", type=int, default=1)
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--stale-claim-s", type=float, default=3.0,
+                    help="claim staleness for takeover; heartbeats keep live claims fresh")
+    ap.add_argument("--max-seconds", type=float, default=90.0)
+    ap.add_argument("--poll-s", type=float, default=0.05)
+    ap.add_argument(
+        "--faults",
+        default="seed=7,witness:hang=0.2,prove:raise:p=0.2,emit:enospc:once,claim:raise:p=0.05",
+        help="ZKP2P_FAULTS spec exported to every worker (>=3 sites for the acceptance shape)",
+    )
+    ap.add_argument("--report", default="",
+                    help="also write the JSON report to this path (stdout is shared "
+                         "with the workers' logs, so machine consumers read the file)")
+    args = ap.parse_args(argv)
+    if args.worker:
+        return worker_main(args)
+    report = run_chaos(args)
+    print(json.dumps(report, indent=1, default=str))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+    if report["violations"]:
+        print(f"[chaos] INVARIANT VIOLATED: {report['violations']}", file=sys.stderr)
+        return 1
+    print(f"[chaos] invariant holds: {report['requests']} requests, "
+          f"{report['proofs_verified']} proofs verified, {report['kills']} kills", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -359,11 +359,15 @@ def cmd_service(args):
         # MSM family) instead of a per-request prove loop
         from ..prover.native_prove import prove_native_batch as prover_fn  # noqa: F811
 
+    # fault-tolerance policy (docs/ROBUSTNESS.md): flags override the
+    # ZKP2P_DEADLINE_S / ZKP2P_SPOOL_CAP config defaults; None defers
+    svc_kw = dict(
+        batch_size=args.batch, prover_fn=prover_fn, prefetch=args.prefetch,
+        stale_claim_s=args.stale_claim_s, deadline_s=args.deadline_s,
+        spool_cap=args.spool_cap,
+    )
     if args.circuit == "venmo":
-        svc = ProvingService.for_venmo(
-            cs, lay, params, dpk, vk, batch_size=args.batch,
-            prover_fn=prover_fn, prefetch=args.prefetch,
-        )
+        svc = ProvingService.for_venmo(cs, lay, params, dpk, vk, **svc_kw)
     else:
 
         def witness_fn(payload):
@@ -375,8 +379,7 @@ def cmd_service(args):
             return cs.witness(inputs.public_signals, inputs.seed)
 
         svc = ProvingService(
-            cs, dpk, vk, witness_fn, lambda w: list(w[1 : cs.num_public + 1]),
-            batch_size=args.batch, prover_fn=prover_fn, prefetch=args.prefetch,
+            cs, dpk, vk, witness_fn, lambda w: list(w[1 : cs.num_public + 1]), **svc_kw
         )
     os.makedirs(args.spool, exist_ok=True)
     _log(f"service sweeping {args.spool} (batch={args.batch})")
@@ -541,6 +544,14 @@ def main(argv=None):
     s.add_argument("--prover", choices=["tpu", "native"], default="tpu",
                    help="tpu: vmapped XLA batch; native: C++ runtime, sequential")
     s.add_argument("--prefetch", type=int, default=1, help="ready-batch queue depth")
+    s.add_argument("--stale-claim-s", type=float, default=300.0,
+                   help="claim age after which a dead worker's request is taken over")
+    s.add_argument("--deadline-s", type=float, default=None,
+                   help="default per-request deadline in s (payload deadline_s overrides; "
+                        "default: ZKP2P_DEADLINE_S; 0 = none)")
+    s.add_argument("--spool-cap", type=int, default=None,
+                   help="max pending requests admitted per sweep — the excess is shed as "
+                        "error-shed (default: ZKP2P_SPOOL_CAP; 0 = unlimited)")
     s.set_defaults(fn=cmd_service)
 
     s = sub.add_parser("serve", help="serve the client order-book UI")

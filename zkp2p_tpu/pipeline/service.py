@@ -2,23 +2,41 @@
 sample -> emit (the BASELINE.json north-star service shape).
 
 Failure semantics mirror the reference UI's explicit state machine
-(`SubmitOrderGenerateProofForm.tsx:45-56,171-220`): each request ends in
-  done | error-bad-input | error-failed-to-prove
+(`SubmitOrderGenerateProofForm.tsx:45-56,171-220`), hardened for a
+fleet (docs/ROBUSTNESS.md): each request ends in exactly one of
+  done | error-bad-input | error-failed-to-prove |
+  error-deadline-exceeded | error-shed
 with the error recorded next to the request — no silent drops; plus the
 verify-after-prove self-check the pipeline scripts do
 (`5_gen_proof.sh:15-22` runs `snarkjs groth16 verify` right after prove).
 
 Requests are JSON files in a spool directory (the S3/queue stand-in);
-results and errors are written alongside.  Single-process, deliberately
-simple: the scheduling story (latency vs batch fill, SURVEY.md §7 hard
-part #6) is a bench-driven knob, not a framework constraint.
+results and errors are written alongside.  Fault tolerance is layered
+(docs/ROBUSTNESS.md has the full ladder):
+
+  transient retries (bounded, exponential backoff)
+    -> batch bisection (a poisoned request terminal-errors ALONE, its
+       batchmates still ship `done`, <= log2(S) extra proves per mate)
+      -> degradation ladder (precomp -> multi -> batch-affine ->
+         sequential, reusing the existing knob gates)
+        -> error-failed-to-prove
+
+plus per-request deadlines (payload `deadline_s` or ZKP2P_DEADLINE_S,
+checked at claim and again at batch assembly) and a spool backlog cap
+(ZKP2P_SPOOL_CAP) that sheds load visibly instead of silently aging
+requests.  Every layer is provable on demand via the fault-injection
+sites (utils.faults, ZKP2P_FAULTS) and the chaos harness
+(tools/chaos.py: N workers, SIGKILLs mid-prove, injected faults, one
+global invariant).
 """
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import queue
+import re
 import threading
 import time
 import traceback
@@ -27,8 +45,90 @@ from typing import Callable, Dict, List, Optional
 
 from ..formats.proof_json import dump
 from ..utils.audit import execution_digest, preflight, sample_device_memory
+from ..utils.faults import FaultInjected, fault_point
 from ..utils.metrics import REGISTRY, JsonlSink, maybe_start_metrics_server, publish_native_stats, run_id, run_manifest
 from ..utils.trace import drain as drain_trace, set_context, trace
+
+# The terminal-state machine (docs/ROBUSTNESS.md): every request ends in
+# EXACTLY ONE of these, recorded as a .proof.json/.error.json artifact
+# plus a request record + requests_total{state} counter.
+TERMINAL_STATES = (
+    "done",
+    "error-bad-input",
+    "error-failed-to-prove",
+    "error-deadline-exceeded",
+    "error-shed",
+)
+
+# A torn .req.json younger than this is left alone for one more sweep —
+# a non-atomic uploader may still be writing it — before the sweep
+# judges it corrupt and terminals error-bad-input.
+TORN_REQ_GRACE_S = 2.0
+
+# Degradation ladder (last resort before error-failed-to-prove): each
+# rung re-proves the isolated request with one more fast path gated off,
+# reusing the existing knob gates — they are fresh-read per prove, so an
+# env overlay flips them for exactly one attempt.  Proof BYTES are
+# knob-invariant (the byte-parity oracles pin every arm), so a ladder
+# rescue emits the same proof the fast path would have.  The overlay is
+# process-global while it is applied; proves are serialized on the
+# consumer thread, so no concurrent prove can observe a half-applied
+# rung (the witness producer never proves).
+_DEGRADATION_LADDER = (
+    ("no-precomp", {"ZKP2P_MSM_PRECOMP": "0"}),
+    ("no-multi", {"ZKP2P_MSM_PRECOMP": "0", "ZKP2P_MSM_MULTI": "0"}),
+    ("no-batch-affine", {
+        "ZKP2P_MSM_PRECOMP": "0", "ZKP2P_MSM_MULTI": "0",
+        "ZKP2P_MSM_BATCH_AFFINE": "0",
+    }),
+    ("sequential", {
+        "ZKP2P_MSM_PRECOMP": "0", "ZKP2P_MSM_MULTI": "0",
+        "ZKP2P_MSM_BATCH_AFFINE": "0", "ZKP2P_MSM_OVERLAP": "0",
+    }),
+)
+
+# Patterns that classify an exception as TRANSIENT (retry-worthy) when
+# its type alone does not: allocator and pool exhaustion surface as
+# RuntimeError text from the C/XLA layers.  Word-bounded: a bare
+# substring scan classified any message merely CONTAINING "pool"
+# ("spool", a path) or "resource" as transient, and a deterministic
+# failure classified transient defer-livelocks in the witness path.
+_TRANSIENT_RE = re.compile(
+    r"\balloc\w*\b|\bpool\b|\bout of memory\b|\btemporarily unavailable\b|\bresource exhausted\b"
+)
+
+# OSError errnos that signal pressure that can clear (disk/fd/memory
+# exhaustion, interruption) — retry-worthy.  Everything else in the
+# class (ENOENT, EACCES, EISDIR, ...) is payload pathology: a request
+# naming a missing file must terminal error-bad-input, not defer.
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in (
+        "ENOSPC", "EDQUOT", "EIO", "EAGAIN", "EWOULDBLOCK", "EINTR",
+        "EMFILE", "ENFILE", "ENOMEM", "EBUSY", "ETIMEDOUT",
+    )
+    if hasattr(errno, name)
+)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Transient = retry may genuinely succeed: injected faults (their
+    whole point), allocation pressure, and the exhaustion slice of the
+    OSError class.  Everything else — bad witnesses, payloads naming
+    missing files, proof-count mismatches, failed sample verification —
+    is permanent and goes straight to isolation: a permanent failure
+    classified transient would defer-livelock, re-claimed and re-failed
+    every sweep with no terminal state ever written."""
+    if isinstance(exc, (FaultInjected, MemoryError)):
+        return True
+    if isinstance(exc, OSError) and exc.errno is not None:
+        return exc.errno in _TRANSIENT_ERRNOS
+    if isinstance(exc, (RuntimeError, OSError)):
+        # C/XLA-layer exhaustion carries only text (and an errno-less
+        # OSError only its message); other types never marker-match —
+        # a ValueError mentioning "resource" is a bad payload, not load
+        return _TRANSIENT_RE.search(str(exc).lower()) is not None
+    return False
 
 
 @dataclass
@@ -42,6 +142,21 @@ class Request:
     # terminal record carries true claim->terminal latency
     rid: str = ""
     t_claim: float = 0.0
+    # deadline anchor: the request file's mtime (the spool's arrival
+    # clock — survives worker crashes and takeovers, unlike any
+    # in-process timestamp)
+    t_submit: float = 0.0
+    # terminal state assigned THIS sweep (None = still open), and the
+    # deliberate non-terminal outcome: a deferred request released its
+    # claim for a later sweep to retry (emit failure, transient witness
+    # failure) — the safety net must not terminal it
+    done: Optional[str] = None
+    deferred: bool = False
+    # which degradation rung rescued the prove (None = fast path)
+    degraded_rung: Optional[str] = None
+    # slot in the batch the request was CLAIMED into (records keep the
+    # original batch attribution across bisection)
+    batch_index: Optional[int] = None
 
 
 class ProvingService:
@@ -58,6 +173,10 @@ class ProvingService:
         prover_fn: Optional[Callable] = None,
         prefetch: int = 1,
         stale_claim_s: float = 300.0,
+        deadline_s: Optional[float] = None,
+        spool_cap: Optional[int] = None,
+        retries: Optional[int] = None,
+        retry_backoff_s: Optional[float] = None,
     ):
         """witness_fn: request payload -> witness vector (raises on bad
         input); public_fn: witness -> public signals.
@@ -75,7 +194,17 @@ class ProvingService:
         window; 1 = classic double buffering).
         stale_claim_s: concurrent workers sweeping one spool partition
         requests via O_EXCL <name>.claim files; a claim older than this
-        is treated as a crashed worker's and taken over."""
+        is treated as a crashed worker's and taken over.
+        deadline_s: default per-request deadline (seconds since the
+        request file's mtime; a payload `deadline_s` key overrides it
+        per request; None = the ZKP2P_DEADLINE_S config default; 0 =
+        no deadline).
+        spool_cap: pending-backlog admission cap per sweep — requests
+        beyond it are shed as error-shed (None = ZKP2P_SPOOL_CAP; 0 =
+        unlimited).
+        retries / retry_backoff_s: bounded transient-failure retries per
+        batch prove and the exponential-backoff base (None = the
+        ZKP2P_PROVE_RETRIES / ZKP2P_RETRY_BACKOFF_S defaults)."""
         self.cs = cs
         self.dpk = dpk
         self.vk = vk
@@ -87,6 +216,10 @@ class ProvingService:
         self.prover_fn = prover_fn
         self.prefetch = max(1, prefetch)
         self.stale_claim_s = stale_claim_s
+        self.deadline_s = deadline_s
+        self.spool_cap = spool_cap
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
         # per-spool rotating JSONL sinks (lazy; see _sink).  Locked:
         # the witness producer thread and the proving thread both emit
         # records, and two racing JsonlSink instances for one path
@@ -99,6 +232,23 @@ class ProvingService:
         # record).  None = not yet resolved.
         self._knobs: Optional[Dict] = None
         self._sink_override: Optional[str] = None
+        self._resolved = False
+
+    def _resolve_policy(self) -> None:
+        """Fill constructor-None policy knobs from the typed config,
+        once per process (env cannot change under a running service)."""
+        if self._resolved:
+            return
+        from ..utils.config import load_config
+
+        cfg = load_config()
+        self._deadline_default = self.deadline_s if self.deadline_s is not None else cfg.deadline_s
+        self._spool_cap = self.spool_cap if self.spool_cap is not None else cfg.spool_cap
+        self._retries = self.retries if self.retries is not None else cfg.prove_retries
+        self._retry_backoff_s = (
+            self.retry_backoff_s if self.retry_backoff_s is not None else cfg.retry_backoff_s
+        )
+        self._resolved = True
 
     # -------------------------------------------------------- observability
     #
@@ -131,8 +281,10 @@ class ProvingService:
         knobs: Dict,
         batch_index: Optional[int] = None,
         batch_n: Optional[int] = None,
+        **extra,
     ) -> None:
         try:
+            fault_point("sink")
             rec = {
                 "type": "request",
                 "ts": round(time.time(), 3),
@@ -155,6 +307,8 @@ class ProvingService:
                 rec["batch_index"] = batch_index
             if batch_n is not None:
                 rec["batch_n"] = batch_n
+            if extra:
+                rec.update(extra)
             if req.error:
                 rec["error"] = req.error[:500]
             # flight recorder: HBM watermark at terminal time.  NOTE
@@ -194,6 +348,7 @@ class ProvingService:
             return False
         claim = base_path + ".claim"
         try:
+            fault_point("claim")
             fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
             try:
@@ -202,15 +357,70 @@ class ProvingService:
                 return False  # vanished: owner just completed it
             if age < self.stale_claim_s:
                 return False
-            # stale claim: take over (best-effort refresh; losing a race
-            # here only risks duplicate work, never a wrong result)
+            # Stale claim: STEAL it by renaming it aside — rename is
+            # atomic and the kernel picks exactly ONE winner (every
+            # other taker's rename of the same source gets ENOENT and
+            # backs off; a replace-in-place scheme would let two takers
+            # each read back their own replace and both "win").  The
+            # winner then re-creates the claim O_EXCL with ITS pid/ts —
+            # the old refresh-mtime takeover left the dead worker's
+            # identity in the file, so `cat *.claim` lied about who
+            # owns in-flight work.
+            stale_aside = f"{claim}.stale.{os.getpid()}"
             try:
-                os.utime(claim, None)
+                # last-moment re-check: if the claim was refreshed or
+                # rewritten since our stat (owner alive after all, or a
+                # faster taker already won), it is not ours to steal
+                if time.time() - os.path.getmtime(claim) < self.stale_claim_s:
+                    return False
+                os.rename(claim, stale_aside)
             except OSError:
+                return False  # lost the steal race (or owner just completed)
+            try:
+                os.unlink(stale_aside)
+            except OSError:
+                pass
+            try:
+                fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except OSError:
+                # an opportunistic claimer slipped into the freed slot
+                # first — still exactly one owner, just not us
+                return False
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(json.dumps({"pid": os.getpid(), "ts": time.time(), "takeover": True}))
+            except OSError:
+                pass  # ownership = existence + mtime; identity is debug info
+            # The old owner may have COMPLETED inside the stale-check →
+            # steal window (it never re-checks its stolen claim;
+            # terminal write, then its release unlinks OUR claim).
+            # Terminal outputs always win: back off instead of
+            # re-proving finished work and emitting a duplicate
+            # terminal record.  (The pre-rewrite utime-based takeover
+            # failed closed here with ENOENT; this re-check keeps that
+            # behavior.)
+            if os.path.exists(base_path + ".proof.json") or os.path.exists(base_path + ".error.json"):
+                self._release_claim(base_path)
                 return False
             return True
-        with os.fdopen(fd, "w") as f:
-            f.write(json.dumps({"pid": os.getpid(), "ts": time.time()}))
+        except (OSError, FaultInjected):
+            # claim-write failure (full disk, injected fault): the
+            # request is simply not ours this sweep — a later sweep
+            # retries; a claim failure must never kill the whole scan
+            return False
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(json.dumps({"pid": os.getpid(), "ts": time.time()}))
+        except OSError:
+            # ownership = the file's existence + mtime; the identity
+            # payload is best-effort debugging info
+            pass
+        # same completed-while-we-raced re-check as the steal path: a
+        # peer may have emitted + released between our top-of-function
+        # artifact check and the O_EXCL create landing on the freed slot
+        if os.path.exists(base_path + ".proof.json") or os.path.exists(base_path + ".error.json"):
+            self._release_claim(base_path)
+            return False
         return True
 
     @staticmethod
@@ -220,16 +430,234 @@ class ProvingService:
         except OSError:
             pass
 
+    # ---------------------------------------------------------- deadlines
+
+    def _deadline_of(self, req: Request) -> Optional[float]:
+        """Absolute wall-clock deadline for a request, or None.  The
+        payload's `deadline_s` wins over the service default; both are
+        seconds since the request file's mtime (the spool arrival clock,
+        stable across worker crashes).  A malformed payload deadline
+        degrades to the service default rather than killing the request
+        (the witness builder will judge the payload)."""
+        d = None
+        if isinstance(req.payload, dict):
+            d = req.payload.get("deadline_s")
+        try:
+            d = float(d) if d is not None else None
+        except (TypeError, ValueError):
+            d = None
+        if d is None:
+            d = self._deadline_default
+        if not d or d <= 0 or not req.t_submit:
+            return None
+        return req.t_submit + d
+
+    # ------------------------------------------------------ terminal emit
+
+    def _terminal_error(
+        self,
+        spool: str,
+        req: Request,
+        state: str,
+        exc: BaseException,
+        knobs: Dict,
+        stats: Dict[str, int],
+        batch_index: Optional[int] = None,
+        batch_n: Optional[int] = None,
+    ) -> bool:
+        """Terminal a request into an error state: atomic .error.json
+        artifact, claim release, request record, counter.  Returns False
+        when the artifact itself cannot be written (disk full): the
+        request is left NON-terminal (claim released) for a later sweep
+        rather than half-terminal."""
+        req.error = f"{state}: {exc}"
+        try:
+            self._emit_error(req, state, exc)
+        except Exception:  # noqa: BLE001 — the error artifact failed to write
+            self._release_claim(req.path)
+            req.deferred = True
+            return False
+        self._emit_record(spool, req, state, knobs, batch_index=batch_index, batch_n=batch_n)
+        req.done = state
+        stats[state] += 1
+        return True
+
+    # ------------------------------------------------- resilient proving
+    #
+    # The retry -> bisect -> degrade ladder (docs/ROBUSTNESS.md).  All
+    # of it runs on the consumer thread under the batch's heartbeat, so
+    # claim age stays bounded however long the rescue takes.
+
+    def _prove_verified(self, batch: List[Request]) -> list:
+        """One prover call over `batch` + the sample verify.  Raises on
+        ANY failure — including a prover that returns the wrong number
+        of proofs, which a bare zip() would silently truncate."""
+        from ..prover.groth16_tpu import prove_tpu_batch
+        from ..snark.groth16 import verify
+
+        fault_point("prove")
+        with trace("service/prove", n=len(batch), request_ids=[r.rid for r in batch]):
+            prove = self.prover_fn or prove_tpu_batch
+            proofs = prove(self.dpk, [r.witness for r in batch])
+        proofs = list(proofs) if proofs is not None else []
+        if len(proofs) != len(batch):
+            raise RuntimeError(
+                f"prover returned {len(proofs)} proofs for a batch of {len(batch)}"
+            )
+        fault_point("verify")
+        with trace("service/verify"):
+            sample_pub = self.public_fn(batch[0].witness)
+            if not verify(self.vk, proofs[0], sample_pub):
+                raise RuntimeError("sample proof failed verification")
+        return proofs
+
+    def _prove_with_retries(self, batch: List[Request]) -> list:
+        """Bounded transient-failure retries with exponential backoff.
+        Permanent failures (bad witness, count mismatch, verify fail)
+        raise immediately — retrying them would only burn deadline."""
+        attempt = 0
+        while True:
+            try:
+                return self._prove_verified(batch)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if attempt >= self._retries or not _is_transient(e):
+                    raise
+                attempt += 1
+                REGISTRY.counter("zkp2p_service_retries_total").inc()
+                delay = min(self._retry_backoff_s * (2 ** (attempt - 1)), 30.0)
+                if delay > 0:
+                    time.sleep(delay)
+
+    def _degraded_prove(self, batch: List[Request], cause: BaseException):
+        """Last resort before error-failed-to-prove: walk the
+        degradation ladder, one attempt per rung, each with one more
+        fast path gated off via the (fresh-read) knob env.  Returns
+        (proofs, rung) on the first success; re-raises the final rung's
+        failure.  Only provers that actually READ the knobs get the
+        ladder (prover fns marked `reads_msm_knobs` — native_prove sets
+        it): for any other prover every rung would re-run the IDENTICAL
+        prove, wasting full proves and misattributing a flaky success
+        to the rung."""
+        prove = self.prover_fn
+        if prove is None or not getattr(prove, "reads_msm_knobs", False):
+            raise cause
+        last: BaseException = cause
+        for rung, overlay in _DEGRADATION_LADDER:
+            saved = {k: os.environ.get(k) for k in overlay}
+            os.environ.update(overlay)
+            try:
+                proofs = self._prove_verified(batch)
+                REGISTRY.counter("zkp2p_service_degraded_total", {"rung": rung}).inc()
+                return proofs, rung
+            except Exception as e:  # noqa: BLE001 — try the next rung
+                last = e
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+        raise last
+
+    def _prove_isolating(
+        self,
+        spool: str,
+        batch: List[Request],
+        knobs: Dict,
+        stats: Dict[str, int],
+        batch_n: int,
+    ) -> None:
+        """Prove `batch`, terminal-ing EVERY member exactly once: on
+        failure the batch is bisected and the halves re-proven (a
+        poisoned request costs each batchmate at most log2(S) extra
+        proves), singles walk the degradation ladder before accepting
+        error-failed-to-prove."""
+        try:
+            proofs = self._prove_with_retries(batch)
+        except Exception as e:  # noqa: BLE001 — isolate below
+            if len(batch) == 1:
+                req = batch[0]
+                try:
+                    proofs, rung = self._degraded_prove(batch, e)
+                    req.degraded_rung = rung
+                except Exception as e2:  # noqa: BLE001 — truly failed
+                    self._terminal_error(
+                        spool, req, "error-failed-to-prove", e2, knobs, stats,
+                        batch_index=req.batch_index, batch_n=batch_n,
+                    )
+                    return
+            else:
+                del e
+                REGISTRY.counter("zkp2p_service_bisections_total").inc()
+                mid = (len(batch) + 1) // 2
+                self._prove_isolating(spool, batch[:mid], knobs, stats, batch_n)
+                self._prove_isolating(spool, batch[mid:], knobs, stats, batch_n)
+                return
+        self._emit_done_batch(spool, batch, proofs, knobs, stats, batch_n)
+
+    def _emit_done_batch(
+        self,
+        spool: str,
+        batch: List[Request],
+        proofs: list,
+        knobs: Dict,
+        stats: Dict[str, int],
+        batch_n: int,
+    ) -> None:
+        from ..formats.proof_json import proof_to_json, public_to_json
+
+        for req, proof in zip(batch, proofs):
+            set_context(request_id=req.rid)
+            try:
+                try:
+                    fault_point("emit")
+                    with trace("service/emit"):
+                        # public first, proof last: the sweep treats
+                        # .proof.json as the done marker, so a crash
+                        # between the two atomic writes leaves a
+                        # retryable request, never a proof without its
+                        # public signals
+                        dump(public_to_json(self.public_fn(req.witness)), req.path + ".public.json")
+                        dump(proof_to_json(proof), req.path + ".proof.json")
+                except Exception as e:  # noqa: BLE001 — emit failure is per-request
+                    REGISTRY.counter("zkp2p_service_emit_failures_total").inc()
+                    if _is_transient(e):
+                        # disk full / injected ENOSPC: the proof is
+                        # valid but unrecorded — and writing .error.json
+                        # would fail on the same full disk — so the
+                        # request stays NON-terminal: claim released, a
+                        # later sweep re-proves it (at-least-once).  Its
+                        # batchmates continue below.
+                        req.deferred = True
+                        self._release_claim(req.path)
+                    else:
+                        # deterministic emit-time failure (public_fn
+                        # compute error): deferring would livelock the
+                        # spool re-proving it forever — terminal it,
+                        # exactly one record
+                        self._terminal_error(
+                            spool, req, "error-failed-to-prove", e, knobs, stats,
+                            batch_index=req.batch_index, batch_n=batch_n,
+                        )
+                    continue
+            finally:
+                set_context(request_id=None)
+            self._release_claim(req.path)
+            extra = {"degraded_rung": req.degraded_rung} if req.degraded_rung else {}
+            self._emit_record(
+                spool, req, "done", knobs,
+                batch_index=req.batch_index, batch_n=batch_n, **extra,
+            )
+            req.done = "done"
+            stats["done"] += 1
+
     # ------------------------------------------------------------ one pass
 
     def process_dir(self, spool: str) -> Dict[str, int]:
         """One spool sweep; returns counters. Files: <name>.req.json in,
         <name>.proof.json / <name>.error.json out."""
-        from ..formats.proof_json import proof_to_json, public_to_json
-        from ..prover.groth16_tpu import prove_tpu_batch
-        from ..snark.groth16 import verify
-
-        stats = {"done": 0, "error-bad-input": 0, "error-failed-to-prove": 0}
+        self._resolve_policy()
+        stats = {s: 0 for s in TERMINAL_STATES}
         # knob manifest stamped on every request record (the acceptance
         # contract: a record is attributable without joining against a
         # separate manifest line) — resolved once per process, not per
@@ -240,6 +668,17 @@ class ProvingService:
         knobs = self._knobs
         pending: List[Request] = []
         for fn in sorted(os.listdir(spool)):
+            if ".claim.stale." in fn:
+                # scavenge steal-aside litter: a taker SIGKILLed between
+                # its rename and its unlink leaves this behind, and no
+                # other path ever matches the name
+                p = os.path.join(spool, fn)
+                try:
+                    if time.time() - os.path.getmtime(p) > self.stale_claim_s:
+                        os.unlink(p)
+                except OSError:
+                    pass
+                continue
             if not fn.endswith(".req.json"):
                 continue
             base = fn[: -len(".req.json")]
@@ -248,8 +687,71 @@ class ProvingService:
             ):
                 self._release_claim(os.path.join(spool, base))
                 continue
-            with open(os.path.join(spool, fn)) as f:
-                pending.append(Request(path=os.path.join(spool, base), payload=json.load(f), rid=base))
+            # a FRESH claim = a peer is on it right now: not claimable
+            # this sweep, and counting it as backlog would let the
+            # admission cap shed viable requests off an inflated number
+            # (stale claims pass through — they are takeover candidates)
+            try:
+                if time.time() - os.path.getmtime(os.path.join(spool, base + ".claim")) < self.stale_claim_s:
+                    continue
+            except OSError:
+                pass  # no claim: free for the taking
+            fpath = os.path.join(spool, fn)
+            try:
+                with open(fpath) as f:
+                    payload = json.load(f)
+            except ValueError as e:
+                # torn/malformed .req.json (half-written upload,
+                # truncated copy): terminal it as error-bad-input and
+                # KEEP SWEEPING — one corrupt file must not sink the
+                # sweep and every batchmate behind it.  A YOUNG torn
+                # file gets the benefit of the doubt first: a
+                # non-atomic uploader (scp, cp) may still be writing
+                # it, and a permanent terminal on a request that was
+                # about to become valid is unrecoverable.
+                try:
+                    if time.time() - os.path.getmtime(fpath) < TORN_REQ_GRACE_S:
+                        continue  # may still be mid-write: next sweep judges it
+                except OSError:
+                    continue  # vanished: nothing to judge
+                req = Request(path=os.path.join(spool, base), payload={}, rid=base)
+                if self._try_claim(req.path):
+                    req.t_claim = time.time()
+                    self._terminal_error(spool, req, "error-bad-input", e, knobs, stats)
+                continue
+            except OSError:
+                continue  # vanished/unreadable this sweep: retry next sweep
+            try:
+                t_submit = os.path.getmtime(fpath)
+            except OSError:
+                t_submit = time.time()
+            pending.append(
+                Request(path=os.path.join(spool, base), payload=payload, rid=base, t_submit=t_submit)
+            )
+
+        # Admission control: a backlog beyond the cap is SHED — newest
+        # arrivals first (the oldest are closest to their deadlines and
+        # already aged in the spool), each with a visible error-shed
+        # terminal + counter, instead of silently aging until every
+        # deadline in the queue is dead on arrival.
+        if self._spool_cap and len(pending) > self._spool_cap:
+            backlog = len(pending)
+            pending.sort(key=lambda r: (r.t_submit, r.rid))
+            keep, shed = pending[: self._spool_cap], pending[self._spool_cap:]
+            for r in shed:
+                if not self._try_claim(r.path):
+                    continue  # a peer is on it — not ours to shed
+                r.t_claim = time.time()
+                # counter only on a SUCCESSFUL terminal: a failed
+                # error-artifact write defers the request, and the next
+                # sweep would shed-count it again
+                if self._terminal_error(
+                    spool, r, "error-shed",
+                    RuntimeError(f"spool backlog {backlog} over admission cap {self._spool_cap}"),
+                    knobs, stats,
+                ):
+                    REGISTRY.counter("zkp2p_service_shed_total").inc()
+            pending = sorted(keep, key=lambda r: r.rid)
 
         # Pipeline overlap (SURVEY.md §2.7 "witness ∥ prove"): witness
         # generation is host CPU, proving is device compute — a producer
@@ -262,18 +764,50 @@ class ProvingService:
         ready_q: "queue.Queue[Optional[List[Request]]]" = queue.Queue(maxsize=self.prefetch)
         producer_error: List[BaseException] = []
 
+        # Sweep-level claim heartbeat: refreshes EVERY claim this sweep
+        # holds — including batches sitting in ready_q behind a slow
+        # rescue (retries + bisection + ladder can far exceed
+        # stale_claim_s) — so claim age stays bounded by the refresh
+        # interval, not by queue wait + rescue time.  A per-batch
+        # heartbeat would leave queued batches' claims aging toward peer
+        # takeover and duplicate terminal records.  Terminal'd/deferred
+        # requests drop out via their done/deferred flags: their claims
+        # are already released, and utime-ing a path a peer has since
+        # re-claimed would delay that peer's legitimate takeover window.
+        hb_reqs: List[Request] = []
+        hb_lock = threading.Lock()
+        stop_hb = threading.Event()
+
+        def _sweep_heartbeat():
+            while True:
+                with hb_lock:
+                    reqs = [r for r in hb_reqs if r.done is None and not r.deferred]
+                for r in reqs:
+                    try:
+                        os.utime(r.path + ".claim", None)
+                    except OSError:
+                        pass
+                if stop_hb.wait(max(self.stale_claim_s / 3.0, 0.05)):
+                    return
+
         def scalar_witness(req: Request) -> bool:
             set_context(request_id=req.rid)
             try:
                 with trace("service/witness"):
+                    fault_point("witness")
                     req.witness = self.witness_fn(req.payload)
                     self.cs.check_witness(req.witness)
                 return True
             except Exception as e:  # noqa: BLE001 — recorded, not silenced
-                req.error = f"error-bad-input: {e}"
-                self._emit_error(req, "error-bad-input", e)
-                self._emit_record(spool, req, "error-bad-input", knobs)
-                stats["error-bad-input"] += 1
+                if _is_transient(e):
+                    # injected fault / allocation pressure: NOT the
+                    # payload's fault — release the claim for a later
+                    # sweep instead of terminal-ing a good request
+                    REGISTRY.counter("zkp2p_service_retries_total").inc()
+                    self._release_claim(req.path)
+                    req.deferred = True
+                    return False
+                self._terminal_error(spool, req, "error-bad-input", e, knobs, stats)
                 return False
             finally:
                 set_context(request_id=None)
@@ -289,13 +823,16 @@ class ProvingService:
                 try:
                     set_context(request_id=req.rid)
                     with trace("service/inputs"):
+                        fault_point("witness")
                         inputs.append(self.inputs_fn(req.payload))
                     batch.append(req)
                 except Exception as e:  # noqa: BLE001
-                    req.error = f"error-bad-input: {e}"
-                    self._emit_error(req, "error-bad-input", e)
-                    self._emit_record(spool, req, "error-bad-input", knobs)
-                    stats["error-bad-input"] += 1
+                    if _is_transient(e):
+                        REGISTRY.counter("zkp2p_service_retries_total").inc()
+                        self._release_claim(req.path)
+                        req.deferred = True
+                    else:
+                        self._terminal_error(spool, req, "error-bad-input", e, knobs, stats)
                 finally:
                     set_context(request_id=None)
             if not batch:
@@ -321,9 +858,29 @@ class ProvingService:
                     # not hold scan-time claims that go stale while
                     # earlier batches prove (peer takeover would then
                     # duplicate in-progress work).
-                    cand = [r for r in pending[i : i + self.batch_size] if self._try_claim(r.path)]
-                    for r in cand:
+                    cand = []
+                    for r in pending[i : i + self.batch_size]:
+                        if not self._try_claim(r.path):
+                            continue
                         r.t_claim = time.time()
+                        with hb_lock:
+                            hb_reqs.append(r)  # heartbeat from claim to terminal
+                        # deadline gate #1, at claim: a request that
+                        # arrived already-expired (or aged out in the
+                        # spool) terminals before any witness work
+                        dl = self._deadline_of(r)
+                        if dl is not None and r.t_claim > dl:
+                            if self._terminal_error(
+                                spool, r, "error-deadline-exceeded",
+                                RuntimeError(
+                                    f"deadline exceeded at claim "
+                                    f"({r.t_claim - r.t_submit:.3f}s since submit)"
+                                ),
+                                knobs, stats,
+                            ):
+                                REGISTRY.counter("zkp2p_service_deadline_total").inc()
+                            continue
+                        cand.append(r)
                     if self.inputs_fn is not None:
                         batch = batched_witness(cand)
                     else:
@@ -338,87 +895,79 @@ class ProvingService:
                 # consumer blocks on ready_q.get() forever.
                 ready_q.put(None)
 
+        hb = threading.Thread(target=_sweep_heartbeat, daemon=True)
+        hb.start()
         producer = threading.Thread(target=produce, daemon=True)
         producer.start()
+        try:
+            self._consume(spool, ready_q, knobs, stats)
+        finally:
+            stop_hb.set()
+            hb.join()
+        producer.join()
+        if producer_error:
+            # Requests after the failure point got no witness, no proof
+            # and no record this sweep — the claim-file discipline means
+            # a later sweep (or another worker) picks them up.
+            raise producer_error[0]
+        return stats
+
+    def _consume(self, spool, ready_q, knobs, stats) -> None:
+        """Drain ready batches: deadline-gate, then prove with the full
+        rescue ladder, terminal-ing every request exactly once.  Claims
+        stay fresh via the caller's sweep-level heartbeat."""
         while True:
             batch = ready_q.get()
             if batch is None:
                 break
-            completed: set = set()  # rids terminal as done in THIS batch
+            # deadline gate #2, at batch assembly: queue wait behind a
+            # slow batch may have burned the remaining budget — check
+            # again immediately before committing prove compute
+            live: List[Request] = []
+            for req in batch:
+                dl = self._deadline_of(req)
+                if dl is not None and time.time() > dl:
+                    if self._terminal_error(
+                        spool, req, "error-deadline-exceeded",
+                        RuntimeError(
+                            f"deadline exceeded at batch assembly "
+                            f"({time.time() - req.t_submit:.3f}s since submit)"
+                        ),
+                        knobs, stats,
+                    ):
+                        REGISTRY.counter("zkp2p_service_deadline_total").inc()
+                else:
+                    live.append(req)
+            if not live:
+                continue
+            for bi, req in enumerate(live):
+                req.batch_index = bi
             try:
-                # heartbeat: refresh the batch's claims periodically WHILE
-                # the prove runs, so claim age stays bounded by the refresh
-                # interval — not by one batch's prove time (a batch of
-                # full-size proves can exceed stale_claim_s and a peer
-                # would take over in-flight work)
-                stop_hb = threading.Event()
-
-                def _heartbeat(reqs=batch):
-                    while True:
-                        for req in reqs:
-                            try:
-                                os.utime(req.path + ".claim", None)
-                            except OSError:
-                                pass
-                        if stop_hb.wait(max(self.stale_claim_s / 3.0, 0.05)):
-                            return
-
-                hb = threading.Thread(target=_heartbeat, daemon=True)
-                hb.start()
-                try:
-                    with trace("service/prove", n=len(batch), request_ids=[r.rid for r in batch]):
-                        prove = self.prover_fn or prove_tpu_batch
-                        proofs = prove(self.dpk, [r.witness for r in batch])
-                finally:
-                    stop_hb.set()
-                    hb.join()
-                # verify a sample from every batch before emitting
-                sample_pub = self.public_fn(batch[0].witness)
-                if not verify(self.vk, proofs[0], sample_pub):
-                    raise RuntimeError("sample proof failed verification")
-                for bi, (req, proof) in enumerate(zip(batch, proofs)):
-                    set_context(request_id=req.rid)
-                    try:
-                        with trace("service/emit"):
-                            dump(proof_to_json(proof), req.path + ".proof.json")
-                            dump(public_to_json(self.public_fn(req.witness)), req.path + ".public.json")
-                    finally:
-                        set_context(request_id=None)
-                    self._release_claim(req.path)
-                    self._emit_record(spool, req, "done", knobs, batch_index=bi, batch_n=len(batch))
-                    completed.add(req.rid)
-                    stats["done"] += 1
-            except Exception as e:  # noqa: BLE001
-                # Only requests NOT already terminal: a dump() failing
-                # mid-batch must not stamp an error artifact/record (and
-                # a second counter bump) onto requests whose proofs were
-                # already emitted as done — one terminal state per
-                # request is what the per-request attribution rides on.
-                for bi, req in enumerate(batch):
-                    if req.rid in completed:
-                        continue
-                    req.error = f"error-failed-to-prove: {e}"
-                    self._emit_error(req, "error-failed-to-prove", e)
-                    self._emit_record(
-                        spool, req, "error-failed-to-prove", knobs,
-                        batch_index=bi, batch_n=len(batch),
-                    )
-                    stats["error-failed-to-prove"] += 1
-        producer.join()
-        if producer_error:
-            # Requests after the failure point got no witness, no proof
-            # and no .error.json — surfacing stats as if the sweep were
-            # complete would silently drop them.
-            raise producer_error[0]
-        return stats
+                self._prove_isolating(spool, live, knobs, stats, batch_n=len(live))
+            except Exception as e:  # noqa: BLE001 — safety net
+                # _prove_isolating terminals every request itself; an
+                # exception escaping it is a bug in the rescue path —
+                # requests still open (and not deliberately deferred)
+                # get the honest terminal instead of silently hanging
+                for req in live:
+                    if req.done is None and not req.deferred:
+                        self._terminal_error(
+                            spool, req, "error-failed-to-prove", e, knobs, stats,
+                            batch_index=req.batch_index, batch_n=len(live),
+                        )
 
     @classmethod
-    def _emit_error(cls, req: Request, state: str, exc: Exception) -> None:
+    def _emit_error(cls, req: Request, state: str, exc: BaseException) -> None:
         # atomic (temp+rename) like every other terminal artifact: a crash
         # or racing peer mid-write must never leave a torn .error.json that
         # the sweep's existence check treats as final
+        # format_exception(exc), not format_exc(): shed/deadline
+        # terminals pass a CONSTRUCTED exception that was never raised —
+        # format_exc() there would stamp "NoneType: None" (or whatever
+        # unrelated exception happens to be in flight) into the artifact
+        trace_s = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__, limit=3))
         dump(
-            {"state": state, "error": str(exc), "trace": traceback.format_exc(limit=3), "ts": time.time()},
+            {"state": state, "error": str(exc), "trace": trace_s, "ts": time.time()},
             req.path + ".error.json",
         )
         cls._release_claim(req.path)

@@ -391,8 +391,13 @@ def prove_native(
 ) -> Proof:
     """Prove with the native C++ runtime.  Emits the exact proof
     `prove_host` / `prove_tpu` produce for the same (witness, r, s)."""
+    from ..utils.faults import fault_point
     from ..utils.trace import trace
 
+    # chaos/fault-injection site for the CLI/bench prove path (the
+    # service's batch prove has its own `prove` site one level up) —
+    # a single env-read no-op when ZKP2P_FAULTS is unset
+    fault_point("native_prove")
     lib = _lib()
     if lib is None:
         raise RuntimeError("native library unavailable (csrc build failed?)")
@@ -590,8 +595,10 @@ def prove_native_batch(
     which remain the byte-parity oracle: every proof here is
     byte-identical to its sequential counterpart for the same
     (witness, r, s), pinned by tests/test_msm_multi.py."""
+    from ..utils.faults import fault_point
     from ..utils.trace import trace
 
+    fault_point("native_prove")
     lib = _lib()
     if lib is None:
         raise RuntimeError("native library unavailable (csrc build failed?)")
@@ -779,3 +786,11 @@ def prove_native_batch(
     REGISTRY.counter("zkp2p_proves_total", {"prover": "native_batch"}).inc(S)
     publish_native_stats()
     return proofs
+
+
+# The service's degradation ladder (pipeline.service) only makes sense
+# for provers that actually READ the MSM knobs it flips per rung
+# (ZKP2P_MSM_PRECOMP/MULTI/BATCH_AFFINE/OVERLAP are fresh-read here,
+# per prove) — mark them so the ladder can tell.
+prove_native.reads_msm_knobs = True
+prove_native_batch.reads_msm_knobs = True

@@ -358,6 +358,12 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     else:
         record_arm("native_tier", "unavailable")
 
+    # fault-injection gate (utils.faults): "off" or the 8-hex spec
+    # digest — a chaos run and a clean run must never share a digest
+    from .faults import faults_arm
+
+    faults_arm()
+
     if workload and backend != "unavailable":
         # one tiny jitted op: proves the backend executes and ticks the
         # compile listener.  Deliberately NOT a gated field mul — a

@@ -75,6 +75,30 @@ def _pos_int(default: int):
     return parse
 
 
+def _nonneg_int(default: int):
+    # 0 is meaningful here ("unlimited" / "no retries"); malformed keeps
+    # the committed default rather than crashing a running service
+    def parse(s: str) -> int:
+        try:
+            return max(0, int(s))
+        except ValueError:
+            return default
+
+    return parse
+
+
+def _nonneg_float(default: float):
+    # seconds knobs (deadlines, backoff): 0 = disabled; malformed keeps
+    # the committed default
+    def parse(s: str) -> float:
+        try:
+            return max(0.0, float(s))
+        except ValueError:
+            return default
+
+    return parse
+
+
 KNOBS: Dict[str, Tuple[str, object, object]] = {
     # device (XLA/Pallas) prover MSM tiers — see prover.groth16_tpu
     "msm_window": ("ZKP2P_MSM_WINDOW", int, 4),
@@ -176,6 +200,25 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "metrics_addr": ("ZKP2P_METRICS_ADDR", str, "127.0.0.1"),
     "metrics_sink": ("ZKP2P_METRICS_SINK", str, ""),
     "trace_max": ("ZKP2P_TRACE_MAX", _pos_int(65536), 65536),
+    # fault injection (utils.faults): named injection sites through the
+    # witness/prove/verify/emit/claim/sink paths, e.g.
+    # "seed=7,prove:raise:p=0.2,emit:enospc:once,witness:hang=3".
+    # Empty = off (the no-op fast path).  The spec grammar and
+    # determinism contract live in utils/faults.py + docs/ROBUSTNESS.md;
+    # the knob stays a raw string here (faults.parse_faults is THE
+    # parser) so a malformed spec fails loudly at arm time, not silently
+    # at config time.
+    "faults": ("ZKP2P_FAULTS", str, ""),
+    # service fault-tolerance knobs (pipeline.service; constructor args
+    # override per instance — these are the fleet-wide defaults):
+    # default per-request deadline in seconds (payload deadline_s wins;
+    # 0 = no deadline), spool backlog cap (pending requests beyond it
+    # are shed as error-shed; 0 = unlimited), bounded transient-failure
+    # retries per batch prove, and the exponential-backoff base.
+    "deadline_s": ("ZKP2P_DEADLINE_S", _nonneg_float(0.0), 0.0),
+    "spool_cap": ("ZKP2P_SPOOL_CAP", _nonneg_int(0), 0),
+    "prove_retries": ("ZKP2P_PROVE_RETRIES", _nonneg_int(2), 2),
+    "retry_backoff_s": ("ZKP2P_RETRY_BACKOFF_S", _nonneg_float(0.25), 0.25),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -216,6 +259,11 @@ class ProverConfig:
     metrics_addr: str = "127.0.0.1"
     metrics_sink: str = ""
     trace_max: int = 65536
+    faults: str = ""
+    deadline_s: float = 0.0
+    spool_cap: int = 0
+    prove_retries: int = 2
+    retry_backoff_s: float = 0.25
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 

@@ -1,0 +1,277 @@
+"""Seeded, deterministic fault injection for the proving service.
+
+The service's failure semantics (docs/ROBUSTNESS.md) are claims, not
+facts, until something can MAKE the failures happen on demand: a prover
+that throws on one batch in five, a disk that returns ENOSPC exactly
+once, a witness builder that stalls long enough for a SIGKILL to land
+mid-prove.  This module is that something — named injection sites
+threaded through the service/prover paths, armed by one env knob:
+
+    ZKP2P_FAULTS="prove:raise:p=0.2,emit:enospc:once,witness:hang=3"
+
+Grammar (comma-separated entries):
+
+    entry   = "seed=" INT                 global RNG seed (default 0)
+            | site ":" action (":" mod)*
+    site    = witness | prove | verify | emit | claim | sink
+              (open set — any [a-z_]+ token; the sites above are the
+              ones wired into the tree, see docs/ROBUSTNESS.md)
+    action  = "raise"                     raise FaultInjected
+            | "enospc"                    raise OSError(ENOSPC)
+            | "hang=" SECONDS             sleep, then continue
+    mod     = "p=" FLOAT                  fire probability (default 1)
+            | "once"                      fire at most once  (= n=1)
+            | "n=" INT                    fire at most n times
+            | "after=" INT                skip the first n eligible hits
+
+Design constraints:
+
+  * **Deterministic**: every fault owns a `random.Random` seeded from
+    (global seed, site, entry index) — two processes with the same spec
+    and the same call sequence inject identically; reruns reproduce.
+  * **No-op when unset**: `fault_point(site)` with no ZKP2P_FAULTS is
+    one env read + one compare (~1.5 µs measured); sites sit at request-stage
+    granularity (per claim/witness/prove/emit), never inside MSM loops,
+    so the armed-off overhead on the prove hot path is far inside the
+    1 % budget (measured: docs/ROBUSTNESS.md §overhead).
+  * **Audited**: the plan resolves through `record_arm("faults", ...)`
+    ("off" or an 8-hex spec digest), so execution digests distinguish
+    fault runs from clean ones and two clean A/B arms stay equal.
+
+`FaultInjected` deliberately subclasses RuntimeError: consumers that
+classify transient failures (service retry logic) name it explicitly;
+everything else treats it as an ordinary crash — which is the point.
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+ENV_VAR = "ZKP2P_FAULTS"
+
+# the sites actually wired into the tree (reference, not enforcement —
+# a typo'd site parses fine and simply never fires, the same way an
+# unused knob arm is legal; keep this list in sync with ROBUSTNESS.md)
+KNOWN_SITES = ("witness", "prove", "verify", "emit", "claim", "sink", "native_prove")
+
+_ACTIONS = ("raise", "enospc", "hang")
+
+
+class FaultInjected(RuntimeError):
+    """An injected (transient-classified) failure — see ZKP2P_FAULTS."""
+
+
+@dataclass
+class Fault:
+    site: str
+    action: str                  # raise | enospc | hang
+    arg: float = 0.0             # hang seconds
+    p: float = 1.0               # fire probability per eligible hit
+    limit: Optional[int] = None  # max fires (None = unlimited; once = 1)
+    after: int = 0               # eligible hits to skip before firing
+    seed_key: str = ""           # rng derivation key (spec-stable)
+    seen: int = 0
+    fired: int = 0
+    rng: random.Random = field(default_factory=random.Random, repr=False)
+
+
+class FaultPlan:
+    """A parsed ZKP2P_FAULTS spec: per-site fault lists + spec digest."""
+
+    def __init__(self, spec: str, faults: List[Fault], seed: int):
+        self.spec = spec
+        self.seed = seed
+        self.digest = hashlib.sha256(spec.encode()).hexdigest()[:8]
+        self.by_site: Dict[str, List[Fault]] = {}
+        for f in faults:
+            # per-fault deterministic stream: independent of every other
+            # fault's draw sequence, reproducible across processes
+            f.rng = random.Random(f"{seed}:{f.seed_key}")
+            self.by_site.setdefault(f.site, []).append(f)
+        # one lock for all counters: fire() runs from the service's
+        # producer AND consumer threads; fairness does not matter but
+        # the once/n accounting must not double-fire on a race
+        self._lock = threading.Lock()
+
+    def fire(self, site: str) -> None:
+        flist = self.by_site.get(site)
+        if not flist:
+            return
+        for f in flist:
+            with self._lock:
+                f.seen += 1
+                if f.limit is not None and f.fired >= f.limit:
+                    continue
+                if f.seen <= f.after:
+                    continue
+                if f.p < 1.0 and f.rng.random() >= f.p:
+                    continue
+                f.fired += 1
+            if f.action == "hang":
+                time.sleep(f.arg)
+                continue  # a hang delays the stage, it does not fail it
+            if f.action == "enospc":
+                raise OSError(errno.ENOSPC, f"injected ENOSPC at {site} [faults:{self.digest}]")
+            raise FaultInjected(f"injected fault at {site} [faults:{self.digest}]")
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        """site -> {seen, fired} totals (tests / chaos reporting)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for site, flist in self.by_site.items():
+            out[site] = {
+                "seen": sum(f.seen for f in flist),
+                "fired": sum(f.fired for f in flist),
+            }
+        return out
+
+
+def parse_faults(spec: str) -> FaultPlan:
+    """Parse a ZKP2P_FAULTS spec; raises ValueError with the offending
+    entry on malformed input (the config knob stays a raw string — this
+    is the one parser, shared by the service and the tests)."""
+    faults: List[Fault] = []
+    seed = 0
+    for idx, raw in enumerate(x.strip() for x in spec.split(",")):
+        if not raw:
+            continue
+        if raw.startswith("seed="):
+            try:
+                seed = int(raw[len("seed="):])
+            except ValueError:
+                raise ValueError(f"ZKP2P_FAULTS: bad seed {raw!r}") from None
+            continue
+        parts = raw.split(":")
+        if len(parts) < 2:
+            raise ValueError(f"ZKP2P_FAULTS: entry {raw!r} needs site:action")
+        site, action_s, mods = parts[0], parts[1], parts[2:]
+        if not site or not site.replace("_", "").isalpha():
+            raise ValueError(f"ZKP2P_FAULTS: bad site in {raw!r}")
+        arg = 0.0
+        if action_s.startswith("hang="):
+            action = "hang"
+            try:
+                arg = float(action_s[len("hang="):])
+            except ValueError:
+                raise ValueError(f"ZKP2P_FAULTS: bad hang seconds in {raw!r}") from None
+            if arg < 0:
+                raise ValueError(f"ZKP2P_FAULTS: negative hang in {raw!r}")
+        elif action_s in ("raise", "enospc"):
+            action = action_s
+        else:
+            raise ValueError(
+                f"ZKP2P_FAULTS: unknown action {action_s!r} in {raw!r} "
+                f"(have: raise, enospc, hang=SECONDS)"
+            )
+        f = Fault(site=site, action=action, arg=arg, seed_key=f"{site}:{idx}:{action}")
+        for mod in mods:
+            if mod == "once":
+                f.limit = 1
+            elif mod.startswith("p="):
+                try:
+                    f.p = float(mod[2:])
+                except ValueError:
+                    raise ValueError(f"ZKP2P_FAULTS: bad probability in {raw!r}") from None
+                if not 0.0 <= f.p <= 1.0:
+                    raise ValueError(f"ZKP2P_FAULTS: p out of [0,1] in {raw!r}")
+            elif mod.startswith("n="):
+                try:
+                    f.limit = int(mod[2:])
+                except ValueError:
+                    raise ValueError(f"ZKP2P_FAULTS: bad n= in {raw!r}") from None
+                if f.limit < 0:
+                    # n=-1 (typo for n=1) would build a fault that can
+                    # NEVER fire — a silently-unfaulted chaos run
+                    raise ValueError(f"ZKP2P_FAULTS: negative n= in {raw!r}")
+            elif mod.startswith("after="):
+                try:
+                    f.after = int(mod[len("after="):])
+                except ValueError:
+                    raise ValueError(f"ZKP2P_FAULTS: bad after= in {raw!r}") from None
+                if f.after < 0:
+                    raise ValueError(f"ZKP2P_FAULTS: negative after= in {raw!r}")
+            else:
+                raise ValueError(
+                    f"ZKP2P_FAULTS: unknown modifier {mod!r} in {raw!r} "
+                    f"(have: p=FLOAT, once, n=INT, after=INT)"
+                )
+        faults.append(f)
+    return FaultPlan(spec, faults, seed)
+
+
+# --------------------------------------------------------------------------
+# Process state.  The plan is cached keyed by the RAW env value: the env
+# is the transport (chaos workers arm via spawn env), flips re-parse,
+# and the unset fast path is one dict lookup + one `is not` compare.
+# Counters (once/n accounting) live on the cached plan, so they persist
+# for the life of the spec — exactly the semantics "once" promises.
+
+_plan: Optional[FaultPlan] = None
+_plan_src: Optional[str] = None
+# serializes the parse-and-install slow path: the service's witness
+# producer and prove consumer threads race the FIRST fault_point, and
+# two unsynchronized parses would install two plans — a `once` fault
+# could then fire on each, breaking the determinism contract
+_state_lock = threading.Lock()
+
+
+def current_plan() -> Optional[FaultPlan]:
+    """The active plan (None when ZKP2P_FAULTS is unset/empty).  Arms
+    the `faults` audit gate on every change, so execution digests
+    distinguish fault runs from clean ones."""
+    global _plan, _plan_src
+    src = os.environ.get(ENV_VAR, "")
+    if src is _plan_src or src == _plan_src:
+        # fast path, lock-free: _plan is installed BEFORE _plan_src,
+        # so a matching src always sees its finished plan
+        return _plan
+    with _state_lock:
+        if src == _plan_src:
+            return _plan  # another thread won the parse race
+        from .audit import record_arm
+
+        if not src:
+            plan = None
+            record_arm("faults", "off")
+        else:
+            # a malformed spec is an operator error and must FAIL
+            # LOUDLY (a chaos run that silently injected nothing would
+            # "prove" fault tolerance it never tested) — at EVERY
+            # fault_point until fixed: _plan_src stays unset on
+            # failure, so each site re-parses and re-raises rather
+            # than quietly running unfaulted
+            plan = parse_faults(src)  # ValueError propagates
+            record_arm("faults", plan.digest)
+        _plan = plan
+        _plan_src = src
+        return _plan
+
+
+def fault_point(site: str) -> None:
+    """Injection site: no-op unless ZKP2P_FAULTS names `site`.  Raises
+    FaultInjected / OSError(ENOSPC) or sleeps per the armed spec."""
+    plan = current_plan()
+    if plan is not None:
+        plan.fire(site)
+
+
+def faults_arm() -> str:
+    """Resolve + audit-record the faults gate without firing anything
+    (preflight/doctor hook).  Returns the recorded arm string."""
+    plan = current_plan()
+    return "off" if plan is None else plan.digest
+
+
+def reset() -> None:
+    """Drop the cached plan so the next fault_point re-parses the env
+    and once/n counters start fresh (tests)."""
+    global _plan, _plan_src
+    with _state_lock:
+        _plan = None
+        _plan_src = None
