@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -56,6 +56,16 @@ precomp-cache: native
 # See docs/ROBUSTNESS.md §chaos harness; ~25 s on the 2-core box.
 chaos-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_chaos.py -q
+
+# Load-generator smoke (fast; tier-1 resident): a 2-second open-loop
+# Poisson burst against the stub-speed toy prover on a temp spool —
+# the capacity JSON must parse with scored ramp steps, /status must
+# scrape 200 mid-run, and trace_report must render the sink's request
+# waterfalls (Chrome-trace export) + time-series lines.  The real
+# measurement is `python tools/loadgen.py --circuit venmo` — see
+# docs/OBSERVABILITY.md §loadgen; ~20 s on the 2-core box.
+loadgen-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_loadgen.py -q
 
 # Execution-path preflight (docs/OBSERVABILITY.md §execution audit):
 # probe the backend, arm EVERY gate through its real resolver, print

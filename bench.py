@@ -306,6 +306,62 @@ def _native_fallback_bench(plat: str) -> bool:
 
             traceback.print_exc(file=sys.stderr)
             log("native batch arm failed; recording batch=1 only")
+    # Service arm: QPS under SLO (ROADMAP item 2 — the number a
+    # deployment buys, not proofs/s min-of-reps).  tools/loadgen.py
+    # drives an open-loop Poisson ramp through a real in-process
+    # ProvingService over THIS tier's key/witness (witness replayed —
+    # the arm measures the proving service, not email parsing), sized
+    # off the measured batch throughput so the two steps bracket the
+    # knee.  BENCH_SERVICE_S=0 disables; failures never sink the tier.
+    service_rec = {}
+    svc_budget = float(os.environ.get("BENCH_SERVICE_S", "45"))
+    if svc_budget > 0:
+        try:
+            import importlib.util
+            import tempfile
+
+            spec = importlib.util.spec_from_file_location(
+                "zkp2p_loadgen",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools", "loadgen.py"),
+            )
+            loadgen = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(loadgen)
+            from zkp2p_tpu.pipeline.service import ProvingService
+            from zkp2p_tpu.prover.native_prove import prove_native_batch
+
+            svc_batch = int(os.environ.get("BENCH_NATIVE_BATCH", "4"))
+            base_qps = batch_rec.get("batch_value") or (1 / best)
+            objective = float(os.environ.get("BENCH_SLO_P95_S", "30"))
+            step_s = max(8.0, svc_budget / 4.0)
+            rates = [round(0.6 * base_qps, 4), round(1.0 * base_qps, 4)]
+            svc = ProvingService(
+                cs, dpk, vk,
+                witness_fn=lambda _p: w,  # replay: service arm, not witness arm
+                public_fn=lambda wit: list(wit[1 : cs.num_public + 1]),
+                batch_size=svc_batch, prover_fn=prove_native_batch,
+            )
+            spool = tempfile.mkdtemp(prefix="bench_service_")
+            cap = loadgen.run_capacity(
+                svc, spool, rates, step_s, objective,
+                drain_s=2 * step_s, circuit="venmo-replay", log=log,
+            )
+            service_rec = {
+                "service_qps_under_slo": cap["max_sustainable_qps"],
+                "service_slo_objective_s": objective,
+                "service_steps": [
+                    {k: s[k] for k in ("qps_target", "offered", "done", "p95_s", "attainment", "ok")}
+                    for s in cap["steps"]
+                ],
+            }
+            log(
+                f"service arm: max sustainable {cap['max_sustainable_qps']:g} QPS "
+                f"at p95<={objective:g}s (steps {rates}, batch={svc_batch})"
+            )
+        except Exception:  # noqa: BLE001 — the prove records must still ship
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            log("service arm failed; recording prove tiers only")
     # stage trace: to the configured JSONL sink (run_id/pid-stamped, with
     # the knob/host manifest — trace_report.py aggregates or diffs it),
     # else stderr as before; the native counter snapshot rides the stderr
@@ -350,6 +406,9 @@ def _native_fallback_bench(plat: str) -> bool:
                 # the batched arm: aggregate proofs/s + per-proof p50
                 # when batch_n requests ride one multi-column prove
                 **batch_rec,
+                # the service arm: QPS under SLO from the loadgen ramp
+                # (max sustainable arrival rate at the p95 objective)
+                **service_rec,
                 # host attribution: resolved thread count + CPU identity,
                 # so spread across identical reps has a suspect
                 **host,

@@ -62,6 +62,10 @@ def test_env_overrides_every_knob():
         "ZKP2P_SPOOL_CAP": "256",
         "ZKP2P_PROVE_RETRIES": "5",
         "ZKP2P_RETRY_BACKOFF_S": "0.5",
+        "ZKP2P_SLO_P95_S": "12",
+        "ZKP2P_SLO_TARGET": "0.99",
+        "ZKP2P_SLO_WINDOW_S": "60",
+        "ZKP2P_TS_SAMPLE_S": "2.5",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -81,6 +85,8 @@ def test_env_overrides_every_knob():
     assert cfg.faults == "prove:raise:p=0.5,emit:enospc:once"
     assert cfg.deadline_s == 30.0 and cfg.spool_cap == 256
     assert cfg.prove_retries == 5 and cfg.retry_backoff_s == 0.5
+    assert cfg.slo_p95_s == 12.0 and cfg.slo_target == 0.99
+    assert cfg.slo_window_s == 60.0 and cfg.ts_sample_s == 2.5
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -108,6 +114,17 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_PROVE_RETRIES": "0"}).prove_retries == 0
     assert load_config(environ={"ZKP2P_PROVE_RETRIES": "junk"}).prove_retries == 2
     assert load_config(environ={"ZKP2P_RETRY_BACKOFF_S": "junk"}).retry_backoff_s == 0.25
+    # SLO knobs: objective 0 = disabled; the target fraction must land
+    # strictly inside (0,1) — out-of-range or malformed keeps 0.95 (a
+    # target of 1.0 would divide the burn rate by zero error budget)
+    assert load_config(environ={"ZKP2P_SLO_P95_S": "0"}).slo_p95_s == 0.0
+    assert load_config(environ={"ZKP2P_SLO_P95_S": "junk"}).slo_p95_s == 0.0
+    assert load_config(environ={"ZKP2P_SLO_TARGET": "1.0"}).slo_target == 0.95
+    assert load_config(environ={"ZKP2P_SLO_TARGET": "0"}).slo_target == 0.95
+    assert load_config(environ={"ZKP2P_SLO_TARGET": "junk"}).slo_target == 0.95
+    assert load_config(environ={"ZKP2P_SLO_TARGET": "0.9"}).slo_target == 0.9
+    assert load_config(environ={"ZKP2P_TS_SAMPLE_S": "0"}).ts_sample_s == 0.0
+    assert load_config(environ={"ZKP2P_TS_SAMPLE_S": "junk"}).ts_sample_s == 10.0
 
 
 def test_armed_flags_whitelist_and_precedence(tmp_path):
@@ -173,7 +190,10 @@ def test_every_zkp2p_env_read_is_registered():
             if f.endswith("config.py"):
                 continue
             with open(f, errors="ignore") as fh:
-                found |= set(re.findall(r"ZKP2P_[A-Z_]*", fh.read()))
+                # digits included: ZKP2P_SLO_P95_S was the first knob
+                # with one, and an [A-Z_]-only scan truncated it to an
+                # unregistered-looking "ZKP2P_SLO_P"
+                found |= set(re.findall(r"ZKP2P_[A-Z0-9_]*", fh.read()))
     unregistered = found - registered - allowed_extra
     assert not unregistered, f"env reads outside the typed config: {sorted(unregistered)}"
     # and the armable whitelist refers to real knobs

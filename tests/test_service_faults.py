@@ -487,27 +487,39 @@ def test_spool_cap_sheds_newest_visibly(world, tmp_path):
 
 def test_injected_enospc_at_emit_defers_and_next_sweep_completes(world, tmp_path, monkeypatch):
     """emit:enospc:once — the proof is valid but cannot land; the
-    request stays NON-terminal (no half-terminal artifacts, no record)
-    and the next sweep re-proves and completes it.  At-least-once,
-    exactly one terminal record."""
+    request stays NON-terminal (no half-terminal artifacts, no TERMINAL
+    record) and the next sweep re-proves and completes it.
+    At-least-once, exactly one terminal record — plus one `deferred`
+    attempt record carrying the sweep's spans, so the prove the failed
+    sweep paid for stays on the waterfall (PR 8)."""
     spool = str(tmp_path)
     _write_reqs(spool, [(3, 5)])
     monkeypatch.setenv("ZKP2P_FAULTS", "emit:enospc:once")
     faults.reset()
     svc = _mk(world)
     e0 = _counter("zkp2p_service_emit_failures_total")
+    d0 = _counter("zkp2p_service_deferred_total")
     stats = svc.process_dir(spool)
     assert stats["done"] == 0 and not any(stats.values())
     assert _counter("zkp2p_service_emit_failures_total") - e0 == 1
+    assert _counter("zkp2p_service_deferred_total") - d0 == 1
     assert not os.path.exists(os.path.join(spool, "r0.proof.json"))
     assert not os.path.exists(os.path.join(spool, "r0.error.json"))
     assert not os.path.exists(os.path.join(spool, "r0.claim"))
-    assert _records(spool) == []  # deferred = NOT terminal, no record
-    # the fault is spent: the retry sweep lands the proof
+    # deferred = NOT terminal, but the attempt IS recorded: state
+    # "deferred", a reason, and the spans of the prove it burned
+    recs = _records(spool)
+    assert [r["state"] for r in recs] == ["deferred"]
+    assert recs[0]["deferred_reason"].startswith("transient emit failure")
+    assert recs[0]["queue_wait_s"] >= 0
+    assert any(s["name"] == "prove" for s in recs[0]["spans"])
+    # the fault is spent: the retry sweep lands the proof — exactly one
+    # TERMINAL record, the deferred attempt line preserved before it
     stats2 = svc.process_dir(spool)
     assert stats2["done"] == 1
     recs = _records(spool)
-    assert [r["request_id"] for r in recs] == ["r0"] and recs[0]["state"] == "done"
+    assert [r["state"] for r in recs] == ["deferred", "done"]
+    assert all(r["request_id"] == "r0" for r in recs)
 
 
 def test_transient_witness_failure_defers_not_bad_input(world, tmp_path, monkeypatch):

@@ -1,0 +1,190 @@
+"""SLO engine (utils.slo): rolling-window attainment/burn-rate math,
+the /status and /healthz routes, and the Prometheus exposition format
+(HELP/TYPE blocks, content type) — tier-1 resident, no prover needed."""
+
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+from zkp2p_tpu.utils import audit
+from zkp2p_tpu.utils.metrics import (
+    REGISTRY,
+    maybe_start_metrics_server,
+    stop_metrics_server,
+)
+from zkp2p_tpu.utils.slo import SloTracker, publish_slo, status_payload
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------ window math
+
+
+def test_attainment_and_burn_rate_exact():
+    """20 good + 1 slow request against a 1 s objective at target 0.95:
+    attainment 20/21, burn = miss fraction / error budget."""
+    t = SloTracker(objective_s=1.0, target=0.95, window_s=100.0, clock=lambda: 0.0)
+    for i in range(20):
+        t.observe(0.5, ok=True, now=i * 0.1)
+    t.observe(5.0, ok=True, now=2.0)  # over objective: not good
+    s = t.snapshot(now=2.0)
+    assert s["n"] == 21 and s["good"] == 20
+    assert abs(s["attainment"] - 20 / 21) < 1e-6
+    assert abs(s["burn_rate"] - (1 / 21) / 0.05) < 1e-3
+    assert s["p50_s"] == 0.5 and s["max_s"] == 5.0
+
+
+def test_failed_requests_are_never_good():
+    t = SloTracker(objective_s=10.0, target=0.95, window_s=100.0, clock=lambda: 0.0)
+    t.observe(0.1, ok=False, now=0.0)  # fast but errored: a miss
+    t.observe(0.1, ok=True, now=0.0)
+    s = t.snapshot(now=0.0)
+    assert s["n"] == 2 and s["good"] == 1 and s["attainment"] == 0.5
+
+
+def test_no_objective_means_done_is_good():
+    """objective 0 = no latency bound configured: any `done` counts."""
+    t = SloTracker(objective_s=0.0, target=0.95, window_s=100.0, clock=lambda: 0.0)
+    t.observe(1e6, ok=True, now=0.0)
+    assert t.snapshot(now=0.0)["attainment"] == 1.0
+
+
+def test_window_eviction_and_empty_window_vacuous():
+    t = SloTracker(objective_s=1.0, target=0.95, window_s=10.0, clock=lambda: 0.0)
+    t.observe(5.0, ok=True, now=0.0)  # a miss
+    assert t.snapshot(now=5.0)["attainment"] == 0.0
+    # 11 s later the miss has aged out: empty window is vacuously met
+    s = t.snapshot(now=11.0)
+    assert s["n"] == 0 and s["attainment"] == 1.0 and s["burn_rate"] == 0.0
+
+
+def test_window_cap_bounds_memory_and_counts():
+    from zkp2p_tpu.utils import slo as slo_mod
+
+    t = SloTracker(objective_s=1.0, target=0.95, window_s=0.0, clock=lambda: 0.0)
+    for i in range(slo_mod.MAX_WINDOW_SAMPLES + 10):
+        t.observe(0.1, ok=True, now=0.0)
+    s = t.snapshot(now=0.0)
+    assert s["n"] == slo_mod.MAX_WINDOW_SAMPLES
+    assert s["capped"] == 10  # evictions counted, never silent
+
+
+def test_bad_target_rejected():
+    with pytest.raises(ValueError):
+        SloTracker(objective_s=1.0, target=1.0)
+    with pytest.raises(ValueError):
+        SloTracker(objective_s=1.0, target=0.0)
+
+
+def test_publish_slo_sets_gauges():
+    from zkp2p_tpu.utils import slo as slo_mod
+
+    snap = publish_slo()
+    assert REGISTRY.gauge("zkp2p_slo_attainment").value == snap["attainment"]
+    assert REGISTRY.gauge("zkp2p_slo_window_requests").value == snap["n"]
+    assert isinstance(slo_mod.default_tracker(), SloTracker)
+
+
+# ------------------------------------------------------------ /status
+
+
+def test_status_fails_closed_before_preflight(monkeypatch):
+    """A scrape must never read 'healthy' off a process whose gates
+    nobody armed — /status is 503 until a preflight has run."""
+    monkeypatch.setattr(audit, "_preflight_report", None)
+    body = status_payload()
+    assert body["ok"] is False and "preflight" in body["reason"]
+    # preflight opens it
+    monkeypatch.setattr(
+        audit, "_preflight_report",
+        {"ts": 1.0, "backend": "cpu", "warnings": 0, "execution_digest": "x"},
+    )
+    body = status_payload()
+    assert body["ok"] is True
+    assert body["preflight"]["backend"] == "cpu"
+    assert "slo" in body and "attainment" in body["slo"]
+    assert "requests" in body and "counters" in body
+
+
+def test_http_routes_status_healthz_metrics(monkeypatch):
+    """The exposition server serves /metrics (0.0.4 text with HELP/TYPE
+    blocks), /healthz (liveness, always 200), and /status (503 before
+    preflight, 200 JSON after)."""
+    port = _free_port()
+    stop_metrics_server()
+    monkeypatch.setattr(audit, "_preflight_report", None)
+    srv = maybe_start_metrics_server(port=port)
+    assert srv is not None
+    try:
+        base = f"http://127.0.0.1:{port}"
+        # /metrics: content type + HELP/TYPE per family
+        r = urllib.request.urlopen(base + "/metrics", timeout=5)
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "text/plain; version=0.0.4"
+        body = r.read().decode()
+        families = [ln.split()[2] for ln in body.splitlines() if ln.startswith("# TYPE")]
+        assert families, body[:200]
+        for fam_line in (ln for ln in body.splitlines() if ln.startswith("# TYPE")):
+            name = fam_line.split()[2]
+            assert f"# HELP {name} " in body, f"family {name} missing its HELP line"
+        # the scrape refreshes the SLO gauges
+        assert "zkp2p_slo_attainment" in body
+
+        # /healthz: pure liveness
+        r = urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert r.status == 200 and json.loads(r.read())["ok"] is True
+
+        # /status: closed before preflight ...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/status", timeout=5)
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read())
+        assert payload["ok"] is False
+
+        # ... open after
+        monkeypatch.setattr(
+            audit, "_preflight_report",
+            {"ts": 1.0, "backend": "cpu", "warnings": 0, "execution_digest": "x"},
+        )
+        r = urllib.request.urlopen(base + "/status", timeout=5)
+        assert r.status == 200
+        st = json.loads(r.read())
+        assert st["ok"] is True and "slo" in st and st["run_id"]
+        # unknown path still 404s
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/nope", timeout=5)
+        assert ei.value.code == 404
+    finally:
+        stop_metrics_server()
+
+
+# ------------------------------------------------------------ audit gates
+
+
+def test_slo_and_timeseries_arms_are_digest_visible(monkeypatch):
+    """Two runs differing only in the SLO objective (or sampler
+    interval) must have different execution digests — same contract as
+    the fault gate: observability arms are code-path arms."""
+    from zkp2p_tpu.utils.slo import slo_arm, timeseries_arm
+
+    monkeypatch.delenv("ZKP2P_SLO_P95_S", raising=False)
+    assert slo_arm() == "off"
+    monkeypatch.setenv("ZKP2P_SLO_P95_S", "10")
+    monkeypatch.setenv("ZKP2P_SLO_TARGET", "0.99")
+    assert slo_arm() == "p95=10s@0.99"
+    monkeypatch.setenv("ZKP2P_TS_SAMPLE_S", "0")
+    assert timeseries_arm() == "off"
+    monkeypatch.setenv("ZKP2P_TS_SAMPLE_S", "2.5")
+    assert timeseries_arm() == "2.5s"
+    arms_a = dict(audit.gate_arms(), service_slo="off")
+    arms_b = dict(audit.gate_arms(), service_slo="p95=10s@0.99")
+    assert audit.execution_digest(arms_a) != audit.execution_digest(arms_b)

@@ -175,7 +175,11 @@ def check_invariants(spool: str, vk=None) -> dict:
                 except ValueError:
                     violations.append(f"{os.path.basename(path)}: torn sink line")
                     continue
-                if rec.get("type") == "request":
+                if rec.get("type") == "request" and rec.get("state") != "deferred":
+                    # TERMINAL records only: deferred attempt lines
+                    # (state="deferred", one per retried sweep — the
+                    # request-waterfall history) are expected repeats,
+                    # not duplicate terminals
                     rec_counts[rec["request_id"]] = rec_counts.get(rec["request_id"], 0) + 1
     for rid, n in sorted(rec_counts.items()):
         if n > 1:

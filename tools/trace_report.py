@@ -18,12 +18,18 @@ Modes:
   --run RID    restrict aggregation to one run_id
   --diff A B   A/B: two files OR (with one file) two run_ids — per-stage
                p50 delta table, replacing eyeballed min-of-5 comparisons
-  --json       machine output: {"stages", "requests", "runs"} with the
-               per-stage aggregates, request-state aggregates, and each
+  --json       machine output: {"stages", "requests", "runs",
+               "timeseries"} with the per-stage aggregates,
+               request-state aggregates, sampler-line summary, and each
                run's knobs + gate arms + execution digest — so CI can
                gate on digests/latencies instead of scraping text
                tables.  Honors --run; with --diff, emits {"a","b"} of
                per-stage aggregates instead.
+  --chrome-trace OUT
+               export the request records' lifecycle spans as Chrome
+               trace-event JSON (one pid per worker process, one tid
+               per request, queue-wait vs witness/prove/emit slices) —
+               load OUT in https://ui.perfetto.dev.  Honors --run.
 
 Exact percentiles from the raw records (the registry's histograms are
 bucket-resolution; this reads the records themselves).
@@ -37,14 +43,17 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 
-def load_records(paths: List[str]) -> Tuple[List[dict], List[dict], List[dict]]:
-    """(stage_records, request_records, manifests) from JSONL files,
-    rotation backups included if named explicitly.  Unparseable lines
-    are counted, not fatal (a torn tail from a crashed worker must not
-    hide the rest of the file)."""
+def load_records(
+    paths: List[str],
+) -> Tuple[List[dict], List[dict], List[dict], List[dict]]:
+    """(stage_records, request_records, manifests, timeseries) from
+    JSONL files, rotation backups included if named explicitly.
+    Unparseable lines are counted, not fatal (a torn tail from a
+    crashed worker must not hide the rest of the file)."""
     stages: List[dict] = []
     requests: List[dict] = []
     manifests: List[dict] = []
+    timeseries: List[dict] = []
     bad = 0
     for path in paths:
         with open(path) as f:
@@ -62,11 +71,13 @@ def load_records(paths: List[str]) -> Tuple[List[dict], List[dict], List[dict]]:
                     manifests.append(rec)
                 elif t == "request":
                     requests.append(rec)
+                elif t == "timeseries":
+                    timeseries.append(rec)
                 elif "stage" in rec and "ms" in rec:
                     stages.append(rec)
     if bad:
         print(f"[trace_report] skipped {bad} unparseable line(s)", file=sys.stderr)
-    return stages, requests, manifests
+    return stages, requests, manifests, timeseries
 
 
 def _pct(sorted_vals: List[float], q: float) -> float:
@@ -213,6 +224,136 @@ def render_diff(agg_a: Dict[str, dict], agg_b: Dict[str, dict], label_a: str, la
     return "\n".join(lines)
 
 
+def chrome_trace(requests: List[dict], run: Optional[str] = None) -> dict:
+    """Chrome trace-event JSON (loads in Perfetto / chrome://tracing)
+    from the service's request records: **one pid per worker process,
+    one tid per request**, so the UI shows each request as its own
+    waterfall row under its worker.
+
+    Per record: a synthesized `queue_wait` slice (req-file mtime →
+    claim — the spool wait the `queue_wait_s` field sums), one complete
+    ("X") slice per lifecycle span (witness / prove attempts / rungs /
+    verify / emit, `spans` on the record), and an instant marker at the
+    terminal/deferred transition.  Deferred attempt records share their
+    request's tid, so a defer→re-prove cycle reads as one row with two
+    prove slices.  Timestamps are µs relative to the earliest event
+    (Chrome's `ts` unit), emitted sorted so they are monotonic."""
+    recs = [
+        r for r in requests
+        if r.get("request_id") and (not run or r.get("run_id") == run)
+    ]
+    events: List[dict] = []
+    tids: Dict[tuple, int] = {}  # (pid, request_id) -> tid
+    next_tid: Dict[int, int] = {}  # per-pid tid allocator
+
+    def tid_for(pid: int, rid: str) -> int:
+        key = (pid, rid)
+        if key not in tids:
+            next_tid[pid] = next_tid.get(pid, 0) + 1
+            tids[key] = next_tid[pid]
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tids[key],
+                "args": {"name": rid},
+            })
+        return tids[key]
+
+    seen_pids = set()
+    for r in recs:
+        pid = int(r.get("pid") or 0)
+        if pid not in seen_pids:
+            seen_pids.add(pid)
+            events.append({
+                "ph": "M", "name": "process_name", "pid": pid,
+                "args": {"name": f"zkp2p worker {pid}"},
+            })
+        tid = tid_for(pid, r["request_id"])
+        t_submit, t_claim = r.get("t_submit"), r.get("t_claim")
+        if t_submit and t_claim and t_claim >= t_submit:
+            events.append({
+                "ph": "X", "name": "queue_wait", "cat": "request",
+                "pid": pid, "tid": tid,
+                "ts": t_submit * 1e6, "dur": (t_claim - t_submit) * 1e6,
+                "args": {"queue_wait_s": r.get("queue_wait_s")},
+            })
+        for s in r.get("spans") or []:
+            args = {k: v for k, v in s.items() if k not in ("name", "t0", "ms")}
+            events.append({
+                "ph": "X", "name": s["name"], "cat": "request",
+                "pid": pid, "tid": tid,
+                "ts": float(s["t0"]) * 1e6, "dur": float(s["ms"]) * 1e3,
+                "args": args,
+            })
+        if r.get("ts"):
+            events.append({
+                "ph": "i", "s": "t", "name": r.get("state", "?"), "cat": "request",
+                "pid": pid, "tid": tid, "ts": float(r["ts"]) * 1e6,
+                "args": {k: r[k] for k in ("batch_index", "batch_n", "degraded_rung",
+                                           "deferred_reason") if r.get(k) is not None},
+            })
+    # normalize to the earliest event and sort: Perfetto wants sane
+    # (small, monotonic-sortable) µs timestamps, not epoch µs
+    slices = [e for e in events if "ts" in e]
+    if slices:
+        t0 = min(e["ts"] for e in slices)
+        for e in slices:
+            e["ts"] = round(e["ts"] - t0, 3)
+            if "dur" in e:
+                e["dur"] = round(e["dur"], 3)
+    meta = [e for e in events if "ts" not in e]
+    # Equal-ts slices sort LONGEST first: importers nest same-timestamp
+    # complete events by assuming the enclosing slice precedes the
+    # enclosed one, and a defer→re-prove request emits two queue_wait
+    # slices both anchored at t_submit (shorter-first would mis-nest).
+    slices.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+    return {"traceEvents": meta + slices, "displayTimeUnit": "ms"}
+
+
+def _aggregate_timeseries(timeseries: List[dict], run: Optional[str] = None) -> dict:
+    """Compact summary of the sampler lines: sample count, time covered,
+    and min/mean/max of the queue-state signals — enough for the text
+    report to say "backlog peaked at N while arrivals ran at X Hz"
+    (full-resolution analysis reads the raw lines)."""
+    recs = [r for r in timeseries if not run or r.get("run_id") == run]
+    if not recs:
+        return {}
+
+    def series(key):
+        vals = [float(r[key]) for r in recs if r.get(key) is not None]
+        if not vals:
+            return None
+        return {
+            "min": min(vals),
+            "mean": round(sum(vals) / len(vals), 4),
+            "max": max(vals),
+        }
+
+    out = {"n": len(recs)}
+    ts = [float(r["ts"]) for r in recs if r.get("ts")]
+    if len(ts) >= 2:
+        out["span_s"] = round(max(ts) - min(ts), 3)
+    for key in ("arrival_rate_hz", "backlog", "claimable", "in_flight", "batch_fill_last"):
+        s = series(key)
+        if s is not None:
+            out[key] = s
+    return out
+
+
+def render_timeseries(agg: dict) -> str:
+    if not agg:
+        return ""
+    parts = [f"timeseries: {agg['n']} samples"]
+    if "span_s" in agg:
+        parts.append(f"over {agg['span_s']:.0f}s")
+    for key, label in (
+        ("arrival_rate_hz", "arrivals/s"), ("backlog", "backlog"),
+        ("in_flight", "in_flight"), ("batch_fill_last", "batch_fill"),
+    ):
+        if key in agg:
+            a = agg[key]
+            parts.append(f"{label} mean={a['mean']:g} max={a['max']:g}")
+    return "  ".join(parts)
+
+
 def _aggregate_requests(requests: List[dict], run: Optional[str] = None) -> Dict[str, dict]:
     """state -> {n, p50, p95, max} over request terminal records; plus a
     `_batched` pseudo-state over records carrying batch_index/batch_n
@@ -330,19 +471,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="two run_ids (single input) or ignored-with-two-files A/B p50 diff",
     )
     ap.add_argument("--json", action="store_true", help="machine output (stages/requests/runs + digests)")
+    ap.add_argument(
+        "--chrome-trace", metavar="OUT",
+        help="write the request waterfalls as Chrome trace-event JSON (Perfetto-loadable)",
+    )
     args = ap.parse_args(argv)
 
     if args.diff and len(args.files) == 2:
         # file-vs-file diff: --diff labels the columns
-        sa, _, _ = load_records([args.files[0]])
-        sb, _, _ = load_records([args.files[1]])
+        sa, _, _, _ = load_records([args.files[0]])
+        sb, _, _, _ = load_records([args.files[1]])
         if args.json:
             print(json.dumps({"a": aggregate(sa), "b": aggregate(sb)}))
         else:
             print(render_diff(aggregate(sa), aggregate(sb), args.diff[0], args.diff[1]))
         return 0
 
-    stages, requests, manifests = load_records(args.files)
+    stages, requests, manifests, timeseries = load_records(args.files)
+    if args.chrome_trace:
+        trace = chrome_trace(requests, run=args.run)
+        n_slices = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        with open(args.chrome_trace, "w") as f:
+            json.dump(trace, f)
+        print(
+            f"[trace_report] wrote {n_slices} spans across "
+            f"{len({e['pid'] for e in trace['traceEvents']})} worker pid(s) to "
+            f"{args.chrome_trace} (load in https://ui.perfetto.dev)",
+            file=sys.stderr,
+        )
+        if not n_slices:
+            print("[trace_report] no request spans found (pre-PR-8 sink?)", file=sys.stderr)
+        return 0
     if args.runs:
         runs = _runs_detail(stages, requests, manifests, run=args.run)
         if args.json:
@@ -369,6 +528,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "stages": agg,
                     "requests": _aggregate_requests(requests, run=args.run),
                     "runs": _runs_detail(stages, requests, manifests, run=args.run),
+                    "timeseries": _aggregate_timeseries(timeseries, run=args.run),
                 }
             )
         )
@@ -378,6 +538,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if req_view:
         print()
         print(req_view)
+    ts_view = render_timeseries(_aggregate_timeseries(timeseries, run=args.run))
+    if ts_view:
+        print()
+        print(ts_view)
     return 0
 
 

@@ -359,6 +359,13 @@ def cmd_service(args):
         # MSM family) instead of a per-request prove loop
         from ..prover.native_prove import prove_native_batch as prover_fn  # noqa: F811
 
+    # SLO observability (docs/OBSERVABILITY.md §SLO): the flags ride the
+    # env knobs (the tracker and sampler read the typed config), written
+    # BEFORE run() so preflight arms the gates with the operator's values
+    if getattr(args, "slo_p95_s", None) is not None:
+        os.environ["ZKP2P_SLO_P95_S"] = str(args.slo_p95_s)
+    if getattr(args, "ts_sample_s", None) is not None:
+        os.environ["ZKP2P_TS_SAMPLE_S"] = str(args.ts_sample_s)
     # fault-tolerance policy (docs/ROBUSTNESS.md): flags override the
     # ZKP2P_DEADLINE_S / ZKP2P_SPOOL_CAP config defaults; None defers
     svc_kw = dict(
@@ -552,6 +559,12 @@ def main(argv=None):
     s.add_argument("--spool-cap", type=int, default=None,
                    help="max pending requests admitted per sweep — the excess is shed as "
                         "error-shed (default: ZKP2P_SPOOL_CAP; 0 = unlimited)")
+    s.add_argument("--slo-p95-s", type=float, default=None,
+                   help="p95 latency objective in s for the SLO tracker + /status "
+                        "(default: ZKP2P_SLO_P95_S; 0 = none)")
+    s.add_argument("--ts-sample-s", type=float, default=None,
+                   help="time-series sampler interval in s "
+                        "(default: ZKP2P_TS_SAMPLE_S; 0 = off)")
     s.set_defaults(fn=cmd_service)
 
     s = sub.add_parser("serve", help="serve the client order-book UI")

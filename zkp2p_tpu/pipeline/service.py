@@ -32,6 +32,7 @@ global invariant).
 
 from __future__ import annotations
 
+import contextlib
 import errno
 import json
 import os
@@ -40,8 +41,8 @@ import re
 import threading
 import time
 import traceback
-from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..formats.proof_json import dump
 from ..utils.audit import execution_digest, preflight, sample_device_memory
@@ -111,6 +112,34 @@ _TRANSIENT_ERRNOS = frozenset(
 )
 
 
+# Batch-fill histogram buckets: live requests per batch handed to the
+# prover (upper bounds; +Inf implicit).  Fill vs batch_size is THE
+# signal the ROADMAP-item-2 dynamic batch scheduler will size columns
+# from, so it is recorded as a distribution, not a last-write gauge.
+BATCH_FILL_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64)
+
+
+@contextlib.contextmanager
+def _lifespan(reqs, name: str, **attrs):
+    """Per-request lifecycle span — the waterfall substrate: brackets
+    `name` over every request in `reqs` (one Request or a batch list)
+    with ONE shared wall-clock [t0, t0+ms] interval appended to each
+    request's `spans` list, persisted on the request's next record and
+    exported by `trace_report --chrome-trace` (one pid per worker, one
+    tid per request).  Wall-clock (`time.time`), not perf_counter: the
+    waterfall is cross-process, so spans must share the spool's arrival
+    clock (req-file mtime).  Cost: one dict build + one append per
+    request per span — microseconds against multi-second proves."""
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        rec = {"name": name, "t0": round(t0, 6), "ms": round((time.time() - t0) * 1e3, 3)}
+        rec.update(attrs)
+        for r in (reqs if isinstance(reqs, (list, tuple)) else (reqs,)):
+            r.spans.append(dict(rec))
+
+
 def _is_transient(exc: BaseException) -> bool:
     """Transient = retry may genuinely succeed: injected faults (their
     whole point), allocation pressure, and the exhaustion slice of the
@@ -157,6 +186,154 @@ class Request:
     # slot in the batch the request was CLAIMED into (records keep the
     # original batch attribution across bisection)
     batch_index: Optional[int] = None
+    # lifecycle spans THIS sweep (witness/prove attempts/rungs/verify/
+    # emit, each {name, t0, ms, ...}) — persisted on every record the
+    # sweep emits, terminal or deferred, so the full waterfall survives
+    # defer→re-prove cycles as one sink line per attempt
+    spans: List[Dict] = field(default_factory=list)
+
+
+class TimeseriesSampler:
+    """Periodic service time-series: one `{"type": "timeseries", ...}`
+    line per interval (ZKP2P_TS_SAMPLE_S; 0 = off) appended to the
+    service's JSONL sink, so post-hoc analysis can correlate a latency
+    spike with the queue state that caused it (the signal SZKP-style
+    scheduling presumes and nothing here recorded before).
+
+    Line schema (docs/OBSERVABILITY.md §time-series):
+      ts / run_id / pid      identity (joins the run manifest)
+      window_s               actual seconds since the previous sample
+      arrivals               req files whose mtime landed in the window
+      arrival_rate_hz        arrivals / window_s
+      backlog                open requests (no terminal artifact yet)
+      claimable              backlog minus fresh-claimed peer work
+      in_flight              open requests under a fresh claim
+      batch_fill_last        live size of the newest batch handed to the prover
+      counters               cumulative service counters (registry values)
+      native_delta           nonzero native C stat deltas since the last sample
+      slo                    rolling-window SLO snapshot (utils.slo)
+      hbm_*                  device-memory point sample (absent on XLA:CPU)
+
+    One listdir + one stat per spool entry per sample — bounded by the
+    spool size the admission cap already bounds; measured ≪1 ms on
+    hundred-request spools."""
+
+    def __init__(self, interval_s: float, stale_claim_s: float = 300.0):
+        self.interval_s = interval_s
+        self.stale_claim_s = stale_claim_s
+        self.batch_fill_last = 0
+        self._last_ts: Optional[float] = None
+        self._last_native: Dict = {}
+
+    def _scan(self, spool: str, now: float, window_s: float) -> Dict:
+        arrivals = backlog = claimable = in_flight = 0
+        try:
+            names = set(os.listdir(spool))
+        except OSError:
+            return {"arrivals": 0, "backlog": 0, "claimable": 0, "in_flight": 0}
+        for fn in names:
+            if not fn.endswith(".req.json"):
+                continue
+            base = fn[: -len(".req.json")]
+            # arrivals count BEFORE the terminal skip: a request that
+            # arrived and completed inside one sample window is still
+            # an arrival (at smoke-scale prove times most are), or the
+            # reported arrival_rate_hz would track backlog growth
+            # instead of offered load
+            try:
+                if window_s > 0 and now - os.path.getmtime(os.path.join(spool, fn)) <= window_s:
+                    arrivals += 1
+            except OSError:
+                pass
+            if base + ".proof.json" in names or base + ".error.json" in names:
+                continue
+            backlog += 1
+            fresh = False
+            if base + ".claim" in names:
+                try:
+                    fresh = now - os.path.getmtime(os.path.join(spool, base + ".claim")) < self.stale_claim_s
+                except OSError:
+                    pass
+            if fresh:
+                in_flight += 1
+            else:
+                claimable += 1
+        return {
+            "arrivals": arrivals, "backlog": backlog,
+            "claimable": claimable, "in_flight": in_flight,
+        }
+
+    def maybe_sample(self, spool: str, sink: JsonlSink, force: bool = False) -> Optional[Dict]:
+        """Sample when the interval elapsed (or `force`); returns the
+        record (also written to `sink`) or None when off/not due.
+        Failures degrade to None — observation must never stop a sweep."""
+        if self.interval_s <= 0 and not force:
+            return None
+        now = time.time()
+        if not force and self._last_ts is not None and now - self._last_ts < self.interval_s:
+            return None
+        try:
+            window_s = (now - self._last_ts) if self._last_ts is not None else self.interval_s
+            self._last_ts = now
+            scan = self._scan(spool, now, window_s)
+            rec: Dict = {
+                "type": "timeseries",
+                "ts": round(now, 3),
+                "run_id": run_id(),
+                "pid": os.getpid(),
+                "window_s": round(window_s, 3),
+                "arrival_rate_hz": round(scan["arrivals"] / window_s, 4) if window_s > 0 else 0.0,
+                "batch_fill_last": self.batch_fill_last,
+                **scan,
+            }
+            # cumulative service counters out of the registry (post-hoc
+            # analysis diffs consecutive lines for rates)
+            counters: Dict[str, float] = {}
+            for m in REGISTRY.snapshot():
+                name = m["name"]
+                if not name.startswith("zkp2p_service_") or m["kind"] != "counter":
+                    continue
+                key = name[len("zkp2p_service_"):]
+                if key.endswith("_total"):
+                    key = key[: -len("_total")]
+                lab = m["labels"]
+                if lab:
+                    key += "_" + "_".join(str(v) for v in lab.values())
+                counters[key] = counters.get(key, 0) + m["value"]
+            rec["counters"] = counters
+            # live backlog gauges for the scrape (same numbers as the line)
+            REGISTRY.gauge("zkp2p_service_backlog").set(scan["backlog"])
+            REGISTRY.gauge("zkp2p_service_in_flight").set(scan["in_flight"])
+            # native C stat deltas since the last sample, nonzero only
+            try:
+                from ..native.lib import stats_snapshot
+
+                snap = stats_snapshot()
+            except Exception:  # noqa: BLE001 — numpy-less env, no .so
+                snap = None
+            if snap:
+                delta = {
+                    k: v - self._last_native.get(k, 0)
+                    for k, v in snap.items()
+                    if v != self._last_native.get(k, 0)
+                }
+                self._last_native = dict(snap)
+                if delta:
+                    rec["native_delta"] = delta
+            try:
+                from ..utils.slo import default_tracker
+
+                rec["slo"] = default_tracker().snapshot()
+            except Exception:  # noqa: BLE001 — observation only
+                pass
+            mem = sample_device_memory("service/timeseries")
+            if mem is not None:
+                rec["hbm_bytes_in_use"] = mem["bytes_in_use"]
+                rec["hbm_peak_bytes"] = mem["peak_bytes_in_use"]
+            sink.write(rec)
+            return rec
+        except Exception:  # noqa: BLE001 — the sweep must not die for a sample
+            return None
 
 
 class ProvingService:
@@ -233,6 +410,9 @@ class ProvingService:
         self._knobs: Optional[Dict] = None
         self._sink_override: Optional[str] = None
         self._resolved = False
+        # time-series sampler (run() installs one when ZKP2P_TS_SAMPLE_S
+        # > 0; process_dir works standalone without it)
+        self._sampler: Optional["TimeseriesSampler"] = None
 
     def _resolve_policy(self) -> None:
         """Fill constructor-None policy knobs from the typed config,
@@ -307,6 +487,20 @@ class ProvingService:
                 rec["batch_index"] = batch_index
             if batch_n is not None:
                 rec["batch_n"] = batch_n
+            # request waterfall: absolute arrival/claim timestamps, the
+            # queue-wait they bound, and this sweep's lifecycle spans.
+            # queue_wait_s is anchored to the req-file mtime, so across
+            # defer→re-prove cycles (and worker takeovers) it is the
+            # CUMULATIVE wait since the request entered the spool, not
+            # this attempt's slice.
+            if req.t_submit:
+                rec["t_submit"] = round(req.t_submit, 6)
+            if req.t_claim:
+                rec["t_claim"] = round(req.t_claim, 6)
+                if req.t_submit:
+                    rec["queue_wait_s"] = round(max(0.0, req.t_claim - req.t_submit), 6)
+            if req.spans:
+                rec["spans"] = req.spans
             if extra:
                 rec.update(extra)
             if req.error:
@@ -324,7 +518,52 @@ class ProvingService:
             self._sink(spool).write(rec)
         except Exception:  # noqa: BLE001 — observation must never fail a prove
             pass
-        REGISTRY.counter("zkp2p_service_requests_total", {"state": state}).inc()
+        if state in TERMINAL_STATES:
+            REGISTRY.counter("zkp2p_service_requests_total", {"state": state}).inc()
+            # SLO accounting: full-life latency (spool arrival ->
+            # terminal) into the rolling-window tracker; only `done`
+            # counts as good (docs/OBSERVABILITY.md §SLO).  The anchor
+            # falls back to claim time for requests with no readable
+            # arrival mtime (torn uploads).
+            # observe() only here — O(1).  The zkp2p_slo_* gauges are
+            # refreshed where they are READ (the /metrics scrape and the
+            # time-series sampler both snapshot): a per-terminal
+            # publish_slo() would sort the whole rolling window (tens of
+            # thousands of samples at saturation) on every request.
+            try:
+                from ..utils.slo import default_tracker
+
+                anchor = req.t_submit or req.t_claim
+                if anchor:
+                    default_tracker().observe(time.time() - anchor, ok=(state == "done"))
+            except Exception:  # noqa: BLE001 — observation only
+                pass
+        else:
+            # non-terminal sweep outcome (deferred): its own counter —
+            # requests_total stays one-inc-per-TERMINAL-transition
+            REGISTRY.counter("zkp2p_service_deferred_total").inc()
+
+    def _record_deferred(
+        self,
+        spool: str,
+        req: Request,
+        reason: object,
+        knobs: Dict,
+        batch_index: Optional[int] = None,
+        batch_n: Optional[int] = None,
+    ) -> None:
+        """Record a NON-terminal sweep outcome: the claim was released
+        for a later sweep to retry (transient witness/emit failure,
+        error-artifact write failure).  One `state="deferred"` line per
+        attempt — with that attempt's spans and the cumulative
+        queue_wait_s — so the request's full history survives
+        defer→re-prove cycles: the eventual terminal record alone would
+        erase every earlier attempt from the timeline."""
+        self._emit_record(
+            spool, req, "deferred", knobs,
+            batch_index=batch_index, batch_n=batch_n,
+            deferred_reason=str(reason)[:200],
+        )
 
     # ------------------------------------------------------------- claims
     #
@@ -375,7 +614,13 @@ class ProvingService:
                     return False
                 os.rename(claim, stale_aside)
             except OSError:
-                return False  # lost the steal race (or owner just completed)
+                # the kernel picked another taker (or the owner just
+                # completed): a steal ATTEMPTED and lost — counted, so
+                # production can watch takeover contention (PR 7 built
+                # the mechanism; this is the meter on it)
+                REGISTRY.counter("zkp2p_service_takeovers_total", {"result": "lost"}).inc()
+                return False
+            REGISTRY.counter("zkp2p_service_takeovers_total", {"result": "won"}).inc()
             try:
                 os.unlink(stale_aside)
             except OSError:
@@ -476,6 +721,12 @@ class ProvingService:
         except Exception:  # noqa: BLE001 — the error artifact failed to write
             self._release_claim(req.path)
             req.deferred = True
+            # best-effort deferred record (the sink may sit on the same
+            # full disk — _emit_record swallows its own failures)
+            self._record_deferred(
+                spool, req, f"error-artifact write failed for {state}", knobs,
+                batch_index=batch_index, batch_n=batch_n,
+            )
             return False
         self._emit_record(spool, req, state, knobs, batch_index=batch_index, batch_n=batch_n)
         req.done = state
@@ -488,27 +739,40 @@ class ProvingService:
     # of it runs on the consumer thread under the batch's heartbeat, so
     # claim age stays bounded however long the rescue takes.
 
-    def _prove_verified(self, batch: List[Request]) -> list:
+    def _prove_verified(
+        self, batch: List[Request], attempt: int = 0, rung: Optional[str] = None,
+    ) -> list:
         """One prover call over `batch` + the sample verify.  Raises on
         ANY failure — including a prover that returns the wrong number
-        of proofs, which a bare zip() would silently truncate."""
+        of proofs, which a bare zip() would silently truncate.
+        `attempt`/`rung` label this call's lifecycle span so retries,
+        bisection halves, and degradation rungs all show as child spans
+        on the request waterfall (failed attempts included — the span
+        closes on the way out of the exception)."""
         from ..prover.groth16_tpu import prove_tpu_batch
         from ..snark.groth16 import verify
 
-        fault_point("prove")
-        with trace("service/prove", n=len(batch), request_ids=[r.rid for r in batch]):
-            prove = self.prover_fn or prove_tpu_batch
-            proofs = prove(self.dpk, [r.witness for r in batch])
+        span_attrs: Dict = {"n": len(batch)}
+        if attempt:
+            span_attrs["attempt"] = attempt
+        if rung:
+            span_attrs["rung"] = rung
+        with _lifespan(batch, "prove", **span_attrs):
+            fault_point("prove")
+            with trace("service/prove", n=len(batch), request_ids=[r.rid for r in batch]):
+                prove = self.prover_fn or prove_tpu_batch
+                proofs = prove(self.dpk, [r.witness for r in batch])
         proofs = list(proofs) if proofs is not None else []
         if len(proofs) != len(batch):
             raise RuntimeError(
                 f"prover returned {len(proofs)} proofs for a batch of {len(batch)}"
             )
-        fault_point("verify")
-        with trace("service/verify"):
-            sample_pub = self.public_fn(batch[0].witness)
-            if not verify(self.vk, proofs[0], sample_pub):
-                raise RuntimeError("sample proof failed verification")
+        with _lifespan(batch, "verify"):
+            fault_point("verify")
+            with trace("service/verify"):
+                sample_pub = self.public_fn(batch[0].witness)
+                if not verify(self.vk, proofs[0], sample_pub):
+                    raise RuntimeError("sample proof failed verification")
         return proofs
 
     def _prove_with_retries(self, batch: List[Request]) -> list:
@@ -518,7 +782,7 @@ class ProvingService:
         attempt = 0
         while True:
             try:
-                return self._prove_verified(batch)
+                return self._prove_verified(batch, attempt=attempt)
             except Exception as e:  # noqa: BLE001 — classified below
                 if attempt >= self._retries or not _is_transient(e):
                     raise
@@ -526,7 +790,10 @@ class ProvingService:
                 REGISTRY.counter("zkp2p_service_retries_total").inc()
                 delay = min(self._retry_backoff_s * (2 ** (attempt - 1)), 30.0)
                 if delay > 0:
-                    time.sleep(delay)
+                    # backoff is part of the request's latency story:
+                    # span it so the waterfall shows waiting, not a gap
+                    with _lifespan(batch, "retry_backoff", attempt=attempt):
+                        time.sleep(delay)
 
     def _degraded_prove(self, batch: List[Request], cause: BaseException):
         """Last resort before error-failed-to-prove: walk the
@@ -546,7 +813,7 @@ class ProvingService:
             saved = {k: os.environ.get(k) for k in overlay}
             os.environ.update(overlay)
             try:
-                proofs = self._prove_verified(batch)
+                proofs = self._prove_verified(batch, rung=rung)
                 REGISTRY.counter("zkp2p_service_degraded_total", {"rung": rung}).inc()
                 return proofs, rung
             except Exception as e:  # noqa: BLE001 — try the next rung
@@ -610,15 +877,16 @@ class ProvingService:
             set_context(request_id=req.rid)
             try:
                 try:
-                    fault_point("emit")
-                    with trace("service/emit"):
-                        # public first, proof last: the sweep treats
-                        # .proof.json as the done marker, so a crash
-                        # between the two atomic writes leaves a
-                        # retryable request, never a proof without its
-                        # public signals
-                        dump(public_to_json(self.public_fn(req.witness)), req.path + ".public.json")
-                        dump(proof_to_json(proof), req.path + ".proof.json")
+                    with _lifespan(req, "emit"):
+                        fault_point("emit")
+                        with trace("service/emit"):
+                            # public first, proof last: the sweep treats
+                            # .proof.json as the done marker, so a crash
+                            # between the two atomic writes leaves a
+                            # retryable request, never a proof without its
+                            # public signals
+                            dump(public_to_json(self.public_fn(req.witness)), req.path + ".public.json")
+                            dump(proof_to_json(proof), req.path + ".proof.json")
                 except Exception as e:  # noqa: BLE001 — emit failure is per-request
                     REGISTRY.counter("zkp2p_service_emit_failures_total").inc()
                     if _is_transient(e):
@@ -627,9 +895,15 @@ class ProvingService:
                         # would fail on the same full disk — so the
                         # request stays NON-terminal: claim released, a
                         # later sweep re-proves it (at-least-once).  Its
-                        # batchmates continue below.
+                        # batchmates continue below.  The attempt still
+                        # leaves a deferred record, so the waterfall
+                        # keeps the prove this sweep paid for.
                         req.deferred = True
                         self._release_claim(req.path)
+                        self._record_deferred(
+                            spool, req, f"transient emit failure: {e}", knobs,
+                            batch_index=req.batch_index, batch_n=batch_n,
+                        )
                     else:
                         # deterministic emit-time failure (public_fn
                         # compute error): deferring would livelock the
@@ -793,7 +1067,7 @@ class ProvingService:
         def scalar_witness(req: Request) -> bool:
             set_context(request_id=req.rid)
             try:
-                with trace("service/witness"):
+                with trace("service/witness"), _lifespan(req, "witness"):
                     fault_point("witness")
                     req.witness = self.witness_fn(req.payload)
                     self.cs.check_witness(req.witness)
@@ -806,6 +1080,7 @@ class ProvingService:
                     REGISTRY.counter("zkp2p_service_retries_total").inc()
                     self._release_claim(req.path)
                     req.deferred = True
+                    self._record_deferred(spool, req, f"transient witness failure: {e}", knobs)
                     return False
                 self._terminal_error(spool, req, "error-bad-input", e, knobs, stats)
                 return False
@@ -822,7 +1097,7 @@ class ProvingService:
             for req in cand:
                 try:
                     set_context(request_id=req.rid)
-                    with trace("service/inputs"):
+                    with trace("service/inputs"), _lifespan(req, "inputs"):
                         fault_point("witness")
                         inputs.append(self.inputs_fn(req.payload))
                     batch.append(req)
@@ -831,6 +1106,7 @@ class ProvingService:
                         REGISTRY.counter("zkp2p_service_retries_total").inc()
                         self._release_claim(req.path)
                         req.deferred = True
+                        self._record_deferred(spool, req, f"transient inputs failure: {e}", knobs)
                     else:
                         self._terminal_error(spool, req, "error-bad-input", e, knobs, stats)
                 finally:
@@ -838,7 +1114,8 @@ class ProvingService:
             if not batch:
                 return []
             try:
-                with trace("service/witness_batch", n=len(batch)):
+                with trace("service/witness_batch", n=len(batch)), \
+                        _lifespan(batch, "witness_batch", n=len(batch)):
                     ws = self.cs.witness_batch(inputs)
                 # EVERY witness gets the Az∘Bz=Cz self-check, exactly like
                 # the scalar tier — only checking a sample would let an
@@ -942,6 +1219,14 @@ class ProvingService:
                 continue
             for bi, req in enumerate(live):
                 req.batch_index = bi
+            # batch-fill distribution: live requests per prover call —
+            # fill vs batch_size is the amortization signal the dynamic
+            # batch scheduler (ROADMAP item 2) will size columns from
+            REGISTRY.histogram(
+                "zkp2p_service_batch_fill", buckets=BATCH_FILL_BUCKETS
+            ).observe(len(live))
+            if self._sampler is not None:
+                self._sampler.batch_fill_last = len(live)
             try:
                 self._prove_isolating(spool, live, knobs, stats, batch_n=len(live))
             except Exception as e:  # noqa: BLE001 — safety net
@@ -1033,6 +1318,17 @@ class ProvingService:
             )
         except Exception:  # noqa: BLE001 — observation must never stop the service
             pass
+        # service observability arms + time-series sampler: the SLO
+        # objective and sampler interval are digest-visible gates (a
+        # sampler-off A/B differs from sampler-on only on these), and
+        # the sampler appends zkp2p_timeseries lines to the same sink
+        # the request records ride.
+        from ..utils.config import load_config
+        from ..utils.slo import slo_arm, timeseries_arm
+
+        slo_arm()
+        timeseries_arm()
+        self._sampler = TimeseriesSampler(load_config().ts_sample_s, self.stale_claim_s)
         sweeps = 0
         while max_sweeps is None or sweeps < max_sweeps:
             stats = self.process_dir(spool)
@@ -1054,5 +1350,9 @@ class ProvingService:
                 except Exception:  # noqa: BLE001 — observation only
                     pass
                 publish_native_stats()
+            # time-series tick rides the sweep cadence (interval-gated
+            # inside; idle sweeps still sample, so a quiet queue is a
+            # recorded fact, not a gap in the series)
+            self._sampler.maybe_sample(spool, self._sink(spool))
             sweeps += 1
             time.sleep(poll_s)

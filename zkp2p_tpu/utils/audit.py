@@ -107,6 +107,19 @@ def reset() -> None:
     _arms.clear()
 
 
+# Summary of the most recent preflight() this process ran (None until
+# one has).  The /status health route fails CLOSED on None: a scrape
+# must never report "healthy" for a process whose gates nobody armed —
+# the round-2 silent disarm applied to the health surface.
+_preflight_report: Optional[Dict] = None
+
+
+def last_preflight() -> Optional[Dict]:
+    """{ts, backend, warnings, execution_digest} of the latest preflight
+    run in this process, or None when none has run."""
+    return _preflight_report
+
+
 # ---------------------------------------------------------------------------
 # Flight recorder, part 1: HBM watermarks.  `device.memory_stats()` is
 # a cheap C call on TPU and None on CPU — the device list is probed once
@@ -364,6 +377,14 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
 
     faults_arm()
 
+    # service observability gates (utils.slo): the SLO objective and the
+    # time-series sampler interval — an A/B with the sampler off must be
+    # digest-distinguishable from one with it on, like the fault gate
+    from .slo import slo_arm, timeseries_arm
+
+    slo_arm()
+    timeseries_arm()
+
     if workload and backend != "unavailable":
         # one tiny jitted op: proves the backend executes and ticks the
         # compile listener.  Deliberately NOT a gated field mul — a
@@ -392,6 +413,13 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
         )
     report["device_memory"] = sample_device_memory("preflight")
     report["execution_digest"] = execution_digest()
+    global _preflight_report
+    _preflight_report = {
+        "ts": report["ts"],
+        "backend": backend,
+        "warnings": len(report["warnings"]),
+        "execution_digest": report["execution_digest"],
+    }
     if log is not None:
         for msg in report["warnings"]:
             log(f"PREFLIGHT WARNING: {msg}")

@@ -99,6 +99,20 @@ def _nonneg_float(default: float):
     return parse
 
 
+def _fraction(default: float):
+    # SLO target fraction: must land strictly inside (0, 1) — a target
+    # of 0 or 1 makes the burn-rate denominator meaningless; malformed
+    # or out-of-range keeps the committed default
+    def parse(s: str) -> float:
+        try:
+            v = float(s)
+        except ValueError:
+            return default
+        return v if 0.0 < v < 1.0 else default
+
+    return parse
+
+
 KNOBS: Dict[str, Tuple[str, object, object]] = {
     # device (XLA/Pallas) prover MSM tiers — see prover.groth16_tpu
     "msm_window": ("ZKP2P_MSM_WINDOW", int, 4),
@@ -219,6 +233,20 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "spool_cap": ("ZKP2P_SPOOL_CAP", _nonneg_int(0), 0),
     "prove_retries": ("ZKP2P_PROVE_RETRIES", _nonneg_int(2), 2),
     "retry_backoff_s": ("ZKP2P_RETRY_BACKOFF_S", _nonneg_float(0.25), 0.25),
+    # service-level SLO (utils.slo; docs/OBSERVABILITY.md §SLO): the
+    # p95 latency objective in seconds over the request's FULL life
+    # (spool arrival -> terminal; 0 = no objective, the tracker still
+    # records window latencies), the attainment target fraction behind
+    # the burn-rate math, and the rolling-window length the tracker
+    # aggregates over.
+    "slo_p95_s": ("ZKP2P_SLO_P95_S", _nonneg_float(0.0), 0.0),
+    "slo_target": ("ZKP2P_SLO_TARGET", _fraction(0.95), 0.95),
+    "slo_window_s": ("ZKP2P_SLO_WINDOW_S", _nonneg_float(300.0), 300.0),
+    # time-series sampler interval (pipeline.service.TimeseriesSampler):
+    # every interval the service loop appends a `zkp2p_timeseries` line
+    # (arrival rate, claimable backlog, in-flight fill, rescue counters,
+    # native stats deltas, HBM gauges) to the JSONL sink.  0 = off.
+    "ts_sample_s": ("ZKP2P_TS_SAMPLE_S", _nonneg_float(10.0), 10.0),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -264,6 +292,10 @@ class ProverConfig:
     spool_cap: int = 0
     prove_retries: int = 2
     retry_backoff_s: float = 0.25
+    slo_p95_s: float = 0.0
+    slo_target: float = 0.95
+    slo_window_s: float = 300.0
+    ts_sample_s: float = 10.0
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 

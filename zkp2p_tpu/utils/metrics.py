@@ -36,6 +36,43 @@ DEFAULT_MS_BUCKETS: Tuple[float, ...] = (
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
+# `# HELP` text per metric family for the Prometheus exposition (0.0.4
+# requires one HELP/TYPE block per family; an unknown family gets a
+# generic pointer at the docs).  Kept HERE — beside the exposition —
+# rather than at the scattered call sites, so the scrape never emits a
+# family without its block.
+METRIC_HELP: Dict[str, str] = {
+    "zkp2p_stage_ms": "Stage latency histogram fed by every trace() span",
+    "zkp2p_proves_total": "Proofs produced, by prover backend",
+    "zkp2p_service_requests_total": "Terminal request transitions, by state (docs/ROBUSTNESS.md state machine)",
+    "zkp2p_service_retries_total": "Transient failures retried or deferred instead of terminal-ed",
+    "zkp2p_service_bisections_total": "Batch proves split in half to isolate a poisoned request",
+    "zkp2p_service_degraded_total": "Proves rescued by the degradation ladder, by rung",
+    "zkp2p_service_deadline_total": "Requests terminal-ed error-deadline-exceeded",
+    "zkp2p_service_shed_total": "Requests shed by the spool admission cap",
+    "zkp2p_service_emit_failures_total": "Proof-emit failures (transient ones defer the request)",
+    "zkp2p_service_deferred_total": "Non-terminal sweep outcomes: claim released for a later sweep to retry",
+    "zkp2p_service_takeovers_total": "Stale-claim steal attempts, by result (won|lost)",
+    "zkp2p_service_batch_fill": "Live requests per batch handed to the prover (fill vs batch_size)",
+    "zkp2p_service_backlog": "Open spool requests at the last time-series sample",
+    "zkp2p_service_in_flight": "Open spool requests under a fresh claim at the last time-series sample",
+    "zkp2p_slo_attainment": "Fraction of rolling-window requests meeting the SLO (1.0 on an empty window)",
+    "zkp2p_slo_burn_rate": "(1-attainment)/(1-target): error-budget burn multiple; 1.0 = at target",
+    "zkp2p_slo_window_p95_s": "Exact p95 request latency (arrival->terminal) over the rolling window",
+    "zkp2p_slo_window_requests": "Requests in the rolling SLO window",
+    "zkp2p_slo_objective_s": "Configured p95 latency objective (ZKP2P_SLO_P95_S; 0 = none)",
+    "zkp2p_trace_dropped_total": "Trace ring-buffer overflow evictions",
+    "zkp2p_path_taken": "Gate consultations by resolved arm (execution audit)",
+    "zkp2p_compile_events_total": "XLA/jit compiles attributed to the triggering trace stage",
+    "zkp2p_compile_seconds_total": "XLA/jit compile seconds attributed to the triggering trace stage",
+    "zkp2p_hbm_bytes_in_use": "Live device memory per device",
+    "zkp2p_hbm_peak_bytes": "Process-lifetime device memory high-water mark per device",
+    "zkp2p_hbm_bytes_limit": "Device memory capacity per device",
+    "zkp2p_hbm_stage_peak_bytes": "Max-semantics per-stage device memory peak",
+    "zkp2p_precomp_table_bytes": "Resident fixed-base table bytes per G1 family",
+    "zkp2p_precomp_total_bytes": "Resident fixed-base table bytes, all families",
+}
+
 
 def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
     if not labels:
@@ -230,6 +267,14 @@ class Registry:
             by_name.setdefault((m.name, m.kind), []).append(m)
         out: List[str] = []
         for (name, kind), ms in sorted(by_name.items()):
+            # native gauges share one templated help line; everything
+            # else resolves through METRIC_HELP (0.0.4 HELP text escapes
+            # only backslash and newline — quotes stay literal)
+            if name.startswith("zkp2p_native_"):
+                help_s = f"Mirror of the native C stats slot {name[len('zkp2p_native_'):]}"
+            else:
+                help_s = METRIC_HELP.get(name, "zkp2p metric (docs/OBSERVABILITY.md)")
+            out.append("# HELP %s %s" % (name, help_s.replace("\\", r"\\").replace("\n", r"\n")))
             out.append(f"# TYPE {name} {kind}")
             for m in ms:
                 if kind == "histogram":
@@ -479,15 +524,40 @@ def maybe_start_metrics_server(port: Optional[int] = None, registry: Optional[Re
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 — stdlib API
-                if self.path.rstrip("/") in ("", "/metrics".rstrip("/")):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path in ("", "/metrics"):
                     publish_native_stats(reg)  # scrape-time native refresh
-                    body = reg.to_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain; version=0.0.4")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    try:  # scrape-time SLO gauge refresh (same contract)
+                        from .slo import publish_slo
+
+                        publish_slo(reg)
+                    except Exception:  # noqa: BLE001 — exposition only
+                        pass
+                    self._send(200, reg.to_prometheus().encode(), "text/plain; version=0.0.4")
+                elif path == "/status":
+                    # fails CLOSED (503) while preflight hasn't run: a
+                    # load balancer must not route to a worker whose
+                    # gates nobody armed (slo.status_payload docs)
+                    try:
+                        from .slo import status_payload
+
+                        body = status_payload()
+                        code = 200 if body.get("ok") else 503
+                    except Exception as e:  # noqa: BLE001 — degraded, not dead
+                        body, code = {"ok": False, "reason": f"status error: {e}"}, 500
+                    self._send(code, (json.dumps(body) + "\n").encode(), "application/json")
+                elif path == "/healthz":
+                    # liveness only: the process is up and serving HTTP.
+                    # Readiness (gates armed, SLO state) is /status's job.
+                    self._send(200, b'{"ok": true}\n', "application/json")
                 else:
                     self.send_response(404)
                     self.end_headers()

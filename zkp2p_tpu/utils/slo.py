@@ -1,0 +1,256 @@
+"""Service-level-objective engine for the proving service.
+
+The prover core is instrumented to the nanosecond (stats block, trace
+spans, execution digests) but nothing answered the question a
+*deployment* asks: "are we meeting our latency objective right now, and
+how fast are we burning the error budget?"  This module is that answer:
+a rolling-window latency tracker with an explicit objective
+(`ZKP2P_SLO_P95_S`), attainment + burn-rate math, gauges on the
+Prometheus endpoint, and the `/status` JSON payload.
+
+Definitions (the standard SRE framing):
+
+  objective   latency bound in seconds over a request's FULL life —
+              spool arrival (req-file mtime) to terminal artifact.
+              0 = no objective configured (latencies still tracked).
+  good        a request that terminal'd `done` within the objective
+              (with no objective: any `done`).
+  attainment  good / total over the rolling window (1.0 on an empty
+              window — no traffic is not an outage).
+  burn rate   (1 - attainment) / (1 - target): how many times faster
+              than sustainable the error budget is burning.  1.0 =
+              exactly at target; 0 = no misses; >1 = paging territory.
+
+Design constraints match utils.metrics: stdlib only, GIL-cheap
+`observe()` (deque append + opportunistic prune), bounded memory
+(window cap, evictions counted), and observation must never fail the
+prove around it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+# Hard cap on samples held regardless of the time window: a runaway
+# arrival burst must not grow the deque unboundedly.  Evictions beyond
+# the cap are counted in the snapshot (`capped`), never silent.
+MAX_WINDOW_SAMPLES = 65536
+
+
+class SloTracker:
+    """Rolling-window latency/outcome tracker.
+
+    `observe(latency_s, ok)` per terminal request; `snapshot()` computes
+    attainment, burn rate, and exact window percentiles.  The clock is
+    injectable (tests drive synthetic time)."""
+
+    def __init__(
+        self,
+        objective_s: float = 0.0,
+        target: float = 0.95,
+        window_s: float = 300.0,
+        clock=time.monotonic,
+    ):
+        self.objective_s = max(0.0, float(objective_s))
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"SLO target must be in (0,1), got {target}")
+        self.target = target
+        self.window_s = max(0.0, float(window_s))
+        self._clock = clock
+        # (t, latency_s, good) triples, oldest first
+        self._samples: deque = deque()
+        self._lock = threading.Lock()
+        self._capped = 0  # samples evicted by MAX_WINDOW_SAMPLES
+
+    def _is_good(self, latency_s: float, ok: bool) -> bool:
+        if not ok:
+            return False
+        return self.objective_s <= 0 or latency_s <= self.objective_s
+
+    def observe(self, latency_s: float, ok: bool = True, now: Optional[float] = None) -> None:
+        t = self._clock() if now is None else now
+        with self._lock:
+            self._samples.append((t, float(latency_s), self._is_good(latency_s, ok)))
+            if len(self._samples) > MAX_WINDOW_SAMPLES:
+                self._samples.popleft()
+                self._capped += 1
+            self._prune(t)
+
+    def _prune(self, now: float) -> None:
+        # caller holds the lock; window_s == 0 keeps everything (the
+        # loadgen uses an unbounded-window tracker per ramp step)
+        if self.window_s <= 0:
+            return
+        edge = now - self.window_s
+        while self._samples and self._samples[0][0] < edge:
+            self._samples.popleft()
+
+    def snapshot(self, now: Optional[float] = None) -> Dict:
+        """Attainment, burn rate, and window percentiles — the payload
+        behind the `zkp2p_slo_*` gauges and `/status`."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            self._prune(t)
+            samples = list(self._samples)
+            capped = self._capped
+        lats = sorted(s[1] for s in samples)
+        n = len(samples)
+        good = sum(1 for s in samples if s[2])
+        # empty window = vacuous attainment: no traffic is not an outage
+        attainment = (good / n) if n else 1.0
+        burn = (1.0 - attainment) / (1.0 - self.target)
+
+        def pct(q: float) -> float:
+            if not lats:
+                return 0.0
+            k = max(0, min(n - 1, int(round(q * (n - 1)))))
+            return lats[k]
+
+        return {
+            "objective_p95_s": self.objective_s,
+            "target": self.target,
+            "window_s": self.window_s,
+            "n": n,
+            "good": good,
+            "attainment": round(attainment, 6),
+            "burn_rate": round(burn, 4),
+            "p50_s": round(pct(0.50), 6),
+            "p95_s": round(pct(0.95), 6),
+            "max_s": round(lats[-1], 6) if lats else 0.0,
+            "capped": capped,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default tracker, resolved once from the typed config (the
+# service and the exposition endpoint share ONE window; a per-consumer
+# tracker would let /status and /metrics disagree about attainment).
+
+_default: Optional[SloTracker] = None
+_default_lock = threading.Lock()
+
+
+def default_tracker() -> SloTracker:
+    global _default
+    with _default_lock:
+        if _default is None:
+            from .config import load_config
+
+            cfg = load_config()
+            _default = SloTracker(
+                objective_s=cfg.slo_p95_s, target=cfg.slo_target, window_s=cfg.slo_window_s
+            )
+        return _default
+
+
+def _reset() -> None:
+    """Drop the default tracker so the next consumer re-reads the config
+    (tests)."""
+    global _default
+    with _default_lock:
+        _default = None
+
+
+def publish_slo(registry=None) -> Dict:
+    """Refresh the `zkp2p_slo_*` gauges from the default tracker (called
+    per terminal record and per scrape); returns the snapshot."""
+    from .metrics import REGISTRY
+
+    reg = registry if registry is not None else REGISTRY
+    snap = default_tracker().snapshot()
+    reg.gauge("zkp2p_slo_attainment").set(snap["attainment"])
+    reg.gauge("zkp2p_slo_burn_rate").set(snap["burn_rate"])
+    reg.gauge("zkp2p_slo_window_p95_s").set(snap["p95_s"])
+    reg.gauge("zkp2p_slo_window_requests").set(snap["n"])
+    reg.gauge("zkp2p_slo_objective_s").set(snap["objective_p95_s"])
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# Audit gates: the SLO objective and the time-series sampler are service
+# observability arms — two runs with different objectives (or sampler
+# on/off) must be digest-distinguishable, exactly like the fault gate.
+
+
+def slo_arm() -> str:
+    """record_arm the SLO configuration: 'off' or 'p95=<s>s@<target>'."""
+    from .audit import record_arm
+    from .config import load_config
+
+    cfg = load_config()
+    arm = "off" if cfg.slo_p95_s <= 0 else f"p95={cfg.slo_p95_s:g}s@{cfg.slo_target:g}"
+    return record_arm("service_slo", arm)
+
+
+def timeseries_arm() -> str:
+    """record_arm the sampler interval: 'off' or '<interval>s'."""
+    from .audit import record_arm
+    from .config import load_config
+
+    cfg = load_config()
+    arm = "off" if cfg.ts_sample_s <= 0 else f"{cfg.ts_sample_s:g}s"
+    return record_arm("service_timeseries", arm)
+
+
+# ---------------------------------------------------------------------------
+# /status payload.  Fails CLOSED while preflight has not run: a scrape
+# that answers "healthy" before the gates were armed would report a
+# service whose code paths nobody has proven — the round-2 silent-disarm
+# lesson applied to the health surface.
+
+_t_start = time.time()
+
+
+def status_payload() -> Dict:
+    """The `/status` JSON: ok flag (preflight-gated), SLO snapshot,
+    request-state counters, rescue-ladder counters, and identity.  The
+    HTTP layer maps ok=False to a 503."""
+    import os
+
+    from .audit import execution_digest, last_preflight
+    from .metrics import REGISTRY, run_id
+
+    pf = last_preflight()
+    body: Dict = {
+        "ok": pf is not None,
+        "ts": round(time.time(), 3),
+        "run_id": run_id(),
+        "pid": os.getpid(),
+        "uptime_s": round(time.time() - _t_start, 3),
+        "execution_digest": execution_digest(),
+    }
+    if pf is None:
+        body["reason"] = "preflight has not run (gates unarmed; see zkp2p-tpu doctor)"
+    else:
+        body["preflight"] = pf
+    body["slo"] = default_tracker().snapshot()
+    # request-state + rescue counters out of the registry snapshot (the
+    # registry exposes no by-name getter on purpose — get-or-create
+    # would mint zero-valued instruments on every status read)
+    states: Dict[str, float] = {}
+    counters: Dict[str, float] = {}
+    wanted = {
+        "zkp2p_service_retries_total": "retries",
+        "zkp2p_service_bisections_total": "bisections",
+        "zkp2p_service_deadline_total": "deadline",
+        "zkp2p_service_shed_total": "shed",
+        "zkp2p_service_emit_failures_total": "emit_failures",
+        "zkp2p_service_deferred_total": "deferred",
+    }
+    for rec in REGISTRY.snapshot():
+        name = rec["name"]
+        if name == "zkp2p_service_requests_total":
+            states[rec["labels"].get("state", "?")] = rec["value"]
+        elif name in wanted:
+            counters[wanted[name]] = counters.get(wanted[name], 0) + rec["value"]
+        elif name == "zkp2p_service_degraded_total":
+            counters["degraded"] = counters.get("degraded", 0) + rec["value"]
+        elif name == "zkp2p_service_takeovers_total":
+            key = "takeovers_" + rec["labels"].get("result", "?")
+            counters[key] = counters.get(key, 0) + rec["value"]
+    body["requests"] = states
+    body["counters"] = counters
+    return body
