@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -66,6 +66,16 @@ chaos-smoke: native
 # docs/OBSERVABILITY.md §loadgen; ~20 s on the 2-core box.
 loadgen-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_loadgen.py -q
+
+# Non-MSM floor smoke (fast; tier-1 resident): segmented-matvec byte
+# parity vs the scatter oracle across {threads}x{tier}, pool-NTT and
+# fused-ladder parity vs the knob-off arms (incl. the 2^19 bench-shape
+# domain), plan-cache round-trip with tamper rejection, and the
+# shared-executor churn regression.  The isolated perf read is
+# `python tools/msm_hwbench.py --ladder --n 524288` — see
+# docs/TUNING.md §non-MSM; ~15 s on the 2-core box.
+nonmsm-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_nonmsm.py -q
 
 # Execution-path preflight (docs/OBSERVABILITY.md §execution audit):
 # probe the backend, arm EVERY gate through its real resolver, print

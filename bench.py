@@ -271,6 +271,31 @@ def _native_fallback_bench(plat: str) -> bool:
         f"native fallback: venmo {cs.num_constraints} constraints, first={first:.1f}s "
         f"steady best={best:.1f}s p50-of-{len(steady)}={p50:.1f}s"
     )
+    # The non-MSM floor (witness_convert + matvec + h_ladder): summed
+    # per-stage p50 over the steady reps, pulled from the in-process
+    # trace ring — the serial floor under both single-proof latency and
+    # QPS-under-SLO, tracked per round now that the MSMs are tiered
+    # (docs/TUNING.md §non-MSM).  Read-only on the ring: the dump below
+    # still carries every record.
+    nonmsm_s = None
+    try:
+        from zkp2p_tpu.utils.trace import records as _trace_records
+
+        stage_ms = {"witness_convert": [], "matvec": [], "h_ladder": []}
+        for rec in _trace_records():
+            st = rec.get("stage", "")
+            if not st.startswith("prove_native"):
+                continue  # first_prove / batch spans are not steady reps
+            for name, vals in stage_ms.items():
+                if st.endswith("/native/" + name):
+                    vals.append(rec["ms"])
+        if all(stage_ms.values()):
+            nonmsm_s = round(
+                sum(sorted(v)[(len(v) - 1) // 2] for v in stage_ms.values()) / 1e3, 4
+            )
+            log(f"nonmsm floor (witness_convert+matvec+h_ladder p50): {nonmsm_s:.3f}s")
+    except Exception:  # noqa: BLE001 — observability must never sink the tier
+        pass
     # Batched arm: whole-batch proofs/s through prove_native_batch (the
     # multi-column MSM fast path — one base sweep per G1 MSM family,
     # batch_n scalar columns) next to the batch=1 number above.  Rides
@@ -403,6 +428,9 @@ def _native_fallback_bench(plat: str) -> bool:
                 "msm_batch_affine": bool(ba_on),
                 "msm_overlap": bool(ov_on),
                 "msm_multi": bool(mu_on),
+                # the non-MSM serial floor this tier sums per steady rep
+                # (witness_convert + matvec + h_ladder stage p50s)
+                **({"nonmsm_s": nonmsm_s} if nonmsm_s is not None else {}),
                 # the batched arm: aggregate proofs/s + per-proof p50
                 # when batch_n requests ride one multi-column prove
                 **batch_rec,

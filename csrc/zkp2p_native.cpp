@@ -92,6 +92,9 @@ enum StatSlot {
   ST_MSM_FIXED_PREP_NS,       // fixed-tier digit recode/scatter, summed
   ST_PRECOMP_BUILD_NS,        // g1_precomp_build wall ns, summed
   ST_PRECOMP_TABLE_BYTES,     // mont256 table bytes built this process, summed
+  ST_MATVEC_NS,               // wall ns inside fr_matvec + fr_matvec_seg
+  ST_MATVEC_SEG_CALLS,        // segmented-plan matvec driver entries
+  ST_NTT_STAGE_NS,            // wall ns inside the vectorized NTT stage pipeline
   ST_COUNT
 };
 static std::atomic<long long> g_stats[ST_COUNT];
@@ -151,6 +154,14 @@ typedef uint64_t u64;
 // request bounds ITS region even when the pool has grown wider for some
 // other caller.  pool_run() must not be called from a pool worker (no
 // region in this library nests).
+// Set inside worker_loop for the thread's lifetime: parallel regions
+// must never be SUBMITTED from a pool worker (run() blocks the caller,
+// and a worker blocked on a nested region is a deadlock waiting for the
+// pool to shrink).  Helpers that can be reached both from Python threads
+// and from pool workers (the NTT stage splitter under the knob-off
+// 3-wide ladder) consult this and degrade to the inline serial path.
+static thread_local bool g_pool_worker = false;
+
 struct PoolJob {
   std::function<void(long)> fn;
   long n = 0;
@@ -254,6 +265,7 @@ class WorkPool {
   }
 
   void worker_loop() {
+    g_pool_worker = true;
     for (;;) {
       std::shared_ptr<PoolJob> job;
       {
@@ -295,6 +307,49 @@ class WorkPool {
 static WorkPool &work_pool() {
   static WorkPool pool;  // joined by the static destructor at exit
   return pool;
+}
+
+// Split [0, n) into contiguous ranges across the pool and run
+// fn(lo, hi) on each, blocking until all complete.  Falls back to one
+// inline fn(0, n) when the caller pinned a single thread, the range is
+// below `grain` (per-chunk minimum — tiny jobs cost more in pool
+// handoff than they save), or the caller IS a pool worker (regions
+// never nest — see g_pool_worker).  Used by the NTT stage splitter and
+// the segmented matvec, where every range is independent by
+// construction.
+static void pool_parallel_ranges(long n, long grain, int n_threads,
+                                 const std::function<void(long, long)> &fn) {
+  if (n <= 0) return;
+  long max_chunks = grain > 0 ? (n + grain - 1) / grain : n;
+  if (n_threads <= 1 || g_pool_worker || max_chunks <= 1) {
+    fn(0, n);
+    return;
+  }
+  // a few chunks per worker smooths uneven ranges without drowning the
+  // queue in micro-tasks
+  long nchunk = (long)n_threads * 4;
+  if (nchunk > max_chunks) nchunk = max_chunks;
+  long per = (n + nchunk - 1) / nchunk;
+  work_pool().ensure(n_threads);
+  work_pool().run(
+      nchunk,
+      [&](long ci) {
+        long lo = ci * per;
+        long hi = lo + per < n ? lo + per : n;
+        if (lo < hi) fn(lo, hi);
+      },
+      n_threads);
+}
+
+// Pool-parallel NTT stage splitting (ZKP2P_NTT_POOL, default ON; off
+// only on a leading '0', the ZKP2P_NATIVE_IFMA rule).  Gates both the
+// per-stage butterfly-block fan-out inside the vectorized NTT and the
+// fused-ladder pipeline in fr_h_ladder; off restores the 3-wide
+// whole-transform split — the honest A/B arm.  Fresh-read per call so
+// one process can diff both arms (tests monkeypatch the env).
+static bool ntt_pool_enabled() {
+  const char *e = getenv("ZKP2P_NTT_POOL");
+  return !(e && e[0] == '0');
 }
 
 // The env-resolved default worker count (ZKP2P_NATIVE_THREADS, else the
@@ -1354,20 +1409,122 @@ static IfmaTwiddles ifma_stage_twiddles(long m, const u64 root_std[4]) {
   return T;
 }
 
-// ALL NTT stages, vectorized: data arrives bit-reversed (mont256
-// u64x4); packs to 52-bit SoA, runs stages len 2/4/8 in-register
-// (permute + blended add/sub, constant twiddle vectors), then the
-// radix-4-fused len>=16 loop, and unpacks with full reduction mod r.
-static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
+// -------- SoA-plane pipeline helpers (shared by fr_ntt_ifma and the
+// fused H ladder).  Layout: 5 planes of m u64 (plane k at soa + k*m),
+// values in the lazy [0, 2p) 52-limb domain carrying the scalar tier's
+// mont256 form (see the domain comment above).  Every helper takes the
+// resolved worker count and degrades to the serial inline path through
+// pool_parallel_ranges (nt <= 1, tiny m, or a pool-worker caller).
+
+// Direct index bit-reversal (byte-table compose): the parallel permute
+// passes can't ride the classic incremental-j walk — each range needs
+// its own j, so compute rev(i) outright.  m <= 2^31 here (domains top
+// out at 2^26 for the flagship).
+struct Rev8Tab {
+  unsigned char t[256];
+  Rev8Tab() {
+    for (int i = 0; i < 256; ++i) {
+      int r = 0;
+      for (int b = 0; b < 8; ++b) r |= ((i >> b) & 1) << (7 - b);
+      t[i] = (unsigned char)r;
+    }
+  }
+};
+static const Rev8Tab REV8;
+static inline long bitrev_idx(long i, int bits) {
+  unsigned v = (unsigned)i;
+  unsigned r = ((unsigned)REV8.t[v & 0xff] << 24) |
+               ((unsigned)REV8.t[(v >> 8) & 0xff] << 16) |
+               ((unsigned)REV8.t[(v >> 16) & 0xff] << 8) |
+               (unsigned)REV8.t[(v >> 24) & 0xff];
+  return (long)(r >> (32 - bits));
+}
+
+// (m, 4) mont256 rows -> SoA planes, BIT-REVERSED on the way in:
+// soa[:, i] = pack(data[rev(i)]) — folding the permutation into the
+// pack pass (sequential writes, gathered 32-byte row reads) removes the
+// standalone swap pass the serial NTT entry used to run.
+static void fr_soa_pack_rev(const u64 *data, long m, u64 *soa, int nt) {
+  int bits = 0;
+  while ((1L << bits) < m) ++bits;
+  pool_parallel_ranges(m, 1L << 13, nt, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      u64 t[5];
+      limbs4_to_52(t, data + 4 * bitrev_idx(i, bits));
+      for (int k = 0; k < 5; ++k) soa[(size_t)k * m + i] = t[k];
+    }
+  });
+}
+
+// SoA planes -> (m, 4) mont256 rows with full canonical reduction.
+static void fr_soa_unpack(const u64 *soa, long m, u64 *data, int nt) {
+  pool_parallel_ranges(m, 1L << 13, nt, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      u64 t[5], o[4];
+      for (int k = 0; k < 5; ++k) t[k] = soa[(size_t)k * m + i];
+      limbs52_to_4(o, t);
+      while (geq(o, R_MOD)) sub_nored(o, o, R_MOD);
+      memcpy(data + 4 * i, o, 32);
+    }
+  });
+}
+
+// In-place bit-reversal of the SoA planes: the fused ladder re-enters
+// the forward stages without unpacking to mont256 between transforms.
+// Range-parallel: pair {i, rev(i)} is swapped only by the owner of the
+// SMALLER index, and no other task reads either slot during the pass,
+// so ranges never conflict.
+static void fr_soa_bitrev(u64 *soa, long m, int nt) {
+  int bits = 0;
+  while ((1L << bits) < m) ++bits;
+  pool_parallel_ranges(m, 1L << 14, nt, [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      long j = bitrev_idx(i, bits);
+      if (i < j) {
+        for (int k = 0; k < 5; ++k) {
+          u64 tmp = soa[(size_t)k * m + i];
+          soa[(size_t)k * m + i] = soa[(size_t)k * m + j];
+          soa[(size_t)k * m + j] = tmp;
+        }
+      }
+    }
+  });
+}
+
+// Pointwise vector multiply by a mont260 SoA constant table (the fused
+// ladder's coset-shift + deferred-1/m-scale pass): soa[i] *= tbl[i],
+// lazy domain preserved (mont260 constants keep the data's mont256
+// carrier — the standing rule of this pipeline).
+static void fr_soa_mul(u64 *soa, long m, const u64 *tbl, int nt) {
+  Ifma52Field &F = fr52_field();
+  __m512i p[5];
+  for (int k = 0; k < 5; ++k) p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+  const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+  pool_parallel_ranges(m / 8, 512, nt, [&](long blo, long bhi) {
+    for (long b = blo; b < bhi; ++b) {
+      const long i = b * 8;
+      __m512i x[5], t[5], o[5];
+      for (int k = 0; k < 5; ++k) {
+        x[k] = _mm512_loadu_si512(soa + (size_t)k * m + i);
+        t[k] = _mm512_loadu_si512(tbl + (size_t)k * m + i);
+      }
+      mont52_mul8(o, x, t, p, pinv);
+      for (int k = 0; k < 5; ++k) _mm512_storeu_si512(soa + (size_t)k * m + i, o[k]);
+    }
+  });
+}
+
+// ALL NTT stages over packed SoA planes (input bit-reversed): len 2/4/8
+// in-register (permute + blended add/sub, constant twiddle vectors),
+// then the radix-4-fused len>=16 loop.  Each pass's butterfly blocks
+// are independent, so every pass fans out across the WorkPool
+// (nt-gated) with the pool's run() barrier separating stages — the
+// split that lets ONE transform use every core, where the ladder's old
+// 3-wide whole-transform split stranded cores at 6 transforms / prove.
+static void fr_ntt_soa_stages(u64 *soa, long m, const u64 root_std[4], int nt) {
+  long long t_st = prof_now_ns();
   Ifma52Field &F = fr52_field();
   IfmaTwiddles T = ifma_stage_twiddles(m, root_std);
-  // SoA planes
-  u64 *soa = new u64[(size_t)m * 5];
-  for (long i = 0; i < m; ++i) {
-    u64 t[5];
-    limbs4_to_52(t, data + 4 * i);
-    for (int k = 0; k < 5; ++k) soa[(size_t)k * m + i] = t[k];
-  }
   __m512i p[5], p2[5], comp2p[5];
   for (int k = 0; k < 5; ++k) {
     p[k] = _mm512_set1_epi64((long long)F.p52[k]);
@@ -1420,7 +1577,9 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
         tw8[k] = _mm512_loadu_si512(t8[k]);
       }
     }
-    for (long i = 0; i < m; i += 8) {
+    pool_parallel_ranges(m / 8, 256, nt, [&](long blo, long bhi) {
+    for (long blk = blo; blk < bhi; ++blk) {
+      const long i = blk * 8;
       __m512i x[5];
       for (int k = 0; k < 5; ++k) x[k] = _mm512_loadu_si512(soa + (size_t)k * m + i);
       // stage len=2: pairs (0,1)(2,3)(4,5)(6,7), twiddle 1 (no mul)
@@ -1460,14 +1619,20 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
       }
       for (int k = 0; k < 5; ++k) _mm512_storeu_si512(soa + (size_t)k * m + i, x[k]);
     }
+    });
   }
   // One radix-2 vector stage (the generic building block, and the odd
-  // leading stage when the vector-stage count is odd).
+  // leading stage when the vector-stage count is odd).  The (block,
+  // j-group) butterfly space is flattened so the pool splits within a
+  // block too — the last stages have only a handful of blocks.
   auto radix2_stage = [&](long len, int stage) {
     const long half = len >> 1;
     const u64 *twp = T.buf.get() + T.offsets[stage];
-    for (long i0 = 0; i0 < m; i0 += len) {
-      for (long j = 0; j < half; j += 8) {
+    const long jblocks = half >> 3;
+    pool_parallel_ranges((m / len) * jblocks, 256, nt, [&](long glo, long ghi) {
+      for (long g = glo; g < ghi; ++g) {
+        const long i0 = (g / jblocks) * len;
+        const long j = (g % jblocks) * 8;
         __m512i u[5], v[5], tw[5], t[5], un[5], vn[5];
         for (int k = 0; k < 5; ++k) {
           u[k] = _mm512_loadu_si512(soa + (size_t)k * m + i0 + j);
@@ -1482,7 +1647,7 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
           _mm512_storeu_si512(soa + (size_t)k * m + i0 + j + half, vn[k]);
         }
       }
-    }
+    });
   };
   // Radix-4 fusion of stage pairs (len, 2len): same 4 Montgomery muls
   // per 4 elements as two radix-2 passes, but ONE load/store pass over
@@ -1503,8 +1668,11 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
     const long q = len >> 1;  // quarter
     const u64 *tw1p = T.buf.get() + T.offsets[stage];      // stage len: q entries
     const u64 *tw2p = T.buf.get() + T.offsets[stage + 1];  // stage 2len: 2q entries
-    for (long i0 = 0; i0 < m; i0 += L) {
-      for (long j = 0; j < q; j += 8) {
+    const long jblocks = q >> 3;
+    pool_parallel_ranges((m / L) * jblocks, 128, nt, [&](long glo, long ghi) {
+      for (long g = glo; g < ghi; ++g) {
+        const long i0 = (g / jblocks) * L;
+        const long j = (g % jblocks) * 8;
         __m512i a[5], b[5], c[5], d[5], w1[5], w2[5], w2q[5];
         for (int k = 0; k < 5; ++k) {
           a[k] = _mm512_loadu_si512(soa + (size_t)k * m + i0 + j);
@@ -1538,16 +1706,24 @@ static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
           _mm512_storeu_si512(soa + (size_t)k * m + i0 + j + 3 * q, o3[k]);
         }
       }
-    }
+    });
   }
-  // unpack + full reduction to [0, r)
-  for (long i = 0; i < m; ++i) {
-    u64 t[5], o[4];
-    for (int k = 0; k < 5; ++k) t[k] = soa[(size_t)k * m + i];
-    limbs52_to_4(o, t);
-    while (geq(o, R_MOD)) sub_nored(o, o, R_MOD);
-    memcpy(data + 4 * i, o, 32);
-  }
+  stat_add(ST_NTT_STAGE_NS, prof_now_ns() - t_st);
+}
+
+// Compat wrapper (fr_ntt_ifma's tier), NATURAL-order input: the input
+// bit-reversal folds into the pack pass (fr_soa_pack_rev), so the
+// standalone swap pass the serial entry used to run is gone.  The
+// stage-pool gate resolves HERE: splitting engages when ZKP2P_NTT_POOL
+// is on; a pool-worker caller (the knob-off 3-wide ladder runs each
+// transform ON a worker) degrades to serial inside pool_parallel_ranges
+// regardless, so regions never nest.
+static void fr_ntt_ifma_stages(u64 *data, long m, const u64 root_std[4]) {
+  int nt = ntt_pool_enabled() ? pool_default_threads() : 1;
+  u64 *soa = new u64[(size_t)m * 5];
+  fr_soa_pack_rev(data, m, soa, nt);
+  fr_ntt_soa_stages(soa, m, root_std, nt);
+  fr_soa_unpack(soa, m, data, nt);
   delete[] soa;
 }
 
@@ -2927,14 +3103,108 @@ static void g2_tree_sum(u64 (*xs)[8], u64 (*ys)[8], long n, G2Jac *out) {
 static bool ifma_enabled() { return false; }
 #endif  // __AVX512IFMA__
 
+#if ZKP2P_HAVE_IFMA
+// One 8-row step of the Fr batch-pass vector tier: pack 8 contiguous
+// (4 u64) rows to 52-limb lanes, multiply by one or two mont260
+// constant vectors (carrier bookkeeping lives in the CALLER's constant
+// choice), canonical-fold, unpack.  Shared by the batch mul/convert
+// passes below — each was a scalar fr_mul-per-row loop on the prove
+// path (m rows each: the pointwise Cz product, the witness to-mont, the
+// ladder's d from-mont), together ~3 full scalar Montgomery passes per
+// proof.
+static inline void fr_batch8_mul2(const u64 *a8, const __m512i *b52,
+                                  const __m512i c1[5], const __m512i c2[5],
+                                  const __m512i p[5], const __m512i pinv,
+                                  const __m512i comppv[5], u64 *out8) {
+  u64 tmp[5][8];
+  for (int l = 0; l < 8; ++l) {
+    u64 t[5];
+    limbs4_to_52(t, a8 + 4 * l);
+    for (int k = 0; k < 5; ++k) tmp[k][l] = t[k];
+  }
+  __m512i x[5], y[5];
+  for (int k = 0; k < 5; ++k) x[k] = _mm512_loadu_si512(tmp[k]);
+  if (b52 != nullptr) {
+    mont52_mul8(y, x, b52, p, pinv);
+  } else {
+    for (int k = 0; k < 5; ++k) y[k] = x[k];
+  }
+  mont52_mul8(x, y, c1, p, pinv);
+  if (c2 != nullptr) {
+    mont52_mul8(y, x, c2, p, pinv);
+  } else {
+    for (int k = 0; k < 5; ++k) y[k] = x[k];
+  }
+  cond_sub_c8(y, comppv);  // canonical (< r): callers' memcmp contracts
+  for (int k = 0; k < 5; ++k) _mm512_storeu_si512(tmp[k], y[k]);
+  for (int l = 0; l < 8; ++l) {
+    u64 t[5], o[4];
+    for (int k = 0; k < 5; ++k) t[k] = tmp[k][l];
+    limbs52_to_4(o, t);
+    memcpy(out8 + 4 * l, o, 32);
+  }
+}
+
+// The Fr batch-pass tier gate: vector core present AND the pool knob on
+// (ZKP2P_NTT_POOL gates the whole Fr vector-batch tier — stages, fused
+// ladder, and these passes — so the knob-off arm reproduces the full
+// pre-tier scalar path for A/Bs).
+static bool fr_batch_vector_on(long n) {
+  return ifma_enabled() && ntt_pool_enabled() && n >= 256;
+}
+#endif  // ZKP2P_HAVE_IFMA
+
 extern "C" {
 
-// Batch std <-> Montgomery over r.
+// Batch std <-> Montgomery over r.  IFMA tier (pool-split, 8-wide):
+// to-mont multiplies by 2^520 then the 2^256 carrier (in·2^260·2^-4 =
+// in·2^256); from-mont is ONE mul by the plain constant 16
+// (in·16·2^-260 = in·2^-256) — both exactly the scalar results,
+// canonically reduced.
 void fr_to_mont_batch(const u64 *in, u64 *out, long n) {
+#if ZKP2P_HAVE_IFMA
+  if (fr_batch_vector_on(n)) {
+    Ifma52Field &F = fr52_field();
+    __m512i p[5], comppv[5], c1[5], c2[5];
+    for (int k = 0; k < 5; ++k) {
+      p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+      comppv[k] = _mm512_set1_epi64((long long)F.compp[k]);
+      c1[k] = _mm512_set1_epi64((long long)F.r260sq[k]);
+      c2[k] = _mm512_set1_epi64((long long)F.c256[k]);
+    }
+    const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+    long nblk = n / 8;
+    pool_parallel_ranges(nblk, 1024, pool_default_threads(), [&](long lo, long hi) {
+      for (long b = lo; b < hi; ++b)
+        fr_batch8_mul2(in + 32 * b, nullptr, c1, c2, p, pinv, comppv, out + 32 * b);
+    });
+    for (long i = nblk * 8; i < n; ++i) fr_mul(out + 4 * i, in + 4 * i, R2R);
+    return;
+  }
+#endif
   for (long i = 0; i < n; ++i) fr_mul(out + 4 * i, in + 4 * i, R2R);
 }
 void fr_from_mont_batch(const u64 *in, u64 *out, long n) {
   static const u64 ONE_STD[4] = {1, 0, 0, 0};
+#if ZKP2P_HAVE_IFMA
+  if (fr_batch_vector_on(n)) {
+    Ifma52Field &F = fr52_field();
+    __m512i p[5], comppv[5], c1[5];
+    for (int k = 0; k < 5; ++k) {
+      p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+      comppv[k] = _mm512_set1_epi64((long long)F.compp[k]);
+      c1[k] = _mm512_set1_epi64(k == 0 ? 16LL : 0LL);  // 2^4: 260 -> 256
+    }
+    const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+    long nblk = n / 8;
+    pool_parallel_ranges(nblk, 1024, pool_default_threads(), [&](long lo, long hi) {
+      for (long b = lo; b < hi; ++b)
+        fr_batch8_mul2(in + 32 * b, nullptr, c1, nullptr, p, pinv, comppv, out + 32 * b);
+    });
+    for (long i = nblk * 8; i < n; ++i) fr_mul(out + 4 * i, in + 4 * i, ONE_STD);
+    return;
+  }
+#endif
   for (long i = 0; i < n; ++i) fr_mul(out + 4 * i, in + 4 * i, ONE_STD);
 }
 // In-place x mod r for n rows of 4 u64, any x < 2^256.  The witness
@@ -2952,8 +3222,38 @@ void fr_reduce_batch(u64 *inout, long n) {
   }
 }
 
-// Pointwise Montgomery product (c_ev = a_ev . b_ev).
+// Pointwise Montgomery product (c_ev = a_ev . b_ev).  IFMA tier: two
+// mul8 per 8 rows (a·b·2^-260 = ab·2^252, then the 2^264 carrier
+// restores mont256) vs 8 scalar fr_muls — exactly the scalar bytes.
 void fr_mul_batch(const u64 *a, const u64 *b, u64 *out, long n) {
+#if ZKP2P_HAVE_IFMA
+  if (fr_batch_vector_on(n)) {
+    Ifma52Field &F = fr52_field();
+    __m512i p[5], comppv[5], c1[5];
+    for (int k = 0; k < 5; ++k) {
+      p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+      comppv[k] = _mm512_set1_epi64((long long)F.compp[k]);
+      c1[k] = _mm512_set1_epi64((long long)F.c264[k]);
+    }
+    const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+    long nblk = n / 8;
+    pool_parallel_ranges(nblk, 1024, pool_default_threads(), [&](long lo, long hi) {
+      for (long b8 = lo; b8 < hi; ++b8) {
+        u64 tmp[5][8];
+        for (int l = 0; l < 8; ++l) {
+          u64 t[5];
+          limbs4_to_52(t, b + 32 * b8 + 4 * l);
+          for (int k = 0; k < 5; ++k) tmp[k][l] = t[k];
+        }
+        __m512i bv[5];
+        for (int k = 0; k < 5; ++k) bv[k] = _mm512_loadu_si512(tmp[k]);
+        fr_batch8_mul2(a + 32 * b8, bv, c1, nullptr, p, pinv, comppv, out + 32 * b8);
+      }
+    });
+    for (long i = nblk * 8; i < n; ++i) fr_mul(out + 4 * i, a + 4 * i, b + 4 * i);
+    return;
+  }
+#endif
   for (long i = 0; i < n; ++i) fr_mul(out + 4 * i, a + 4 * i, b + 4 * i);
 }
 // Self-test hook: c = a*b mod r, standard form in/out.
@@ -2969,6 +3269,7 @@ void fr_mul_std(const u64 *a, const u64 *b, u64 *c) {
 // Sparse QAP matvec: out[row[i]] += coeff[i] * w[wire[i]] (all Montgomery).
 void fr_matvec(const u64 *coeff, const unsigned *wire, const unsigned *row,
                long nnz, const u64 *w, long m, u64 *out) {
+  long long wall0 = prof_now_ns();
   memset(out, 0, (size_t)m * 32);
   u64 t[4];
   for (long i = 0; i < nnz; ++i) {
@@ -2976,6 +3277,208 @@ void fr_matvec(const u64 *coeff, const unsigned *wire, const unsigned *row,
     u64 *o = out + 4 * (long)row[i];
     fr_add(o, o, t);
   }
+  stat_add(ST_MATVEC_NS, prof_now_ns() - wall0);
+}
+
+// ---------------------------------------------------------------------------
+// Segmented matvec (the presorted-plan tier; docs/TUNING.md §non-MSM).
+//
+// fr_matvec above is a serial read-modify-write scatter: out[row[i]] +=
+// coeff[i]*w[wire[i]] in nnz order, which blocks both vectorization (at
+// ~2-4 nnz per QAP row the Montgomery mul IS the stage) and threading
+// (two workers may hit one output row).  The plan — built once per key
+// on the Python side (prover.matvec_plan) and persisted beside the
+// precomp tables — presorts the nnz by output row, turning the stage
+// into nseg independent "sum one contiguous run of products" segments:
+//
+//   * the PRODUCTS vectorize ACROSS segment boundaries (independent by
+//     definition): 8-wide 5x52 IFMA Montgomery muls over gathered wire
+//     values, canonically reduced in-register;
+//   * the ACCUMULATION is a scalar fr_add walk over canonical products
+//     — field addition is exact, so the output bytes match the scatter
+//     oracle for any order;
+//   * the SEGMENT space partitions across the WorkPool with zero
+//     scatter conflicts by construction (each worker owns a disjoint
+//     row range of the plan).
+//
+// Montgomery bookkeeping: w arrives mont256; the packed plan coeffs are
+// pre-multiplied by the 2^264 carrier (mont256 -> mont260), so one
+// mont260 vector mul yields the mont256 product directly — the same
+// constants-in-mont260 rule the NTT vector pipeline rides (see the
+// 52-bit core comment block).
+
+// Pack the plan's permuted mont256 coeffs into mont260 8-lane SoA
+// blocks (block b = plan entries 8b..8b+7; 5 planes x 8 u64 each, so
+// ceil(nnz/8)*40 u64 out).  Returns 1 on the IFMA tier, 0 when the
+// vector core is unavailable (caller then passes coeff52 = NULL and the
+// segmented driver runs its scalar product loop — still pool-parallel).
+int fr_matvec_pack52(const u64 *coeff_mont, long nnz, u64 *out52) {
+#if ZKP2P_HAVE_IFMA
+  if (!ifma_enabled() || nnz <= 0) return ifma_enabled() && nnz == 0 ? 1 : 0;
+  Ifma52Field &F = fr52_field();
+  long nblk = (nnz + 7) / 8;
+  // zero the pad lanes of the last block so they never carry garbage
+  // into a vector register (they are multiplied but never stored)
+  memset(out52 + (size_t)(nblk - 1) * 40, 0, 40 * sizeof(u64));
+  pool_parallel_ranges(nnz, 1L << 14, pool_default_threads(), [&](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) {
+      u64 t[5], t260[5];
+      limbs4_to_52(t, coeff_mont + 4 * i);
+      mont52_mul_scalar(t260, t, F.c264, F);  // carrier 256 -> 260
+      u64 *blk = out52 + (size_t)(i / 8) * 40;
+      for (int k = 0; k < 5; ++k) blk[k * 8 + (i & 7)] = t260[k];
+    }
+  });
+  return 1;
+#else
+  (void)coeff_mont;
+  (void)nnz;
+  (void)out52;
+  return 0;
+#endif
+}
+
+// Segmented-plan matvec: plan entries are presorted by output row;
+// segment s covers plan indices [seg_starts[s], seg_starts[s+1]) and
+// sums into out[seg_rows[s]].  coeff52 is the fr_matvec_pack52 output
+// (NULL = scalar product tier); coeff_mont the permuted mont256 coeffs
+// (always required: scalar tier, unaligned heads/tails).  Rows not
+// named by any segment stay zero, matching the oracle's memset.
+void fr_matvec_seg(const u64 *coeff52, const u64 *coeff_mont,
+                   const unsigned *wire, const long long *seg_starts,
+                   const unsigned *seg_rows, long nseg, const u64 *w,
+                   long m, int n_threads, u64 *out) {
+  long long wall0 = prof_now_ns();
+  stat_add(ST_MATVEC_SEG_CALLS, 1);
+  memset(out, 0, (size_t)m * 32);
+  if (nseg <= 0) {
+    stat_add(ST_MATVEC_NS, prof_now_ns() - wall0);
+    return;
+  }
+  const long nnz_total = seg_starts[nseg];
+  // chunk boundaries in SEGMENT space, balanced by nnz: worker c owns
+  // segments [bounds[c], bounds[c+1]) — disjoint output rows, so no
+  // two workers ever touch one out entry.
+  int nchunk = 1;
+  if (n_threads > 1 && !g_pool_worker && nseg > 1) {
+    long want = (long)n_threads * 4;
+    if (want > nseg) want = nseg;
+    long by_grain = nnz_total / 4096;  // per-chunk minimum work
+    if (want > by_grain) want = by_grain;
+    nchunk = want > 1 ? (int)want : 1;
+  }
+  std::vector<long> bounds((size_t)nchunk + 1);
+  bounds[0] = 0;
+  for (int ci = 1; ci < nchunk; ++ci) {
+    long target = nnz_total / nchunk * ci;
+    long lo = bounds[ci - 1], hi = nseg;
+    while (lo < hi) {  // first segment starting at/after the nnz target
+      long mid = (lo + hi) / 2;
+      if (seg_starts[mid] < target) lo = mid + 1; else hi = mid;
+    }
+    bounds[ci] = lo;
+  }
+  bounds[nchunk] = nseg;
+
+  auto run_chunk = [&](long ci) {
+    long sa = bounds[ci], sb = bounds[ci + 1];
+    if (sa >= sb) return;
+    const long i0 = seg_starts[sa], i1 = seg_starts[sb];
+    const long CHV = 2048;  // product-slice length (4 planes -> 64 KB, L2-warm)
+    static thread_local std::vector<u64> scratch;
+    if ((long)scratch.size() < 4 * CHV) scratch.assign(4 * CHV, 0);
+    u64 *pr0 = scratch.data(), *pr1 = pr0 + CHV, *pr2 = pr1 + CHV, *pr3 = pr2 + CHV;
+    long seg = sa;
+    u64 acc[4] = {0, 0, 0, 0};
+    for (long base = i0; base < i1; base += CHV) {
+      const long hi = base + CHV < i1 ? base + CHV : i1;
+      long i = base;
+      auto scalar_store = [&](long j) {
+        u64 t[4];
+        fr_mul(t, coeff_mont + 4 * j, w + 4 * (long)wire[j]);
+        pr0[j - base] = t[0];
+        pr1[j - base] = t[1];
+        pr2[j - base] = t[2];
+        pr3[j - base] = t[3];
+      };
+#if ZKP2P_HAVE_IFMA
+      if (coeff52 != nullptr && ifma_enabled()) {
+        Ifma52Field &F = fr52_field();
+        __m512i p[5], comppv[5];
+        for (int k = 0; k < 5; ++k) {
+          p[k] = _mm512_set1_epi64((long long)F.p52[k]);
+          comppv[k] = _mm512_set1_epi64((long long)F.compp[k]);
+        }
+        const __m512i pinv = _mm512_set1_epi64((long long)F.pinv52);
+        const __m512i m52v = _mm512_set1_epi64((long long)M52);
+        long a0 = (base + 7) & ~7L;  // coeff52 blocks are GLOBAL-8-aligned
+        if (a0 > hi) a0 = hi;
+        for (; i < a0; ++i) scalar_store(i);
+        for (; i + 8 <= hi; i += 8) {
+          // gather the 8 wire rows limb-by-limb, then 4x64 -> 5x52
+          // entirely in-register (the lane-wise limbs4_to_52)
+          const __m512i idx = _mm512_slli_epi64(
+              _mm512_cvtepu32_epi64(_mm256_loadu_si256((const __m256i *)(wire + i))), 2);
+          __m512i wv[4];
+          for (int k = 0; k < 4; ++k)
+            wv[k] = _mm512_i64gather_epi64(
+                _mm512_add_epi64(idx, _mm512_set1_epi64(k)), (const long long *)w, 8);
+          __m512i w52[5];
+          w52[0] = _mm512_and_si512(wv[0], m52v);
+          w52[1] = _mm512_and_si512(
+              _mm512_or_si512(_mm512_srli_epi64(wv[0], 52), _mm512_slli_epi64(wv[1], 12)), m52v);
+          w52[2] = _mm512_and_si512(
+              _mm512_or_si512(_mm512_srli_epi64(wv[1], 40), _mm512_slli_epi64(wv[2], 24)), m52v);
+          w52[3] = _mm512_and_si512(
+              _mm512_or_si512(_mm512_srli_epi64(wv[2], 28), _mm512_slli_epi64(wv[3], 36)), m52v);
+          w52[4] = _mm512_srli_epi64(wv[3], 16);
+          __m512i c52[5];
+          const u64 *blk = coeff52 + (size_t)(i / 8) * 40;
+          for (int k = 0; k < 5; ++k) c52[k] = _mm512_loadu_si512(blk + k * 8);
+          __m512i prv[5];
+          mont52_mul8(prv, w52, c52, p, pinv);  // mont256 product, [0, 2p)
+          cond_sub_c8(prv, comppv);             // canonical: < r
+          // lane-wise limbs52_to_4, stored to the product planes
+          _mm512_storeu_si512(pr0 + (i - base),
+                              _mm512_or_si512(prv[0], _mm512_slli_epi64(prv[1], 52)));
+          _mm512_storeu_si512(pr1 + (i - base),
+                              _mm512_or_si512(_mm512_srli_epi64(prv[1], 12),
+                                              _mm512_slli_epi64(prv[2], 40)));
+          _mm512_storeu_si512(pr2 + (i - base),
+                              _mm512_or_si512(_mm512_srli_epi64(prv[2], 24),
+                                              _mm512_slli_epi64(prv[3], 28)));
+          _mm512_storeu_si512(pr3 + (i - base),
+                              _mm512_or_si512(_mm512_srli_epi64(prv[3], 36),
+                                              _mm512_slli_epi64(prv[4], 16)));
+        }
+      }
+#endif
+      for (; i < hi; ++i) scalar_store(i);
+      // segmented accumulation over this slice; acc carries across
+      // slice boundaries for segments longer than CHV
+      i = base;
+      while (i < hi) {
+        const long send = seg_starts[seg + 1];
+        const long stop = send < hi ? send : hi;
+        for (; i < stop; ++i) {
+          u64 t[4] = {pr0[i - base], pr1[i - base], pr2[i - base], pr3[i - base]};
+          fr_add(acc, acc, t);
+        }
+        if (i == send) {
+          memcpy(out + 4 * (long)seg_rows[seg], acc, 32);
+          memset(acc, 0, 32);
+          ++seg;
+        }
+      }
+    }
+  };
+  if (nchunk > 1) {
+    work_pool().ensure(n_threads);
+    work_pool().run(nchunk, run_chunk, n_threads);
+  } else {
+    run_chunk(0);
+  }
+  stat_add(ST_MATVEC_NS, prof_now_ns() - wall0);
 }
 
 // In-place radix-2 NTT over Fr, natural order in/out, data Montgomery.
@@ -3077,6 +3580,11 @@ int zkp2p_ifma_available(void) { return ifma_enabled() ? 1 : 0; }
 // unset / not leading-'0').  Fresh-read, so tools can echo the live arm.
 int zkp2p_batch_affine_enabled(void) { return batch_affine_enabled() ? 1 : 0; }
 
+// 1 when the pool-parallel NTT stage splitting + fused ladder pipeline
+// are active (ZKP2P_NTT_POOL unset / not leading-'0').  Fresh-read for
+// the same reason.
+int zkp2p_ntt_pool_enabled(void) { return ntt_pool_enabled() ? 1 : 0; }
+
 // Differential-test hook for the 8-wide kernel: c[i] = a[i]*b[i] mod r,
 // standard form in/out, driven through pack -> mont260 vector multiply
 // -> unpack (the exact pipeline the NTT stages use).  Falls back to the
@@ -3131,10 +3639,10 @@ void fr52_mul_std_batch(const u64 *a, const u64 *b, u64 *c, long n) {
 void fr_ntt_ifma(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
 #if ZKP2P_HAVE_IFMA
   if (ifma_enabled() && m >= 64) {
-    fr_bitrev(data, m);
     // ALL stages vectorized: len 2/4/8 via in-register permutes (the
     // scalar small-stage tier was ~1/3 of the NTT after radix-4), then
-    // the radix-4-fused len>=16 loop — one pack/unpack for everything
+    // the radix-4-fused len>=16 loop — one pack/unpack for everything,
+    // with the input bit-reversal folded into the pack
     fr_ntt_ifma_stages(data, m, root_std);
     fr_apply_scale(data, m, scale_std);
     return;
@@ -3142,6 +3650,76 @@ void fr_ntt_ifma(u64 *data, long m, const u64 *root_std, const u64 *scale_std) {
 #endif
   fr_ntt(data, m, root_std, scale_std);
 }
+
+#if ZKP2P_HAVE_IFMA
+// gpow table for the FUSED ladder, in mont260 SoA planes, cached per
+// (m, g): gpow[j] = (1/m)·g^j — the iNTT's deferred 1/m scale folded
+// into the coset shift, applied as ONE vectorized SoA pass between the
+// inverse and forward stage pipelines (fr_soa_mul).  Key-shape
+// invariant, so it builds once per (domain, coset) like the twiddle
+// tables and drops the old per-call sequential m-mul chain from the
+// prove path (shared_ptr for in-flight safety; small cap — each entry
+// is 40·m bytes).
+static std::shared_ptr<u64[]> ladder_gpow260(long m, const u64 *g_std,
+                                             const u64 *minv_std) {
+  static std::mutex mu;
+  static std::map<std::array<u64, 5>, std::shared_ptr<u64[]>> cache;
+  std::lock_guard<std::mutex> lk(mu);
+  std::array<u64, 5> key = {(u64)m, g_std[0], g_std[1], g_std[2], g_std[3]};
+  auto it = cache.find(key);
+  if (it != cache.end()) return it->second;
+  Ifma52Field &F = fr52_field();
+  std::shared_ptr<u64[]> buf(new u64[(size_t)m * 5]);
+  u64 g52[5], g260[5], cur[5], t52[5];
+  limbs4_to_52(g52, g_std);
+  mont52_mul_scalar(g260, g52, F.r260sq, F);  // std -> mont260
+  limbs4_to_52(t52, minv_std);
+  mont52_mul_scalar(cur, t52, F.r260sq, F);   // (1/m) in mont260
+  u64 *planes = buf.get();
+  for (long j = 0; j < m; ++j) {
+    for (int k = 0; k < 5; ++k) planes[(size_t)k * m + j] = cur[k];
+    mont52_mul_scalar(cur, cur, g260, F);
+  }
+  while (cache.size() >= 4) cache.erase(cache.begin());
+  cache[key] = buf;
+  return buf;
+}
+
+// Fused-pipeline ladder (the ZKP2P_NTT_POOL arm): each transform stays
+// in 52-limb SoA form across iNTT -> coset-mul -> forward NTT, so the
+// unpack-to-mont256 and repack passes between the two transforms (plus
+// the standalone scalar coset-mul pass) disappear — two full memory
+// passes per transform — and every stage pass fans out across the
+// WorkPool instead of the old 3-wide whole-transform split.  Byte
+// parity with the unfused arm is exact: identical field values at every
+// step, one canonical unpack at the end (tests/test_nonmsm.py pins it).
+static void fr_h_ladder_fused(u64 *a, u64 *b, u64 *c, long m,
+                              const u64 *w_std, const u64 *winv_std,
+                              const u64 *g_std, const u64 *minv_std,
+                              u64 *out_d, int nt) {
+  std::shared_ptr<u64[]> gpow = ladder_gpow260(m, g_std, minv_std);
+  u64 *soa = new u64[(size_t)m * 5];
+  u64 *vecs[3] = {a, b, c};
+  for (int v3 = 0; v3 < 3; ++v3) {
+    u64 *v = vecs[v3];
+    fr_soa_pack_rev(v, m, soa, nt);           // bitrev folded into the pack
+    fr_ntt_soa_stages(soa, m, winv_std, nt);  // unscaled iNTT: evals -> m·coeffs
+    fr_soa_mul(soa, m, gpow.get(), nt);       // fused (1/m)·g^j coset pass
+    fr_soa_bitrev(soa, m, nt);                // natural -> bit-reversed for forward
+    fr_ntt_soa_stages(soa, m, w_std, nt);     // coefficients -> coset evals
+    fr_soa_unpack(soa, m, v, nt);             // canonical mont256 out
+  }
+  delete[] soa;
+  // d = A·B - C on the coset, range-parallel (independent rows)
+  pool_parallel_ranges(m, 1L << 13, nt, [&](long lo, long hi) {
+    for (long j = lo; j < hi; ++j) {
+      u64 t[4];
+      fr_mul(t, a + 4 * j, b + 4 * j);
+      fr_sub(out_d + 4 * j, t, c + 4 * j);
+    }
+  });
+}
+#endif  // ZKP2P_HAVE_IFMA
 
 // The H-polynomial coset ladder (prove_tpu's h_evals, native):
 // a/b/c are the domain evaluations (Montgomery, length m, clobbered);
@@ -3161,6 +3739,15 @@ void fr_h_ladder(u64 *a, u64 *b, u64 *c, long m, const u64 *w_std,
   fr_mul(mm, m_std, R2R);
   fr_inv_mont(mim, mm);
   fr_mul(minv_std, mim, ONE_STD);
+#if ZKP2P_HAVE_IFMA
+  // the fused, stage-parallel pipeline (byte-identical; gated so the
+  // knob-off arm below stays the honest A/B oracle)
+  if (ifma_enabled() && ntt_pool_enabled() && m >= 64) {
+    fr_h_ladder_fused(a, b, c, m, w_std, winv_std, g_std, minv_std, out_d,
+                      pool_default_threads());
+    return;
+  }
+#endif
   u64 gm[4];
   fr_mul(gm, g_std, R2R);
   // One shared table for all three ladders, with the iNTT's 1/m scale

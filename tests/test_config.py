@@ -45,6 +45,8 @@ def test_env_overrides_every_knob():
         "ZKP2P_MSM_PRECOMP_CACHE": "/tmp/precomp_cache",
         "ZKP2P_MSM_PRECOMP_PERSIST_MIN": "1024",
         "ZKP2P_MSM_PRECOMP_FAMILIES": "a,h",
+        "ZKP2P_MATVEC_SEG": "0",
+        "ZKP2P_NTT_POOL": "0",
         "ZKP2P_BATCH_CHUNK": "8",
         "ZKP2P_FIELD_CONV": "limb_major",
         "ZKP2P_FIELD_MUL": "pallas",
@@ -77,6 +79,7 @@ def test_env_overrides_every_knob():
     assert cfg.msm_precomp is False and cfg.precomp_depth == 4
     assert cfg.precomp_max_mb == 512 and cfg.precomp_cache == "/tmp/precomp_cache"
     assert cfg.precomp_persist_min == 1024 and cfg.precomp_families == "a,h"
+    assert cfg.matvec_seg is False and cfg.ntt_pool is False
     assert cfg.batch_chunk == "8"
     assert cfg.field_conv == "limb_major" and cfg.field_mul == "pallas" and cfg.curve_kernel == "xla"
     assert cfg.native_ifma is False and cfg.native_threads == 7 and cfg.no_cache is True

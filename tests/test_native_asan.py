@@ -190,6 +190,71 @@ for ba in ("1", "0"):
         out.ctypes.data_as(u64p))
     check(f"fixed no52 ba={ba}", out)
 
+# non-MSM kernels (segmented matvec + pooled/fused NTT ladder): the
+# per-chunk product-slice scratch, the mont260 plan pack, the SoA stage
+# planes, and the gpow260 table are the new-allocation surface.  Parity
+# vs fr_matvec / the knob-off ladder arm inside the instrumented lib.
+import hashlib
+u32p = ctypes.POINTER(ctypes.c_uint32)
+i64p = ctypes.POINTER(ctypes.c_longlong)
+lib.fr_to_mont_batch.argtypes = [u64p, u64p, ctypes.c_long]
+lib.fr_matvec.argtypes = [u64p, u32p, u32p, ctypes.c_long, u64p, ctypes.c_long, u64p]
+lib.fr_matvec_pack52.argtypes = [u64p, ctypes.c_long, u64p]
+lib.fr_matvec_pack52.restype = ctypes.c_int
+lib.fr_matvec_seg.argtypes = [u64p, u64p, u32p, i64p, u32p, ctypes.c_long,
+                              u64p, ctypes.c_long, ctypes.c_int, u64p]
+lib.fr_h_ladder.argtypes = [u64p, u64p, u64p, ctypes.c_long, u64p, u64p, u64p]
+m_mv, nw, nnz = 128, 90, 700
+w_std = _scalars_to_u64([rng.randrange(R) for _ in range(nw)]).copy()
+w_m = np.zeros_like(w_std)
+lib.fr_to_mont_batch(w_std.ctypes.data_as(u64p), w_m.ctypes.data_as(u64p), nw)
+cf_std = _scalars_to_u64([rng.randrange(R) for _ in range(nnz)]).copy()
+cf = np.zeros_like(cf_std)
+lib.fr_to_mont_batch(cf_std.ctypes.data_as(u64p), cf.ctypes.data_as(u64p), nnz)
+wires = np.array([rng.randrange(nw) for _ in range(nnz)], dtype=np.uint32)
+rows = np.array([rng.randrange(m_mv) for _ in range(nnz)], dtype=np.uint32)
+rows[:150] = 9  # hot segment crossing the product-slice boundary shape
+mv_want = np.zeros((m_mv, 4), dtype=np.uint64)
+lib.fr_matvec(cf.ctypes.data_as(u64p), wires.ctypes.data_as(u32p),
+              rows.ctypes.data_as(u32p), nnz, w_m.ctypes.data_as(u64p), m_mv,
+              mv_want.ctypes.data_as(u64p))
+perm = np.argsort(rows, kind="stable")
+rsort = rows[perm]
+cp = np.ascontiguousarray(cf[perm]); wp = np.ascontiguousarray(wires[perm])
+bnd = np.flatnonzero(np.diff(rsort)) + 1
+seg_starts = np.ascontiguousarray(np.concatenate([[0], bnd, [nnz]]).astype(np.int64))
+seg_rows = np.ascontiguousarray(rsort[seg_starts[:-1]].astype(np.uint32))
+c52 = np.zeros(((nnz + 7) // 8) * 40, dtype=np.uint64)
+mv52 = lib.fr_matvec_pack52(cp.ctypes.data_as(u64p), nnz, c52.ctypes.data_as(u64p))
+for threads in (1, 2):
+    for p52 in ([c52.ctypes.data_as(u64p), None] if mv52 else [None]):
+        got = np.zeros((m_mv, 4), dtype=np.uint64)
+        lib.fr_matvec_seg(p52, cp.ctypes.data_as(u64p), wp.ctypes.data_as(u32p),
+                          seg_starts.ctypes.data_as(i64p), seg_rows.ctypes.data_as(u32p),
+                          len(seg_rows), w_m.ctypes.data_as(u64p), m_mv, threads,
+                          got.ctypes.data_as(u64p))
+        assert np.array_equal(got, mv_want), ("matvec_seg", threads, p52 is not None)
+print("ok matvec_seg", flush=True)
+
+from zkp2p_tpu.field.bn254 import fr_domain_root
+from zkp2p_tpu.snark.groth16 import coset_gen
+log_lm = 7; M = 1 << log_lm
+wroot = _scalars_to_u64([fr_domain_root(log_lm)]).copy()
+gcosv = _scalars_to_u64([coset_gen(log_lm)]).copy()
+abc0 = _scalars_to_u64([rng.randrange(R) for _ in range(3 * M)]).reshape(3, M, 4).copy()
+lad = {}
+for knob in ("1", "0"):
+    os.environ["ZKP2P_NTT_POOL"] = knob
+    os.environ["ZKP2P_NATIVE_THREADS"] = "2"
+    abc = [np.ascontiguousarray(abc0[i].copy()) for i in range(3)]
+    d = np.zeros((M, 4), dtype=np.uint64)
+    lib.fr_h_ladder(abc[0].ctypes.data_as(u64p), abc[1].ctypes.data_as(u64p),
+                    abc[2].ctypes.data_as(u64p), M, wroot.ctypes.data_as(u64p),
+                    gcosv.ctypes.data_as(u64p), d.ctypes.data_as(u64p))
+    lad[knob] = d
+assert np.array_equal(lad["1"], lad["0"]), "pooled ladder != unfused ladder"
+print("ok ladder_pool", flush=True)
+
 lib.zkp2p_pool_shutdown()
 print("ASAN-PARITY-GREEN", flush=True)
 """

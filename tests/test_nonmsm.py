@@ -1,0 +1,454 @@
+"""The non-MSM hot path: segmented IFMA matvec, pool-parallel NTT
+stages, fused coset ladder, shared prover executor (docs/TUNING.md
+§non-MSM).
+
+Parity oracles: the scatter `fr_matvec` and the scalar `fr_ntt` (both
+differentially tested against pure-python in test_native.py), and the
+ZKP2P_NTT_POOL=0 / ZKP2P_MATVEC_SEG=0 arms of the full prove.  Every
+new kernel must be byte-identical to its oracle across {threads 1,2} x
+{knob on/off} — field addition is exact and the kernels reduce
+canonically, so any mismatch is a real defect, never rounding.
+
+Also tier-1-resident here (`make nonmsm-smoke`): the segment-plan cache
+round-trip with tamper rejection, and the shared-executor regression
+(thread-pool constructions per batch must be ZERO — the old code built
+2-6 ThreadPoolExecutors per proof).
+"""
+
+import ctypes
+import os
+import random
+
+import numpy as np
+import pytest
+
+from zkp2p_tpu.field.bn254 import R, fr_domain_root
+from zkp2p_tpu.native import lib as native
+from zkp2p_tpu.native.lib import _scalars_to_u64
+from zkp2p_tpu.snark.groth16 import coset_gen
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None, reason="native toolchain unavailable")
+
+rng = random.Random(41)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+
+
+def _p(a: np.ndarray):
+    return a.ctypes.data_as(_u64p)
+
+
+def _p32(a: np.ndarray):
+    return a.ctypes.data_as(_u32p)
+
+
+def _lib():
+    from zkp2p_tpu.prover.native_prove import _lib as pl
+
+    lib = pl()
+    lib.fr_ntt_ifma.argtypes = [_u64p, ctypes.c_long, _u64p, _u64p]
+    return lib
+
+
+def _rand_fr(n: int, seed: int = 0) -> np.ndarray:
+    """(n, 4) u64 of values < r (top limb masked under r's top limb) —
+    numpy-speed random field elements for the big-domain tests."""
+    g = np.random.default_rng(seed)
+    a = g.integers(0, 1 << 63, size=(n, 4), dtype=np.uint64) * 2 + g.integers(
+        0, 2, size=(n, 4), dtype=np.uint64
+    )
+    a[:, 3] &= np.uint64((1 << 60) - 1)  # < 2^252 < r
+    return np.ascontiguousarray(a)
+
+
+def _mont(lib, std: np.ndarray) -> np.ndarray:
+    out = np.zeros_like(std)
+    lib.fr_to_mont_batch(_p(std), _p(out), std.shape[0])
+    return out
+
+
+# ----------------------------------------------------------- matvec
+
+
+def _synthetic_matrix(m: int, n_wires: int, nnz: int):
+    """Random QAP-ish matrix with the adversarial shapes the plan must
+    survive: empty rows, a hot row (segment longer than the product
+    slice), duplicate (row, wire) pairs."""
+    lib = _lib()
+    coeff = _mont(lib, _rand_fr(nnz, seed=7))
+    wire = np.array([rng.randrange(n_wires) for _ in range(nnz)], dtype=np.uint32)
+    row = np.array([rng.randrange(m) for _ in range(nnz)], dtype=np.uint32)
+    row[: nnz // 4] = 3  # hot row: one segment spanning slice boundaries
+    if nnz > 8:
+        wire[5] = wire[6]
+        row[5] = row[6]  # duplicate pair
+    return coeff, wire, row
+
+
+def _plan_from(coeff, wire, row):
+    from zkp2p_tpu.prover import matvec_plan
+
+    lib = _lib()
+    cp, wp, perm, seg_starts, seg_rows = matvec_plan._build(coeff, wire, row)
+    c52 = matvec_plan._pack52(lib, cp)
+    return cp, wp, seg_starts, seg_rows, c52
+
+
+def _run_seg(lib, plan, w_mont, m, threads) -> np.ndarray:
+    cp, wp, seg_starts, seg_rows, c52 = plan
+    out = np.zeros((m, 4), dtype=np.uint64)
+    lib.fr_matvec_seg(
+        _p(c52) if c52 is not None else None,
+        _p(cp),
+        _p32(wp),
+        seg_starts.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        _p32(seg_rows),
+        seg_rows.shape[0],
+        _p(w_mont),
+        m,
+        threads,
+        _p(out),
+    )
+    return out
+
+
+@pytest.mark.parametrize("threads", [1, 2])
+def test_matvec_seg_parity(threads):
+    """fr_matvec_seg == the scatter fr_matvec oracle, byte for byte,
+    on both the IFMA-packed and the scalar (coeff52=NULL) tiers."""
+    lib = _lib()
+    m, n_wires, nnz = 512, 300, 6000
+    coeff, wire, row = _synthetic_matrix(m, n_wires, nnz)
+    w_mont = _mont(lib, _rand_fr(n_wires, seed=11))
+    want = np.zeros((m, 4), dtype=np.uint64)
+    lib.fr_matvec(_p(coeff), _p32(wire), _p32(row), nnz, _p(w_mont), m, _p(want))
+    plan = _plan_from(coeff, wire, row)
+    got = _run_seg(lib, plan, w_mont, m, threads)
+    assert np.array_equal(got, want)
+    if plan[4] is not None:  # scalar product tier under the same plan
+        scalar_plan = plan[:4] + (None,)
+        got = _run_seg(lib, scalar_plan, w_mont, m, threads)
+        assert np.array_equal(got, want)
+
+
+def test_matvec_seg_empty_and_tiny():
+    """nseg=0 (empty matrix) zeroes the output; a single 1-nnz segment
+    lands in the right row."""
+    lib = _lib()
+    m = 64
+    w_mont = _mont(lib, _rand_fr(8, seed=3))
+    out = np.ones((m, 4), dtype=np.uint64)
+    empty = np.zeros(1, dtype=np.int64)
+    lib.fr_matvec_seg(
+        None, None, None, empty.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        None, 0, _p(w_mont), m, 2, _p(out),
+    )
+    assert not out.any()
+    coeff = _mont(lib, _rand_fr(1, seed=5))
+    wire = np.array([3], dtype=np.uint32)
+    row = np.array([17], dtype=np.uint32)
+    want = np.zeros((m, 4), dtype=np.uint64)
+    lib.fr_matvec(_p(coeff), _p32(wire), _p32(row), 1, _p(w_mont), m, _p(want))
+    got = _run_seg(lib, _plan_from(coeff, wire, row), w_mont, m, 1)
+    assert np.array_equal(got, want)
+
+
+# ----------------------------------------------------------- NTT / ladder
+
+
+@pytest.mark.parametrize("shape", ["random", "zero", "delta"])
+def test_ntt_pool_parity(monkeypatch, shape):
+    """fr_ntt_ifma with the stage pool armed == the scalar fr_ntt
+    oracle on random and adversarial inputs, forward and inverse."""
+    lib = _lib()
+    m = 1024
+    if shape == "random":
+        data = _mont(lib, _rand_fr(m, seed=13))
+    elif shape == "zero":
+        data = np.zeros((m, 4), dtype=np.uint64)
+    else:
+        data = np.zeros((m, 4), dtype=np.uint64)
+        data[m // 3] = _mont(lib, _rand_fr(1, seed=17))[0]
+    log_m = m.bit_length() - 1
+    root = np.ascontiguousarray(_scalars_to_u64([fr_domain_root(log_m)]))
+    winv = np.ascontiguousarray(
+        _scalars_to_u64([pow(fr_domain_root(log_m), R - 2, R)])
+    )
+    one = np.ascontiguousarray(_scalars_to_u64([1]))
+    minv = np.ascontiguousarray(_scalars_to_u64([pow(m, R - 2, R)]))
+    for root_std, scale in ((root, one), (winv, minv)):
+        want = np.ascontiguousarray(data.copy())
+        lib.fr_ntt(_p(want), m, _p(root_std), _p(scale))
+        monkeypatch.setenv("ZKP2P_NTT_POOL", "1")
+        monkeypatch.setenv("ZKP2P_NATIVE_THREADS", "2")
+        got = np.ascontiguousarray(data.copy())
+        lib.fr_ntt_ifma(_p(got), m, _p(root_std), _p(scale))
+        assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("threads", ["1", "2"])
+def test_ladder_parity_bench_shape(monkeypatch, threads):
+    """fr_h_ladder: the fused, stage-pooled arm == the 3-wide unfused
+    arm byte-for-byte at the BENCH shape's log_m (2^19 domain) — the
+    exact transform the 499k venmo prove runs."""
+    lib = _lib()
+    log_m = 19
+    m = 1 << log_m
+    base = _mont(lib, _rand_fr(3 * m, seed=23)).reshape(3, m, 4)
+    wroot = np.ascontiguousarray(_scalars_to_u64([fr_domain_root(log_m)]))
+    gcos = np.ascontiguousarray(_scalars_to_u64([coset_gen(log_m)]))
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", threads)
+    res = {}
+    for knob in ("1", "0"):
+        monkeypatch.setenv("ZKP2P_NTT_POOL", knob)
+        abc = [np.ascontiguousarray(base[i].copy()) for i in range(3)]
+        d = np.zeros((m, 4), dtype=np.uint64)
+        lib.fr_h_ladder(
+            _p(abc[0]), _p(abc[1]), _p(abc[2]), m, _p(wroot), _p(gcos), _p(d)
+        )
+        res[knob] = d
+    assert np.array_equal(res["1"], res["0"])
+
+
+def test_fr_batch_passes_parity(monkeypatch):
+    """The Fr batch passes (pointwise mul, to/from Montgomery) on the
+    ZKP2P_NTT_POOL vector tier == the scalar arm, byte for byte —
+    including the non-multiple-of-8 tail."""
+    lib = _lib()
+    n = 1031  # > the 256-row vector threshold, ragged tail
+    a_std = _rand_fr(n, seed=31)
+    b_std = _rand_fr(n, seed=37)
+    res = {}
+    for knob in ("0", "1"):
+        monkeypatch.setenv("ZKP2P_NTT_POOL", knob)
+        monkeypatch.setenv("ZKP2P_NATIVE_THREADS", "2")
+        am = _mont(lib, a_std)
+        bm = _mont(lib, b_std)
+        prod = np.zeros_like(am)
+        lib.fr_mul_batch(_p(am), _p(bm), _p(prod), n)
+        back = np.zeros_like(prod)
+        lib.fr_from_mont_batch(_p(prod), _p(back), n)
+        res[knob] = (am, prod, back)
+    for i in range(3):
+        assert np.array_equal(res["0"][i], res["1"][i]), f"batch pass {i} diverged"
+
+
+def test_witness_fast_path_parity():
+    """_witness_std_u64 fast=True == fast=False on mixed small/large/
+    exotic witnesses (the bulk-assign chunks + serialize fallback)."""
+    from zkp2p_tpu.prover.native_prove import _lib as pl, _witness_std_u64
+
+    lib = pl()
+    small = [rng.randrange(1 << 50) for _ in range(9000)]
+    mixed = list(small)
+    for i in range(0, 9000, 517):
+        mixed[i] = rng.randrange(R)  # full-width rows scattered through
+    over = list(small)
+    over[123] = R + 5  # >= r: needs the reduction
+    for w in (small, mixed, over, [], [7]):
+        slow = _witness_std_u64(lib, w, fast=False)
+        fast = _witness_std_u64(lib, w, fast=True)
+        assert np.array_equal(slow, fast)
+    neg = list(small)
+    neg[7] = -3  # exotic: exact python fallback on both arms
+    assert np.array_equal(
+        _witness_std_u64(lib, neg, fast=False), _witness_std_u64(lib, neg, fast=True)
+    )
+
+
+# ----------------------------------------------------------- full prove
+
+
+def _toy_circuit(n_extra: int = 70):
+    """x*y chain with enough constraints that m >= 64 — the fused
+    ladder path must actually ENGAGE (it gates on m >= 64)."""
+    from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+    cs = ConstraintSystem("nonmsm-toy")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+    cs.compute(z, lambda a, b: a * b % R, [x, y])
+    prev = z
+    for i in range(n_extra):
+        nxt = cs.new_wire(f"t{i}")
+        cs.enforce(LC.of(prev), LC.of(x), LC.of(nxt), f"chain{i}")
+        cs.compute(nxt, lambda a, b: a * b % R, [prev, x])
+        prev = nxt
+    cs.enforce(LC.of(prev), LC.of(prev), LC.of(out), "sq")
+    return cs, (x, y, prev)
+
+
+@pytest.fixture
+def toy_world(monkeypatch, tmp_path):
+    from zkp2p_tpu.prover import device_pk, matvec_plan, precomp
+    from zkp2p_tpu.snark.groth16 import setup
+
+    monkeypatch.setenv("ZKP2P_MSM_PRECOMP_CACHE", str(tmp_path / "cache"))
+    matvec_plan.reset()
+    precomp.reset()
+    cs, (x, y, last) = _toy_circuit()
+    pk, vk = setup(cs)
+    dpk = device_pk(pk, cs)
+    yield cs, (x, y), dpk, vk
+    matvec_plan.reset()
+    precomp.reset()
+
+
+def _toy_public() -> int:
+    """The chain's out value for x=3, y=5: out = (15·3^70)^2."""
+    val = 15
+    for _ in range(70):
+        val = val * 3 % R
+    return val * val % R
+
+
+def test_prove_parity_seg_and_ntt_arms(monkeypatch, toy_world):
+    """prove_native / prove_native_batch: {matvec_seg on/off} x
+    {ntt_pool on/off} x {threads 1,2} all emit IDENTICAL proof bytes —
+    and the armed proof verifies."""
+    from zkp2p_tpu.prover.native_prove import prove_native, prove_native_batch
+    from zkp2p_tpu.snark.groth16 import verify
+
+    cs, (x, y), dpk, vk = toy_world
+    publics = [_toy_public()]
+    w = cs.witness(publics, {x: 3, y: 5})
+    monkeypatch.setenv("ZKP2P_MSM_PRECOMP", "0")  # isolate the non-MSM arms
+    monkeypatch.setenv("ZKP2P_MATVEC_SEG", "0")
+    monkeypatch.setenv("ZKP2P_NTT_POOL", "0")
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", "1")
+    want = prove_native(dpk, w, r=11, s=13)
+    assert verify(vk, want, publics)
+    for seg in ("0", "1"):
+        for pool in ("0", "1"):
+            for threads in ("1", "2"):
+                monkeypatch.setenv("ZKP2P_MATVEC_SEG", seg)
+                monkeypatch.setenv("ZKP2P_NTT_POOL", pool)
+                monkeypatch.setenv("ZKP2P_NATIVE_THREADS", threads)
+                got = prove_native(dpk, w, r=11, s=13)
+                assert got == want, f"seg={seg} pool={pool} threads={threads}"
+    # batch path (multi-column MSMs + pipelined ladder) — same bytes
+    monkeypatch.setenv("ZKP2P_MATVEC_SEG", "1")
+    monkeypatch.setenv("ZKP2P_NTT_POOL", "1")
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", "2")
+    got = prove_native_batch(dpk, [w, w, w], rs=[11, 2, 3], ss=[13, 5, 7])
+    assert got[0] == want
+    seq = [prove_native(dpk, w, r=r_, s=s_) for r_, s_ in ((11, 13), (2, 5), (3, 7))]
+    assert got == seq
+
+
+# ----------------------------------------------------------- plan cache
+
+
+def test_plan_cache_roundtrip_and_tamper(monkeypatch, toy_world, tmp_path):
+    """build -> persist -> reload (source=cache) -> byte-equal plans;
+    a tampered file (payload edit, digest stale OR digest recomputed)
+    is rejected and rebuilt instead of proving garbage."""
+    from zkp2p_tpu.prover import matvec_plan
+
+    cs, (x, y), dpk, vk = toy_world
+    monkeypatch.setenv("ZKP2P_MSM_PRECOMP_PERSIST_MIN", "1")
+    plans = matvec_plan.plans_for(dpk)
+    assert plans is not None and set(plans) == {"a", "b"}
+    assert all(p.source == "built" for p in plans.values())
+    cache_dir = os.path.join(str(tmp_path), "cache")
+    files = sorted(f for f in os.listdir(cache_dir) if f.startswith("matvec_seg_"))
+    assert len(files) == 2
+
+    matvec_plan.reset()
+    warm = matvec_plan.plans_for(dpk)
+    assert all(p.source == "cache" for p in warm.values())
+    for mat in ("a", "b"):
+        assert np.array_equal(warm[mat].coeff, plans[mat].coeff)
+        assert np.array_equal(warm[mat].seg_starts, plans[mat].seg_starts)
+        assert np.array_equal(warm[mat].seg_rows, plans[mat].seg_rows)
+
+    # tamper 1: edit a payload array, digest left stale -> digest check
+    path = os.path.join(cache_dir, files[0])
+    with np.load(path) as data:
+        arrays = {k: data[k].copy() for k in data.files}
+    arrays["wire"][0] ^= np.uint32(1)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    matvec_plan.reset()
+    rebuilt = matvec_plan.plans_for(dpk)
+    assert rebuilt[files[0].split("_")[2]].source == "built", "stale-digest tamper trusted"
+
+    # tamper 2: edit + RECOMPUTE the digest -> the sampled source
+    # cross-check must still reject it
+    with np.load(path) as data:
+        arrays = {k: data[k].copy() for k in data.files}
+    arrays["wire"][:] = (arrays["wire"] + 1) % 2  # garbage wires, in range
+    arrays["digest"] = np.array(
+        matvec_plan._content_digest(
+            arrays["coeff"], arrays["wire"], arrays["perm"],
+            arrays["seg_starts"], arrays["seg_rows"],
+        )
+    )
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    matvec_plan.reset()
+    rebuilt = matvec_plan.plans_for(dpk)
+    assert rebuilt[files[0].split("_")[2]].source == "built", "forged-digest tamper trusted"
+
+
+# ----------------------------------------------------------- executor
+
+
+def test_no_per_prove_executor_churn(monkeypatch, toy_world):
+    """Regression (the satellite contract): a batch prove constructs
+    ZERO new ThreadPoolExecutors — the shared executor replaced the
+    per-proof, per-matvec construction churn."""
+    import concurrent.futures as cf
+
+    from zkp2p_tpu.prover import native_prove
+    from zkp2p_tpu.prover.native_prove import prove_native_batch
+
+    cs, (x, y), dpk, vk = toy_world
+    w = cs.witness([_toy_public()], {x: 3, y: 5})
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", "2")
+    native_prove._shared_executor()  # force the one global construction
+
+    real = cf.ThreadPoolExecutor
+    count = {"n": 0}
+
+    class Counting(real):
+        def __init__(self, *a, **k):
+            count["n"] += 1
+            super().__init__(*a, **k)
+
+    monkeypatch.setattr(cf, "ThreadPoolExecutor", Counting)
+    for seg in ("1", "0"):  # both matvec arms ride the shared executor
+        monkeypatch.setenv("ZKP2P_MATVEC_SEG", seg)
+        prove_native_batch(dpk, [w, w, w], rs=[1, 2, 3], ss=[4, 5, 6])
+    assert count["n"] == 0, f"{count['n']} executors constructed during batches"
+
+
+# ----------------------------------------------------------- stats
+
+
+def test_nonmsm_stats_counters(monkeypatch, toy_world):
+    """The new ABI slots tick: matvec_ns on both arms, matvec_seg_calls
+    only on the segmented arm, ntt_stage_ns whenever the vector stages
+    ran (IFMA hosts)."""
+    from zkp2p_tpu.native.lib import ifma_available, stats_reset, stats_snapshot
+    from zkp2p_tpu.prover.native_prove import prove_native
+
+    cs, (x, y), dpk, vk = toy_world
+    w = cs.witness([_toy_public()], {x: 3, y: 5})
+    monkeypatch.setenv("ZKP2P_MATVEC_SEG", "1")
+    assert stats_reset()
+    prove_native(dpk, w, r=1, s=2)
+    snap = stats_snapshot()
+    assert snap["matvec_seg_calls"] >= 2  # A and B matrices
+    assert snap["matvec_ns"] > 0
+    if ifma_available():
+        assert snap["ntt_stage_ns"] > 0
+    monkeypatch.setenv("ZKP2P_MATVEC_SEG", "0")
+    assert stats_reset()
+    prove_native(dpk, w, r=1, s=2)
+    snap = stats_snapshot()
+    assert snap["matvec_seg_calls"] == 0
+    assert snap["matvec_ns"] > 0

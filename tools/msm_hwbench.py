@@ -334,6 +334,117 @@ def _native_multi_bench(args, lib, bm, threads):
     assert parity == "OK", "multi-column result diverged from the sequential oracle"
 
 
+def _ladder_bench(args):
+    """--ladder: the non-MSM floor in isolation (docs/TUNING.md
+    §non-MSM) — the segmented matvec vs the scatter `fr_matvec` oracle,
+    and the H ladder with the pool-parallel fused NTT pipeline vs the
+    3-wide unfused arm.  Interleaved same-process A/B (both knobs are
+    fresh-read in csrc), min-of-reps, parity asserted on output bytes.
+
+      python tools/msm_hwbench.py --ladder --n 524288 [--reps 5]
+    """
+    import ctypes
+
+    import numpy as np
+
+    from zkp2p_tpu.field.bn254 import fr_domain_root
+    from zkp2p_tpu.prover import matvec_plan
+    from zkp2p_tpu.prover.native_prove import _lib, _n_threads, _p
+    from zkp2p_tpu.snark.groth16 import coset_gen
+
+    lib = _lib()
+    assert lib is not None, "native library unavailable"
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i64p = ctypes.POINTER(ctypes.c_longlong)
+    threads = _n_threads()
+    m = args.n
+    log_m = m.bit_length() - 1
+    assert 1 << log_m == m, "--ladder needs a power-of-two --n (the NTT domain)"
+    print(
+        f"ladder arm: m=2^{log_m} threads={threads} "
+        f"ifma={'on' if lib.zkp2p_ifma_available() else 'off'} reps={args.reps}",
+        flush=True,
+    )
+    g = np.random.default_rng(17)
+
+    def rand_fr(n):
+        a = g.integers(0, 1 << 64, size=(n, 4), dtype=np.uint64)
+        a[:, 3] &= np.uint64((1 << 60) - 1)  # < 2^252 < r
+        return np.ascontiguousarray(a)
+
+    def mont(std):
+        out = np.zeros_like(std)
+        lib.fr_to_mont_batch(_p(std), _p(out), std.shape[0])
+        return out
+
+    # ---- matvec: venmo-like density (~4 nnz/row), random wires/rows
+    nnz = 4 * m
+    coeff = mont(rand_fr(nnz))
+    wire = g.integers(0, m, size=nnz, dtype=np.uint32)
+    row = g.integers(0, m, size=nnz, dtype=np.uint32)
+    w_mont = mont(rand_fr(m))
+    cp, wp, _perm, seg_starts, seg_rows = matvec_plan._build(coeff, wire, row)
+    c52 = matvec_plan._pack52(lib, cp)
+    outs = {}
+    times = {"oracle": [], "seg": []}
+    for _ in range(args.reps):
+        for arm in ("oracle", "seg"):  # interleaved
+            out = np.zeros((m, 4), dtype=np.uint64)
+            t0 = time.time()
+            if arm == "oracle":
+                lib.fr_matvec(
+                    _p(coeff), wire.ctypes.data_as(u32p), row.ctypes.data_as(u32p),
+                    nnz, _p(w_mont), m, _p(out),
+                )
+            else:
+                lib.fr_matvec_seg(
+                    _p(c52) if c52 is not None else None, _p(cp),
+                    wp.ctypes.data_as(u32p), seg_starts.ctypes.data_as(i64p),
+                    seg_rows.ctypes.data_as(u32p), seg_rows.shape[0],
+                    _p(w_mont), m, threads, _p(out),
+                )
+            times[arm].append(time.time() - t0)
+            outs[arm] = out
+    assert np.array_equal(outs["oracle"], outs["seg"]), "segmented matvec diverged"
+    mo, ms = min(times["oracle"]), min(times["seg"])
+    print(
+        f"matvec nnz={nnz}: oracle min={mo*1e3:.1f} ms seg min={ms*1e3:.1f} ms "
+        f"-> {mo/ms:.2f}x parity=OK",
+        flush=True,
+    )
+
+    # ---- H ladder: pool-fused arm vs the 3-wide unfused arm
+    wroot = np.ascontiguousarray(
+        np.frombuffer(int(fr_domain_root(log_m)).to_bytes(32, "little"), dtype="<u8")
+    )
+    gcos = np.ascontiguousarray(
+        np.frombuffer(int(coset_gen(log_m)).to_bytes(32, "little"), dtype="<u8")
+    )
+    base = mont(rand_fr(3 * m)).reshape(3, m, 4)
+    lt = {"pool": [], "unfused": []}
+    louts = {}
+    for _ in range(args.reps):
+        for arm, knob in (("pool", "1"), ("unfused", "0")):
+            os.environ["ZKP2P_NTT_POOL"] = knob
+            abc = [np.ascontiguousarray(base[i].copy()) for i in range(3)]
+            d = np.zeros((m, 4), dtype=np.uint64)
+            t0 = time.time()
+            lib.fr_h_ladder(
+                _p(abc[0]), _p(abc[1]), _p(abc[2]), m, _p(wroot), _p(gcos), _p(d)
+            )
+            lt[arm].append(time.time() - t0)
+            louts[arm] = d
+    os.environ.pop("ZKP2P_NTT_POOL", None)
+    assert np.array_equal(louts["pool"], louts["unfused"]), "pooled ladder diverged"
+    lp, lu = min(lt["pool"]), min(lt["unfused"])
+    print(
+        f"h_ladder m=2^{log_m}: unfused min={lu*1e3:.0f} ms pool-fused min={lp*1e3:.0f} ms "
+        f"-> {lu/lp:.2f}x parity=OK",
+        flush=True,
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1 << 17)
@@ -353,6 +464,12 @@ def main():
         "omit --window (or pass 0) for the prover's _pick_window choice",
     )
     ap.add_argument("--reps", type=int, default=5, help="native arm: min-of-reps (noisy box)")
+    ap.add_argument(
+        "--ladder", action="store_true",
+        help="bench the NON-MSM floor in isolation: segmented matvec vs the "
+        "scatter oracle + the pool-fused H ladder vs the 3-wide unfused arm, "
+        "interleaved same-process A/B at domain size --n (power of two)",
+    )
     ap.add_argument(
         "--columns", type=int, default=1,
         help="native arm: S > 1 benches the multi-column kernel (one base sweep, "
@@ -404,6 +521,9 @@ def main():
     elif args.no_batch_affine:
         os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "0"
 
+    if args.ladder:
+        _ladder_bench(args)
+        return
     if args.native:
         _native_bench(args)
         return

@@ -110,6 +110,9 @@ STATS_FIELDS = (
     "msm_fixed_prep_ns",
     "precomp_build_ns",
     "precomp_table_bytes",
+    "matvec_ns",
+    "matvec_seg_calls",
+    "ntt_stage_ns",
 )
 
 

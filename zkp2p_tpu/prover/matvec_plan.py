@@ -1,0 +1,334 @@
+"""Segmented matvec plans for the frozen QAP matrices.
+
+The A/B matvecs (out[row[i]] += coeff[i]·w[wire[i]]) are a serial
+read-modify-write scatter in the oracle kernel — at ~2-4 nnz per
+constraint row the Montgomery mul IS the stage, and the scatter blocks
+both vectorization and threading.  The matrices are immutable for the
+life of a DeviceProvingKey, so (the same trade the fixed-base MSM
+tables made in prover.precomp) this module presorts each matrix's nnz
+by output row ONCE into a plan:
+
+  * `perm`        — stable argsort of the row array (plan order),
+  * `coeff`/`wire`— the gathered (permuted) coefficient / wire arrays,
+  * `seg_starts`  — row-segment boundaries (segment s = one output row),
+  * `seg_rows`    — the output row each segment sums into,
+  * `coeff52`     — per process, the coeffs re-packed to the mont260
+                    8-lane SoA blocks the IFMA product loop consumes
+                    (csrc fr_matvec_pack52; never persisted — one cheap
+                    conversion pass, and keying the disk cache by IFMA
+                    arm would double the files).
+
+`csrc fr_matvec_seg` then runs the products 8-wide ACROSS segment
+boundaries (they are independent) and partitions the segment space over
+the persistent WorkPool with zero scatter conflicts by construction —
+each worker owns a disjoint row range.  Byte parity with the scatter
+oracle is exact (field addition is associative; products are reduced
+canonically), pinned by tests/test_nonmsm.py.
+
+Plans persist beside the fixed-base precomp tables (``.bench_cache/``,
+``matvec_seg_<mat>_<key_hash>.npz``) keyed by a sha256 over the SOURCE
+matrix bytes, so a different key or matrix resolves to a different file
+by construction.  Loads are tamper-rejecting: structural invariants
+(monotone segment bounds, strictly increasing rows, in-range wires), an
+embedded content digest, and sampled cross-checks of plan entries
+against the live source matrix through ``perm`` — a corrupt, foreign,
+or bit-rotted plan rebuilds (cheap: one argsort) instead of proving
+garbage.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_i64p = ctypes.POINTER(ctypes.c_longlong)
+
+# The QAP matrices with a matvec in the prove path (Cz is the pointwise
+# Az·Bz product, never a matvec).
+MATRICES = ("a", "b")
+
+
+@dataclass
+class MatvecPlan:
+    """One matrix's presorted segment plan (+ the per-process 52-pack)."""
+
+    matrix: str
+    coeff: np.ndarray  # (nnz, 4) u64 Montgomery, plan order
+    wire: np.ndarray  # (nnz,) u32, plan order
+    perm: np.ndarray  # (nnz,) u32: plan index -> source nnz index
+    seg_starts: np.ndarray  # (nseg+1,) i64, monotone, [0 .. nnz]
+    seg_rows: np.ndarray  # (nseg,) u32, strictly increasing
+    coeff52: Optional[np.ndarray]  # packed mont260 blocks, or None (scalar tier)
+    key_hash: str
+    source: str  # "built" | "cache"
+
+    @property
+    def nseg(self) -> int:
+        return int(self.seg_rows.shape[0])
+
+    def pointers(self):
+        """The (coeff52, coeff, wire, seg_starts, seg_rows, nseg) ctypes
+        argument pack fr_matvec_seg consumes."""
+        p52 = self.coeff52.ctypes.data_as(_u64p) if self.coeff52 is not None else None
+        return (
+            p52,
+            self.coeff.ctypes.data_as(_u64p),
+            self.wire.ctypes.data_as(_u32p),
+            self.seg_starts.ctypes.data_as(_i64p),
+            self.seg_rows.ctypes.data_as(_u32p),
+            self.nseg,
+        )
+
+
+# One plan dict per DeviceProvingKey identity — the precomp.py memo
+# pattern: entries pin the dpk so an id() cannot be reused while its
+# entry is alive; lock-guarded (batch d-column workers resolve plans
+# concurrently); small cap bounds test-suite churn.
+_plan_cache: Dict[int, Tuple[object, Dict[str, MatvecPlan]]] = {}
+_PLAN_CACHE_CAP = 4
+_plan_lock = threading.Lock()
+_build_lock = threading.Lock()
+
+
+def reset() -> None:
+    """Drop memoized plans (tests)."""
+    with _plan_lock:
+        _plan_cache.clear()
+
+
+def _source_arrays(dpk, matrix: str):
+    """(coeff_u64 (nnz,4) mont256, wire u32, row u32) for one matrix —
+    the same limb conversion + memo the oracle matvec path uses."""
+    from .native_prove import _bases_memo, _limbs16_to_u64
+
+    coeff = getattr(dpk, f"{matrix}_coeff")
+    cf = _bases_memo(
+        (coeff, coeff),
+        lambda b: np.ascontiguousarray(_limbs16_to_u64(np.asarray(b[0]))),
+    )
+    wi = np.ascontiguousarray(np.asarray(getattr(dpk, f"{matrix}_wire"), dtype=np.uint32))
+    ro = np.ascontiguousarray(np.asarray(getattr(dpk, f"{matrix}_row"), dtype=np.uint32))
+    return cf, wi, ro
+
+
+def _key_hash(cf: np.ndarray, wi: np.ndarray, ro: np.ndarray, m: int) -> str:
+    """sha256 over the FULL source matrix bytes + domain size (16 hex).
+    Full, not sampled — the hash is the cache-invalidation key."""
+    h = hashlib.sha256()
+    h.update(np.asarray([cf.shape[0], m], dtype=np.int64).tobytes())
+    h.update(np.ascontiguousarray(cf).tobytes())
+    h.update(wi.tobytes())
+    h.update(ro.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _content_digest(coeff, wire, perm, seg_starts, seg_rows) -> str:
+    """Digest over the PLAN arrays (embedded in the npz; a flipped bit
+    anywhere in the file fails the compare and rebuilds)."""
+    h = hashlib.sha256()
+    for a in (coeff, wire, perm, seg_starts, seg_rows):
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _cache_path(cache_dir: str, matrix: str, key_hash: str) -> str:
+    return os.path.join(cache_dir, f"matvec_seg_{matrix}_{key_hash}.npz")
+
+
+def _build(cf: np.ndarray, wi: np.ndarray, ro: np.ndarray):
+    """Presort by output row -> (coeff, wire, perm, seg_starts, seg_rows)."""
+    nnz = int(ro.shape[0])
+    perm = np.argsort(ro, kind="stable").astype(np.uint32)
+    rows_sorted = ro[perm]
+    coeff = np.ascontiguousarray(cf[perm])
+    wire = np.ascontiguousarray(wi[perm])
+    if nnz:
+        bounds = np.flatnonzero(np.diff(rows_sorted)) + 1
+        seg_starts = np.concatenate(
+            [[0], bounds, [nnz]]
+        ).astype(np.int64)
+        seg_rows = rows_sorted[seg_starts[:-1]].astype(np.uint32)
+    else:
+        seg_starts = np.zeros(1, dtype=np.int64)
+        seg_rows = np.zeros(0, dtype=np.uint32)
+    return coeff, wire, perm, np.ascontiguousarray(seg_starts), np.ascontiguousarray(seg_rows)
+
+
+def _validate(
+    data, cf: np.ndarray, wi: np.ndarray, ro: np.ndarray, m: int
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Structural + digest + sampled-source validation of a loaded plan;
+    None on ANY mismatch (the caller rebuilds)."""
+    try:
+        coeff = np.ascontiguousarray(data["coeff"])
+        wire = np.ascontiguousarray(data["wire"])
+        perm = np.ascontiguousarray(data["perm"])
+        seg_starts = np.ascontiguousarray(data["seg_starts"])
+        seg_rows = np.ascontiguousarray(data["seg_rows"])
+        digest = str(data["digest"])
+    except Exception:  # noqa: BLE001 — a torn npz must rebuild, not raise
+        return None
+    nnz = int(ro.shape[0])
+    nseg = int(seg_rows.shape[0])
+    if (
+        coeff.shape != (nnz, 4)
+        or coeff.dtype != np.uint64
+        or wire.shape != (nnz,)
+        or wire.dtype != np.uint32
+        or perm.shape != (nnz,)
+        or perm.dtype != np.uint32
+        or seg_starts.shape != (nseg + 1,)
+        or seg_starts.dtype != np.int64
+        or seg_rows.dtype != np.uint32
+    ):
+        return None
+    if digest != _content_digest(coeff, wire, perm, seg_starts, seg_rows):
+        return None
+    # structural invariants the C driver relies on
+    if nseg:
+        if seg_starts[0] != 0 or seg_starts[-1] != nnz:
+            return None
+        if not (np.diff(seg_starts) > 0).all():
+            return None
+        if not (np.diff(seg_rows.astype(np.int64)) > 0).all():
+            return None
+        if int(seg_rows.max()) >= m:
+            return None
+    elif nnz:
+        return None
+    # wire indices must stay inside the source's index range — an
+    # out-of-range tamper would read past the witness buffer in C
+    if nnz and int(wire.max()) > int(wi.max()):
+        return None
+    # sampled cross-check against the LIVE source through perm: a plan
+    # for a different (but structurally valid) matrix fails here
+    if nnz:
+        idx = np.unique(np.linspace(0, nnz - 1, num=min(nnz, 64), dtype=np.int64))
+        src = perm[idx].astype(np.int64)
+        if int(src.max()) >= nnz:
+            return None
+        if not np.array_equal(coeff[idx], cf[src]) or not np.array_equal(
+            wire[idx], wi[src]
+        ):
+            return None
+        seg_of = np.searchsorted(seg_starts, idx, side="right") - 1
+        if not np.array_equal(seg_rows[seg_of], ro[src]):
+            return None
+    return coeff, wire, perm, seg_starts, seg_rows
+
+
+def _persist(path: str, coeff, wire, perm, seg_starts, seg_rows) -> None:
+    """Atomic write (tmp + rename) — precomp._persist_table's contract."""
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "wb") as f:
+            np.savez(
+                f,
+                coeff=coeff,
+                wire=wire,
+                perm=perm,
+                seg_starts=seg_starts,
+                seg_rows=seg_rows,
+                digest=_content_digest(coeff, wire, perm, seg_starts, seg_rows),
+            )
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+
+
+def _pack52(lib, coeff: np.ndarray) -> Optional[np.ndarray]:
+    nnz = int(coeff.shape[0])
+    if nnz == 0:
+        return None
+    out = np.zeros(((nnz + 7) // 8) * 40, dtype=np.uint64)
+    if not lib.fr_matvec_pack52(coeff.ctypes.data_as(_u64p), nnz, out.ctypes.data_as(_u64p)):
+        return None
+    return out
+
+
+def _resolve_one(lib, dpk, matrix: str, cache_dir: Optional[str], persist_min: int) -> MatvecPlan:
+    from ..utils.trace import trace
+
+    cf, wi, ro = _source_arrays(dpk, matrix)
+    m = 1 << dpk.log_m
+    nnz = int(ro.shape[0])
+    kh = _key_hash(cf, wi, ro, m)
+    persist = cache_dir is not None and nnz >= persist_min
+    path = _cache_path(cache_dir, matrix, kh) if persist else None
+
+    plan_arrays = None
+    source = "cache"
+    if path is not None and os.path.exists(path):
+        with trace("native/matvec_plan_load", matrix=matrix):
+            try:
+                with np.load(path) as data:
+                    plan_arrays = _validate(data, cf, wi, ro, m)
+            except Exception:  # noqa: BLE001 — corrupt cache rebuilds
+                plan_arrays = None
+    if plan_arrays is None:
+        source = "built"
+        with trace("native/matvec_plan_build", matrix=matrix):
+            plan_arrays = _build(cf, wi, ro)
+        if path is not None:
+            _persist(path, *plan_arrays)
+    coeff, wire, perm, seg_starts, seg_rows = plan_arrays
+    return MatvecPlan(
+        matrix=matrix,
+        coeff=coeff,
+        wire=wire,
+        perm=perm,
+        seg_starts=seg_starts,
+        seg_rows=seg_rows,
+        coeff52=_pack52(lib, coeff),
+        key_hash=kh,
+        source=source,
+    )
+
+
+def plans_for(dpk) -> Optional[Dict[str, MatvecPlan]]:
+    """The segment plans for this DeviceProvingKey ({"a": .., "b": ..}),
+    memoized per key identity; built or cache-loaded on first use.  None
+    when the native library is unavailable.  Callers gate on
+    ZKP2P_MATVEC_SEG (native_prove._use_matvec_seg) BEFORE calling."""
+    from .native_prove import _lib
+
+    lib = _lib()
+    if lib is None:
+        return None
+    key = id(dpk)
+    with _plan_lock:
+        hit = _plan_cache.get(key)
+        if hit is not None and hit[0] is dpk:
+            return hit[1]
+    with _build_lock:
+        with _plan_lock:
+            hit = _plan_cache.get(key)
+            if hit is not None and hit[0] is dpk:
+                return hit[1]
+        from .precomp import _cache_dir
+        from ..utils.config import load_config
+
+        cache_dir = _cache_dir()
+        persist_min = load_config().precomp_persist_min
+        plans = {
+            matrix: _resolve_one(lib, dpk, matrix, cache_dir, persist_min)
+            for matrix in MATRICES
+        }
+        with _plan_lock:
+            if len(_plan_cache) >= _PLAN_CACHE_CAP:
+                _plan_cache.pop(next(iter(_plan_cache)))
+            _plan_cache[key] = (dpk, plans)
+        return plans

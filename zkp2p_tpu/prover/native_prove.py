@@ -78,6 +78,13 @@ def _lib():
             ctypes.c_int, _u64p, ctypes.c_int, _u64p,
         ]
         lib.fr_reduce_batch.argtypes = [_u64p, ctypes.c_long]
+        # segmented matvec tier (prover.matvec_plan)
+        lib.fr_matvec_pack52.argtypes = [_u64p, ctypes.c_long, _u64p]
+        lib.fr_matvec_pack52.restype = ctypes.c_int
+        lib.fr_matvec_seg.argtypes = [
+            _u64p, _u64p, _u32p, ctypes.POINTER(ctypes.c_longlong), _u32p,
+            ctypes.c_long, _u64p, ctypes.c_long, ctypes.c_int, _u64p,
+        ]
         # fixed-base precomputed-window tier (prover.precomp)
         lib.g1_precomp_build.argtypes = [
             _u64p, ctypes.c_long, ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -246,14 +253,98 @@ def _use_msm_precomp() -> bool:
     return record_arm("native_msm_precomp", load_config().msm_precomp)
 
 
-def _witness_std_u64(lib, witness: Sequence[int]) -> np.ndarray:
+def _use_matvec_seg() -> bool:
+    """Segmented-plan matvec gate (ZKP2P_MATVEC_SEG, default ON): the
+    A/B matvecs run through the presorted per-key segment plan
+    (prover.matvec_plan + csrc fr_matvec_seg — 8-wide IFMA products,
+    pool-parallel conflict-free segments); =0 falls back to the scatter
+    oracle `fr_matvec` — the byte-parity arm.  Fresh-read per prove and
+    record_arm-audited, so A/B digests distinguish the arms."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("native_matvec_seg", load_config().matvec_seg)
+
+
+def _ntt_pool_arm() -> bool:
+    """NTT stage-pool + fused-ladder gate (ZKP2P_NTT_POOL, default ON).
+    The arm itself is resolved IN the C runtime (fresh getenv per
+    ladder/NTT call, like ZKP2P_MSM_BATCH_AFFINE); this mirror records
+    it into the execution digest so pool-NTT A/Bs are
+    digest-distinguishable.  apply_env keeps the env and the typed
+    config coherent, so the recorded arm is the arm C takes."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("native_ntt_pool", load_config().ntt_pool)
+
+
+# ONE process-wide executor for the prover's Python-side task graphs
+# (stage overlap + oracle-arm matvec jobs).  The per-prove, per-matvec
+# `ThreadPoolExecutor(max_workers=2)` constructions this replaces
+# spawned and joined 2-6 threads per proof — tens of thread spawns per
+# batch, pure overhead on the hot path (tests/test_nonmsm.py counts
+# constructions per batch now).  Sized for the widest acyclic task set:
+# 4 overlap tasks + 2 oracle matvec leaves; leaves are only ever
+# submitted from the MAIN thread, so the graph cannot deadlock on pool
+# exhaustion.
+_executor = None
+_executor_lock = threading.Lock()
+
+
+def _shared_executor():
+    global _executor
+    with _executor_lock:
+        if _executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _executor = ThreadPoolExecutor(
+                max_workers=6, thread_name_prefix="zkp2p-native"
+            )
+        return _executor
+
+
+def _witness_std_u64(lib, witness: Sequence[int], fast: bool = False) -> np.ndarray:
     """Witness ints -> standard-form (n, 4) u64 MSM scalars, reduced
     mod r IN THE NATIVE LIBRARY (docs/NEXT.md lever 3): raw 256-bit
     serialization here, `fr_reduce_batch` there — the per-element
     Python `w % R` this replaces was ~half the witness_convert stage.
     Values a 256-bit window cannot hold (negative or >= 2^256 — no
     in-tree witness builder emits them) fall back to the exact Python
-    reduction."""
+    reduction.
+
+    fast=True (the ZKP2P_MATVEC_SEG arm — witness-side leg of the same
+    vectorized-floor tier, so the knob-off arm reproduces the full
+    pre-tier path): real witnesses are overwhelmingly sub-64-bit wires
+    (99.2% on the venmo shape — bits, bytes, bignum limbs), so chunks
+    bulk-assign into the u64 column at numpy C speed (already < r, no
+    reduction needed); a chunk holding any >= 2^64 value raises
+    OverflowError and takes the exact serialize+reduce path for that
+    chunk alone.  Byte-identical to the slow path by construction
+    (pinned in tests/test_nonmsm.py)."""
+    n = len(witness)
+    if fast and n:
+        try:
+            arr = np.zeros((n, 4), dtype=np.uint64)
+            col = arr[:, 0]
+            CH = 8192
+            for lo in range(0, n, CH):
+                hi = min(n, lo + CH)
+                chunk = witness[lo:hi]
+                try:
+                    col[lo:hi] = chunk  # raises on >= 2^64 / negative / non-int
+                except (OverflowError, TypeError, ValueError):
+                    sub = np.frombuffer(
+                        b"".join(int(w).to_bytes(32, "little") for w in chunk),
+                        dtype="<u8",
+                    ).reshape(hi - lo, 4)
+                    view = arr[lo:hi]
+                    view[:] = sub
+                    lib.fr_reduce_batch(_p(view), hi - lo)
+            return arr
+        except (OverflowError, ValueError, TypeError):
+            pass  # exotic values (negative / >= 2^256) or a non-sliceable
+            # sequence: the exact paths below handle them
     try:
         buf = b"".join(int(w).to_bytes(32, "little") for w in witness)
     except (OverflowError, ValueError):
@@ -383,6 +474,58 @@ def _n_threads() -> int:
     return v if v else max(1, os.cpu_count() or 1)
 
 
+def _run_matvecs(lib, dpk, w_mont: np.ndarray, m: int, threads: int, a_ev, b_ev, plans):
+    """The A/B QAP matvecs into a_ev/b_ev.  With a segment plan armed,
+    each matrix is ONE `fr_matvec_seg` call — 8-wide IFMA products,
+    segments partitioned across the C pool with no scatter conflicts
+    (the pool is the parallel axis; no Python threads needed).  The
+    oracle arm keeps the scatter `fr_matvec` with the two matrices on
+    the shared executor."""
+    if plans is not None:
+        for matrix, out in (("a", a_ev), ("b", b_ev)):
+            p52, pcf, pwi, pss, psr, nseg = plans[matrix].pointers()
+            lib.fr_matvec_seg(
+                p52, pcf, pwi, pss, psr, nseg, _p(w_mont), m, threads, _p(out)
+            )
+        return
+
+    def matvec(coeff, wire, row, out):
+        cf = _bases_memo(
+            (coeff, coeff),
+            lambda b: np.ascontiguousarray(_limbs16_to_u64(np.asarray(b[0]))),
+        )
+        wi = np.ascontiguousarray(np.asarray(wire, dtype=np.uint32))
+        ro = np.ascontiguousarray(np.asarray(row, dtype=np.uint32))
+        lib.fr_matvec(_p(cf), _p32(wi), _p32(ro), cf.shape[0], _p(w_mont), m, _p(out))
+
+    jobs = [
+        (dpk.a_coeff, dpk.a_wire, dpk.a_row, a_ev),
+        (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
+    ]
+    if threads > 1:
+        # futures, not bare Threads: a worker exception must abort the
+        # prove, not leave a zeroed evaluation vector behind.  Shared
+        # executor — the per-matvec ThreadPoolExecutor construction this
+        # replaces spawned threads on every proof.
+        ex = _shared_executor()
+        for f in [ex.submit(matvec, *j) for j in jobs]:
+            f.result()
+    else:
+        for j in jobs:
+            matvec(*j)
+
+
+def _seg_plans(dpk):
+    """The memoized segment plans when ZKP2P_MATVEC_SEG arms (and the
+    native lib is up); None otherwise — callers fall back to the
+    scatter oracle."""
+    if not _use_matvec_seg():
+        return None
+    from .matvec_plan import plans_for
+
+    return plans_for(dpk)
+
+
 def prove_native(
     dpk: DeviceProvingKey,
     witness: Sequence[int],
@@ -406,10 +549,13 @@ def prove_native(
     if s is None:
         s = 1 + secrets.randbelow(R - 1)
     m = 1 << dpk.log_m
+    threads = _n_threads()
+    plans = _seg_plans(dpk)  # memoized; resolves the matvec_seg gate
+    _ntt_pool_arm()  # C-side gate; recorded here for the digest
 
     # Witness: standard-form u64x4 (MSM scalars) + Montgomery (matvec).
     with trace("native/witness_convert"):
-        w_std = _witness_std_u64(lib, witness)
+        w_std = _witness_std_u64(lib, witness, fast=plans is not None)
         n_wires = w_std.shape[0]
         # inferred-width guard, vectorized over the limb view
         _check_inferred_widths(dpk, witness, w_std=w_std)
@@ -424,36 +570,11 @@ def prove_native(
     b_ev = np.zeros((m, 4), dtype=np.uint64)
     c_ev = np.zeros((m, 4), dtype=np.uint64)
     with trace("native/matvec"):
-        def matvec(coeff, wire, row, out):
-            cf = _bases_memo(
-                (coeff, coeff),
-                lambda b: np.ascontiguousarray(_limbs16_to_u64(np.asarray(b[0]))),
-            )
-            wi = np.ascontiguousarray(np.asarray(wire, dtype=np.uint32))
-            ro = np.ascontiguousarray(np.asarray(row, dtype=np.uint32))
-            lib.fr_matvec(_p(cf), _p32(wi), _p32(ro), cf.shape[0], _p(w_mont), m, _p(out))
-
-        jobs = [
-            (dpk.a_coeff, dpk.a_wire, dpk.a_row, a_ev),
-            (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
-        ]
-        if _n_threads() > 1:
-            # futures, not bare Threads: a worker exception must abort the
-            # prove, not leave a zeroed evaluation vector behind.
-            from concurrent.futures import ThreadPoolExecutor
-
-            with ThreadPoolExecutor(max_workers=2) as ex:
-                for f in [ex.submit(matvec, *j) for j in jobs]:
-                    f.result()
-        else:
-            for j in jobs:
-                matvec(*j)
+        _run_matvecs(lib, dpk, w_mont, m, threads, a_ev, b_ev, plans)
         lib.fr_mul_batch(_p(a_ev), _p(b_ev), _p(c_ev), m)
 
     b_sel = np.asarray(dpk.b_sel)
     c_sel = np.asarray(dpk.c_sel)
-
-    threads = _n_threads()
 
     glv = _use_glv()
     # Fixed-base precomputed tables for the frozen G1 families: resolved
@@ -532,8 +653,6 @@ def prove_native(
     from ..utils.config import load_config
 
     if load_config().msm_overlap and threads > 1:
-        from concurrent.futures import ThreadPoolExecutor
-
         from ..utils.trace import adopt_context, adopt_stack, current_context, current_stack
 
         # worker-thread trace records keep this thread's stage prefix
@@ -549,16 +668,16 @@ def prove_native(
             adopt_context(ctx)
             return fn(*fargs)
 
-        with ThreadPoolExecutor(max_workers=4) as ex:
-            fut_a = ex.submit(seeded, msm_g1, dpk.a_bases, w_std, "a")
-            fut_b1 = ex.submit(seeded, msm_g1, dpk.b1_bases, np.ascontiguousarray(w_std[b_sel]), "b1")
-            fut_b2 = ex.submit(seeded, msm_g2, dpk.b2_bases, np.ascontiguousarray(w_std[b_sel]), "b2")
-            fut_c = ex.submit(seeded, msm_g1, dpk.c_bases, np.ascontiguousarray(w_std[c_sel]), "c")
-            d_std = h_ladder_and_d()
-            h_acc = msm_g1(dpk.h_bases, d_std, "h")
-            a_acc, b1_acc, b2_acc, c_acc = (
-                fut_a.result(), fut_b1.result(), fut_b2.result(), fut_c.result()
-            )
+        ex = _shared_executor()
+        fut_a = ex.submit(seeded, msm_g1, dpk.a_bases, w_std, "a")
+        fut_b1 = ex.submit(seeded, msm_g1, dpk.b1_bases, np.ascontiguousarray(w_std[b_sel]), "b1")
+        fut_b2 = ex.submit(seeded, msm_g2, dpk.b2_bases, np.ascontiguousarray(w_std[b_sel]), "b2")
+        fut_c = ex.submit(seeded, msm_g1, dpk.c_bases, np.ascontiguousarray(w_std[c_sel]), "c")
+        d_std = h_ladder_and_d()
+        h_acc = msm_g1(dpk.h_bases, d_std, "h")
+        a_acc, b1_acc, b2_acc, c_acc = (
+            fut_a.result(), fut_b1.result(), fut_b2.result(), fut_c.result()
+        )
     else:
         d_std = h_ladder_and_d()
         a_acc = msm_g1(dpk.a_bases, w_std, "a")
@@ -618,6 +737,11 @@ def prove_native_batch(
     b_sel = np.asarray(dpk.b_sel)
     c_sel = np.asarray(dpk.c_sel)
 
+    # Resolved once per batch (not per proof): the segment plans + both
+    # arm recordings — ladder constants are hoisted further down.
+    plans = _seg_plans(dpk)
+    _ntt_pool_arm()
+
     # Phase 1: witness conversion for EVERY proof first — it is cheap
     # and unlocks all three witness-column multi MSMs (a/b1/c) plus the
     # per-proof b2 G2 MSMs, which the overlap arm below launches before
@@ -625,7 +749,7 @@ def prove_native_batch(
     w_cols, w_monts = [], []
     for witness in witnesses:
         with trace("native/witness_convert"):
-            w_std = _witness_std_u64(lib, witness)
+            w_std = _witness_std_u64(lib, witness, fast=plans is not None)
             n_wires = w_std.shape[0]
             _check_inferred_widths(dpk, witness, w_std=w_std)
             w_mont = np.zeros_like(w_std)
@@ -633,48 +757,41 @@ def prove_native_batch(
         w_cols.append(w_std)
         w_monts.append(w_mont)
 
-    def ladder_cols():
-        # per proof: A/B matvecs, Cz = Az . Bz, H ladder -> d column
-        # (evaluation buffers freed proof-by-proof)
-        d_cols = []
-        for w_mont in w_monts:
+    # Hoisted out of the per-proof ladder loop: the domain root and
+    # coset generator are key-shape constants, yet were re-derived (a
+    # Python bigint pow chain each) S times per batch.
+    w_root = _scalars_to_u64([fr_domain_root(dpk.log_m)]).copy()
+    g_cos = _scalars_to_u64([coset_gen(dpk.log_m)]).copy()
+
+    # Concurrency cap for the pipelined d-column tasks: each live
+    # ladder body holds ~5 m-row buffers (a/b/c/d/d_std) plus the fused
+    # ladder's 5-plane SoA scratch — letting all 6 executor workers run
+    # ladders would multiply transient memory ~6x over the old serial
+    # walk (≈8 GB at 2^23).  Two concurrent bodies keep the b2-overlap
+    # win while bounding the peak at ~2x serial; the gate is INSIDE the
+    # task so a capped task parks its worker, never deadlocks (the
+    # tasks holding the permits always progress and release).
+    d_gate = threading.Semaphore(2)
+
+    def ladder_one_col(w_mont):
+        # one proof: A/B matvecs, Cz = Az . Bz, H ladder -> d column
+        # (evaluation buffers freed on return)
+        with d_gate:
             a_ev = np.zeros((m, 4), dtype=np.uint64)
             b_ev = np.zeros((m, 4), dtype=np.uint64)
             c_ev = np.zeros((m, 4), dtype=np.uint64)
             with trace("native/matvec"):
-                def matvec(coeff, wire, row, out):
-                    cf = _bases_memo(
-                        (coeff, coeff),
-                        lambda b: np.ascontiguousarray(_limbs16_to_u64(np.asarray(b[0]))),
-                    )
-                    wi = np.ascontiguousarray(np.asarray(wire, dtype=np.uint32))
-                    ro = np.ascontiguousarray(np.asarray(row, dtype=np.uint32))
-                    lib.fr_matvec(_p(cf), _p32(wi), _p32(ro), cf.shape[0], _p(w_mont), m, _p(out))
-
-                jobs = [
-                    (dpk.a_coeff, dpk.a_wire, dpk.a_row, a_ev),
-                    (dpk.b_coeff, dpk.b_wire, dpk.b_row, b_ev),
-                ]
-                if threads > 1:
-                    from concurrent.futures import ThreadPoolExecutor
-
-                    with ThreadPoolExecutor(max_workers=2) as mex:
-                        for f in [mex.submit(matvec, *j) for j in jobs]:
-                            f.result()
-                else:
-                    for j in jobs:
-                        matvec(*j)
+                _run_matvecs(lib, dpk, w_mont, m, threads, a_ev, b_ev, plans)
                 lib.fr_mul_batch(_p(a_ev), _p(b_ev), _p(c_ev), m)
             with trace("native/h_ladder"):
                 d = np.zeros((m, 4), dtype=np.uint64)
-                w_root = _scalars_to_u64([fr_domain_root(dpk.log_m)]).copy()
-                g_cos = _scalars_to_u64([coset_gen(dpk.log_m)]).copy()
                 lib.fr_h_ladder(_p(a_ev), _p(b_ev), _p(c_ev), m, _p(w_root), _p(g_cos), _p(d))
                 d_std = np.zeros_like(d)
                 lib.fr_from_mont_batch(_p(d), _p(d_std), m)
-            d_cols.append(d_std)
-            del a_ev, b_ev, c_ev
-        return d_cols
+            return d_std
+
+    def ladder_cols():
+        return [ladder_one_col(w_mont) for w_mont in w_monts]
 
     # Phase 2: the MSMs.  a/b1/c/h each ride ONE multi-column call over
     # the fixed (memoized) bases; b2 stays a per-proof G2 MSM.  With
@@ -741,12 +858,10 @@ def prove_native_batch(
         # everything witness-dependent — the three witness-column multi
         # MSMs and the S per-proof G2 MSMs — runs on worker threads
         # (ctypes releases the GIL; the C pool's region width caps bound
-        # window concurrency) while THIS thread grinds the per-proof
-        # matvec/H-ladder pipeline and then the h multi MSM, which sits
-        # behind it.  Assembly order stays fixed, so proof bytes match
-        # the sequential schedule.
-        from concurrent.futures import ThreadPoolExecutor
-
+        # window concurrency) while the per-proof matvec/H-ladder
+        # pipeline produces d columns, then the h multi MSM (which sits
+        # behind ALL of them) runs on this thread.  Assembly order stays
+        # fixed, so proof bytes match the sequential schedule.
         from ..utils.trace import adopt_context, adopt_stack, current_context, current_stack
 
         stack = current_stack()
@@ -757,18 +872,39 @@ def prove_native_batch(
             adopt_context(ctx)
             return fn(*fargs)
 
-        with ThreadPoolExecutor(max_workers=4) as ex:
-            fut_a = ex.submit(seeded, msm_g1_multi, dpk.a_bases, w_cols, "a")
-            fut_b1 = ex.submit(seeded, msm_g1_multi, dpk.b1_bases, b_cols, "b1")
-            fut_b2 = ex.submit(
+        ex = _shared_executor()
+        fut_a = ex.submit(seeded, msm_g1_multi, dpk.a_bases, w_cols, "a")
+        fut_b1 = ex.submit(seeded, msm_g1_multi, dpk.b1_bases, b_cols, "b1")
+        fut_c = ex.submit(seeded, msm_g1_multi, dpk.c_bases, c_cols, "c")
+        if plans is not None:
+            # PIPELINED arm: per-proof b2 tasks (not one serialized
+            # list — a free worker starts proof k's G2 MSM while k-1's
+            # runs) interleaved with ladder d-column tasks, so the h
+            # multi MSM starts when the LAST column lands instead of
+            # after a serial ladder walk.  Segment-plan arm only: its
+            # matvec parallelism lives in the C pool, so a d task never
+            # submits executor work (workers submitting-and-blocking
+            # could exhaust the shared pool).
+            b2_futs = [
+                ex.submit(seeded, msm_g2_one, dpk.b2_bases, col, "b2") for col in b_cols
+            ]
+            d_cols = [f.result() for f in [
+                ex.submit(seeded, ladder_one_col, w_mont) for w_mont in w_monts
+            ]]
+        else:
+            # oracle arm: ONE serialized b2 task (the pre-tier
+            # schedule) — S individual b2 tasks would FIFO-queue ahead
+            # of the main-thread ladder's matvec leaves on the shared
+            # executor and stall the d-column pipeline the h MSM waits
+            # on.
+            b2_futs = [ex.submit(
                 seeded, lambda: [msm_g2_one(dpk.b2_bases, col, "b2") for col in b_cols]
-            )
-            fut_c = ex.submit(seeded, msm_g1_multi, dpk.c_bases, c_cols, "c")
+            )]
             d_cols = ladder_cols()
-            h_accs = msm_g1_multi(dpk.h_bases, d_cols, "h")
-            a_accs, b1_accs, b2_accs, c_accs = (
-                fut_a.result(), fut_b1.result(), fut_b2.result(), fut_c.result()
-            )
+        h_accs = msm_g1_multi(dpk.h_bases, d_cols, "h")
+        a_accs, b1_accs, c_accs = (fut_a.result(), fut_b1.result(), fut_c.result())
+        gathered = [f.result() for f in b2_futs]
+        b2_accs = gathered if plans is not None else gathered[0]
     else:
         d_cols = ladder_cols()
         a_accs = msm_g1_multi(dpk.a_bases, w_cols, "a")

@@ -349,8 +349,10 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
 
     from ..native.lib import get_lib
     from ..prover.native_prove import (
+        _ntt_pool_arm,
         _use_batch_affine,
         _use_glv,
+        _use_matvec_seg,
         _use_msm_multi,
         _use_msm_precomp,
     )
@@ -359,6 +361,8 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     _use_batch_affine()
     _use_msm_multi()
     _use_msm_precomp()
+    _use_matvec_seg()
+    _ntt_pool_arm()
     native_ok = False
     try:
         native_ok = get_lib() is not None

@@ -183,6 +183,27 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     # full-width ladder scalars still measure ~1.25x over the GLV
     # variable-base arm at the bench shape (docs/TUNING.md sweep).
     "precomp_families": ("ZKP2P_MSM_PRECOMP_FAMILIES", str, "a,b1,c,h"),
+    # Segmented-plan matvec in the native prover (prover.matvec_plan +
+    # csrc fr_matvec_seg): the A/B QAP matvecs run over a per-key
+    # presorted plan — 8-wide IFMA coeff·wire products across segment
+    # boundaries, segments partitioned over the C worker pool with no
+    # scatter conflicts by construction; plans persist beside the
+    # precomp tables keyed by matrix hash.  Default ON; "0" falls back
+    # to the scatter `fr_matvec` oracle — the byte-parity A/B arm.
+    # Fresh-read per prove, so one process can A/B both arms.
+    "matvec_seg": ("ZKP2P_MATVEC_SEG", _not_zero, True),
+    # Pool-parallel NTT stage splitting + fused coset ladder + Fr
+    # vector batch passes in the C runtime: each NTT stage's butterfly
+    # blocks fan out across the persistent WorkPool (ONE transform uses
+    # every core, vs the old 3-wide whole-transform ladder split), the
+    # H ladder keeps data in 52-limb SoA form across iNTT -> coset-mul
+    # -> forward NTT (the coset+1/m pass vectorized, two full memory
+    # passes dropped), and the fr_mul_batch / to-mont / from-mont
+    # passes run 8-wide.  Default ON; "0" restores the full scalar
+    # 3-wide unfused path — the byte-parity A/B arm.  The C runtime
+    # re-reads the env per call (csrc ntt_pool_enabled), so flips apply
+    # immediately.
+    "ntt_pool": ("ZKP2P_NTT_POOL", _not_zero, True),
     # proof-batch sub-chunking: "auto" (4 per chunk on a real TPU — the
     # 16 GB HBM budget; whole batch elsewhere), "0" (never chunk), or an
     # explicit chunk size.  r5 bench1 on-chip: the batched h-evals stage
@@ -253,7 +274,7 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 # whitelist, promoted here so there is a single list).
 ARMABLE = (
     "msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap",
-    "msm_multi", "msm_precomp",
+    "msm_multi", "msm_precomp", "matvec_seg", "ntt_pool",
 )
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
@@ -270,6 +291,8 @@ class ProverConfig:
     msm_batch_affine: bool = True
     msm_multi: bool = True
     msm_precomp: bool = True
+    matvec_seg: bool = True
+    ntt_pool: bool = True
     precomp_depth: int = 8
     precomp_max_mb: int = 6144
     precomp_cache: str = ""
