@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-chaos doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -66,6 +66,27 @@ chaos-smoke: native
 # docs/OBSERVABILITY.md §loadgen; ~20 s on the 2-core box.
 loadgen-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_loadgen.py -q
+
+# Fleet smoke (tier-1 resident): the supervised-fleet machinery end to
+# end — drain semantics (SIGTERM mid-batch: in-flight -> done, no new
+# claims, heartbeat keeps held claims out of peer-takeover range, exit
+# codes split clean drain from escalation), supervisor restart/backoff/
+# circuit-breaker/governor, a 2-worker toy fleet with one SIGKILL and
+# one SIGTERM drain under the PR-7 global invariant with /status
+# reachable on both auto-bound metrics ports, and the flock'd
+# one-cold-build-per-key contract across two processes.  The N=3
+# chaos acceptance + the --fleet loadgen scaling arm are the slow tier
+# (`make fleet-chaos`).  See docs/ROBUSTNESS.md §fleet; ~2 min.
+fleet-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_fleet.py -q
+
+# The full fleet acceptance (slow): N=3 supervised workers, seeded
+# faults, worker SIGKILL + worker SIGTERM drain + supervisor
+# kill/restart, plus the `--fleet 2` loadgen arm proving >=1.8x
+# single-worker throughput at the same SLO objective.
+fleet-chaos: native
+	env -u PALLAS_AXON_POOL_IPS ZKP2P_RUN_SLOW=1 python -m pytest \
+	  tests/test_fleet.py -q -k "acceptance or loadgen_fleet"
 
 # Non-MSM floor smoke (fast; tier-1 resident): segmented-matvec byte
 # parity vs the scatter oracle across {threads}x{tier}, pool-NTT and

@@ -387,6 +387,53 @@ def _native_fallback_bench(plat: str) -> bool:
 
             traceback.print_exc(file=sys.stderr)
             log("service arm failed; recording prove tiers only")
+    # Fleet arm (optional, BENCH_FLEET_WORKERS=N): QPS under SLO with N
+    # worker processes under the fleet supervisor — the fleet-scaling
+    # datapoint of ROADMAP item 2.  Toy circuit + artificial per-request
+    # prove time (the arm measures the SERVING layer's scaling, and N
+    # venmo workers would blow the bench budget on N cold starts), so
+    # the number is labeled fleet_circuit=toy and is only comparable to
+    # other fleet arms, never to the venmo tiers above.
+    fleet_n = int(os.environ.get("BENCH_FLEET_WORKERS", "0"))
+    if fleet_n > 0:
+        try:
+            import subprocess
+            import tempfile
+
+            out_path = os.path.join(tempfile.mkdtemp(prefix="bench_fleet_"), "capacity.json")
+            spool = tempfile.mkdtemp(prefix="bench_fleet_spool_")
+            rc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools", "loadgen.py"),
+                    "--spool", spool, "--fleet", str(fleet_n), "--circuit", "toy",
+                    "--rates", os.environ.get("BENCH_FLEET_RATES", "2,4,8"),
+                    "--step-s", os.environ.get("BENCH_FLEET_STEP_S", "6"),
+                    "--prove-s", os.environ.get("BENCH_FLEET_PROVE_S", "0.4"),
+                    "--objective-s", "5", "--out", out_path,
+                ],
+                timeout=600, capture_output=True, text=True,
+            )
+            if rc.returncode != 0:
+                # surface the subprocess's own diagnosis — an opaque
+                # FileNotFoundError on capacity.json explains nothing
+                raise RuntimeError(
+                    f"fleet loadgen exited rc={rc.returncode}: {rc.stderr[-2000:]}"
+                )
+            with open(out_path) as f:
+                fcap = json.load(f)
+            service_rec.update({
+                "fleet_workers": fleet_n,
+                "fleet_circuit": "toy",
+                "fleet_qps_under_slo": fcap["max_sustainable_qps"],
+            })
+            log(f"fleet arm: {fleet_n} workers sustain {fcap['max_sustainable_qps']:g} QPS (toy)")
+            del rc
+        except Exception:  # noqa: BLE001 — optional arm, never sinks the tier
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            log("fleet arm failed; recording without it")
     # stage trace: to the configured JSONL sink (run_id/pid-stamped, with
     # the knob/host manifest — trace_report.py aggregates or diffs it),
     # else stderr as before; the native counter snapshot rides the stderr

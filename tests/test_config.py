@@ -68,6 +68,16 @@ def test_env_overrides_every_knob():
         "ZKP2P_SLO_TARGET": "0.99",
         "ZKP2P_SLO_WINDOW_S": "60",
         "ZKP2P_TS_SAMPLE_S": "2.5",
+        "ZKP2P_WORKER_ID": "w3",
+        "ZKP2P_FLEET_ID": "fleet-abc",
+        "ZKP2P_FLEET_DIR": "/tmp/fleetdir",
+        "ZKP2P_FLEET_WORKERS": "4",
+        "ZKP2P_DRAIN_TIMEOUT_S": "7.5",
+        "ZKP2P_RSS_SOFT_MB": "2048",
+        "ZKP2P_RSS_HARD_MB": "4096",
+        "ZKP2P_BREAKER_K": "3",
+        "ZKP2P_BREAKER_WINDOW_S": "45",
+        "ZKP2P_RESTART_BACKOFF_S": "0.1",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -90,6 +100,12 @@ def test_env_overrides_every_knob():
     assert cfg.prove_retries == 5 and cfg.retry_backoff_s == 0.5
     assert cfg.slo_p95_s == 12.0 and cfg.slo_target == 0.99
     assert cfg.slo_window_s == 60.0 and cfg.ts_sample_s == 2.5
+    assert cfg.worker_id == "w3" and cfg.fleet_id == "fleet-abc"
+    assert cfg.fleet_dir == "/tmp/fleetdir" and cfg.fleet_workers == 4
+    assert cfg.drain_timeout_s == 7.5
+    assert cfg.rss_soft_mb == 2048 and cfg.rss_hard_mb == 4096
+    assert cfg.breaker_k == 3 and cfg.breaker_window_s == 45.0
+    assert cfg.restart_backoff_s == 0.1
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -102,10 +118,21 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_NATIVE_IFMA": "0"}).native_ifma is False
     assert load_config(environ={"ZKP2P_NATIVE_THREADS": ""}).native_threads is None
     assert load_config(environ={"ZKP2P_NATIVE_THREADS": "junk"}).native_threads == 1
-    # metrics port fails CLOSED (no listener) on anything non-portlike
-    assert load_config(environ={"ZKP2P_METRICS_PORT": "0"}).metrics_port is None
+    # metrics port fails CLOSED (no listener) on anything non-portlike;
+    # "auto"/"0" mean EPHEMERAL (bind port 0, record the bound port) so
+    # N fleet workers on one host never collide on a fixed port
+    assert load_config(environ={"ZKP2P_METRICS_PORT": "0"}).metrics_port == 0
+    assert load_config(environ={"ZKP2P_METRICS_PORT": "auto"}).metrics_port == 0
     assert load_config(environ={"ZKP2P_METRICS_PORT": "junk"}).metrics_port is None
     assert load_config(environ={"ZKP2P_METRICS_PORT": "9464"}).metrics_port == 9464
+    assert load_config(environ={"ZKP2P_METRICS_PORT": "99999"}).metrics_port is None
+    # fleet knobs: breaker/backoff clamp like their service siblings
+    assert load_config(environ={"ZKP2P_FLEET_WORKERS": "0"}).fleet_workers == 1
+    assert load_config(environ={"ZKP2P_FLEET_WORKERS": "junk"}).fleet_workers == 2
+    assert load_config(environ={"ZKP2P_DRAIN_TIMEOUT_S": "-1"}).drain_timeout_s == 0.0
+    assert load_config(environ={"ZKP2P_RSS_SOFT_MB": "junk"}).rss_soft_mb == 0
+    assert load_config(environ={"ZKP2P_BREAKER_K": "0"}).breaker_k == 1
+    assert load_config(environ={"ZKP2P_RESTART_BACKOFF_S": "junk"}).restart_backoff_s == 0.5
     # trace ring bound keeps the committed default on malformed input
     assert load_config(environ={"ZKP2P_TRACE_MAX": "junk"}).trace_max == 65536
     # fault-tolerance seconds/count knobs: 0 is meaningful (disabled /

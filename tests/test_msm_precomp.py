@@ -351,7 +351,7 @@ def test_cache_roundtrip_and_warm_start(monkeypatch, toy_dpk, tmp_path):
     cache_dir = os.environ["ZKP2P_MSM_PRECOMP_CACHE"]
     # the cache dir is shared with the matvec segment plans
     # (prover.matvec_plan) — count only the precomp tables here
-    files = sorted(f for f in os.listdir(cache_dir) if f.startswith("precomp_g1_"))
+    files = sorted(f for f in os.listdir(cache_dir) if f.startswith("precomp_g1_") and f.endswith(".npy"))
     assert len(files) == len(man["families"])
     assert man["total_bytes"] > 0
 
@@ -365,7 +365,7 @@ def test_cache_roundtrip_and_warm_start(monkeypatch, toy_dpk, tmp_path):
     assert snap["precomp_build_ns"] == 0, "warm start re-ran the table build"
     man = precomp.precomp_manifest()
     assert all(f["source"] == "cache" for f in man["families"].values())
-    assert sorted(f for f in os.listdir(cache_dir) if f.startswith("precomp_g1_")) == files
+    assert sorted(f for f in os.listdir(cache_dir) if f.startswith("precomp_g1_") and f.endswith(".npy")) == files
 
 
 @pytest.mark.parametrize("level", [0, 1])
@@ -386,8 +386,8 @@ def test_stale_cache_rejected(monkeypatch, toy_dpk, level):
     man = precomp.precomp_manifest()
     cache_dir = os.environ["ZKP2P_MSM_PRECOMP_CACHE"]
     for name in os.listdir(cache_dir):
-        if not name.startswith("precomp_g1_"):
-            continue  # matvec segment plans share this dir
+        if not name.startswith("precomp_g1_") or not name.endswith(".npy"):
+            continue  # matvec segment plans + flock sidecars share this dir
         path = os.path.join(cache_dir, name)
         t = np.load(path)
         fam = name.split("_")[2]
@@ -416,13 +416,13 @@ def test_key_hash_partitions_cache(monkeypatch, toy_dpk):
     monkeypatch.setenv("ZKP2P_MSM_PRECOMP", "1")
     prove_native(dpk, w, r=5, s=7)
     cache_dir = os.environ["ZKP2P_MSM_PRECOMP_CACHE"]
-    first = {f for f in os.listdir(cache_dir) if f.startswith("precomp_g1_")}
+    first = {f for f in os.listdir(cache_dir) if f.startswith("precomp_g1_") and f.endswith(".npy")}
     # a different setup seed = different toxic waste = different bases
     cs2, (out2, x2, y2, z2) = _toy_circuit()
     pk2, _ = setup(cs2, seed="zkp2p-tpu-dev-precomp-b")
     dpk2 = device_pk(pk2, cs2)
     prove_native(dpk2, cs2.witness([225], {x2: 3, y2: 5}), r=5, s=7)
-    second = {f for f in os.listdir(cache_dir) if f.startswith("precomp_g1_")}
+    second = {f for f in os.listdir(cache_dir) if f.startswith("precomp_g1_") and f.endswith(".npy")}
     assert first < second and len(second) == 2 * len(first)
 
 

@@ -74,43 +74,42 @@ def _build_world():
     return cs, dpk, vk, witness_fn
 
 
-def _spool_terminal(spool: str) -> bool:
-    for fn in os.listdir(spool):
-        if not fn.endswith(".req.json"):
-            continue
-        base = os.path.join(spool, fn[: -len(".req.json")])
-        if not any(os.path.exists(base + s) for s in TERMINAL_SUFFIXES):
-            return False
-    return True
-
-
 # -------------------------------------------------------------- worker
 
 
 def worker_main(args) -> int:
+    from zkp2p_tpu.pipeline.fleet import install_drain_handlers, slowed_prover
     from zkp2p_tpu.pipeline.service import ProvingService
     from zkp2p_tpu.prover.native_prove import prove_native_batch
 
     cs, dpk, vk, witness_fn = _build_world()
+    # artificial PER-REQUEST service time (loadgen --fleet smokes: the
+    # toy prove is µs — saturation and mid-prove kill windows need
+    # batches that HOLD claims for a while); fleet.slowed_prover is THE
+    # shared model, so fleet and in-process capacity stay comparable
+    prover_fn = slowed_prover(prove_native_batch, args.prove_s)
     svc = ProvingService(
         cs, dpk, vk, witness_fn, public_fn=lambda w: [w[1]],
         batch_size=args.batch,
-        prover_fn=prove_native_batch,
+        prover_fn=prover_fn,
         stale_claim_s=args.stale_claim_s,
         retry_backoff_s=0.05,
     )
+    # fleet semantics ride the service run loop: SIGTERM/SIGINT drain
+    # (stop claiming, finish in-flight, flush, exit 0), heartbeats +
+    # governor ctl via ZKP2P_FLEET_DIR when a supervisor spawned us
+    install_drain_handlers(svc)
     print(f"[chaos-worker {os.getpid()}] up, sweeping {args.spool}", flush=True)
-    deadline = time.time() + args.max_seconds
-    while time.time() < deadline:
-        stats = svc.process_dir(args.spool)
-        if any(stats.values()):
-            print(f"[chaos-worker {os.getpid()}] {stats}", flush=True)
-        if _spool_terminal(args.spool):
-            print(f"[chaos-worker {os.getpid()}] spool terminal, exiting", flush=True)
-            return 0
-        time.sleep(args.poll_s)
-    print(f"[chaos-worker {os.getpid()}] max-seconds expired", flush=True)
-    return 2
+    why = svc.run(
+        args.spool, poll_s=args.poll_s,
+        max_seconds=args.max_seconds,
+        # --linger: keep sweeping an empty/terminal spool (loadgen fleet
+        # workers outlive the ramp); default chaos workers exit once
+        # every request is terminal
+        exit_when_spool_terminal=not args.linger,
+    )
+    print(f"[chaos-worker {os.getpid()}] exiting ({why})", flush=True)
+    return 0 if why in ("drained", "terminal") else 2
 
 
 # ----------------------------------------------------------- invariant
@@ -286,6 +285,196 @@ def run_chaos(args) -> dict:
     return report
 
 
+# --------------------------------------------------------------- fleet
+
+
+def _fleet_pids(fleet_dir: str) -> dict:
+    """worker id -> pid, from the supervisor's status.json (written per
+    tick, so pids are visible the moment workers spawn — heartbeats
+    only land once a worker finishes its first sweep) with the
+    heartbeat files as fallback."""
+    pids = {}
+    try:
+        with open(os.path.join(fleet_dir, "status.json")) as f:
+            status = json.load(f)
+        for wid, w in status.get("workers", {}).items():
+            if w.get("pid"):
+                pids[wid] = int(w["pid"])
+    except (OSError, ValueError):
+        pass
+    try:
+        names = os.listdir(fleet_dir)
+    except OSError:
+        return pids
+    for fn in names:
+        if not fn.endswith(".hb"):
+            continue
+        try:
+            with open(os.path.join(fleet_dir, fn)) as f:
+                hb = json.load(f)
+            if hb.get("pid"):
+                pids.setdefault(fn[:-3], int(hb["pid"]))
+        except (OSError, ValueError):
+            continue
+    return pids
+
+
+def _live_claims(spool: str) -> list:
+    """[(rid, owner_pid)] for every live .claim file."""
+    out = []
+    for fn in os.listdir(spool):
+        if fn.endswith(".claim"):
+            try:
+                with open(os.path.join(spool, fn)) as f:
+                    pid = json.load(f).get("pid")
+                if pid:
+                    out.append((fn[: -len(".claim")], int(pid)))
+            except (OSError, ValueError):
+                continue
+    return out
+
+
+def run_fleet_chaos(args) -> dict:
+    """Fleet-scale chaos (the ISSUE-10 acceptance shape): a SUPERVISED
+    fleet of N workers on one spool, faults armed in every worker, then
+
+      1. SIGKILL a worker that provably owns in-flight work (the
+         supervisor must restart it with backoff, not flap);
+      2. SIGTERM-drain another claim-owning worker (its in-flight
+         requests must terminal `done` — drain finishes what it owns —
+         and the supervisor must count the clean exit, not restart it);
+      3. SIGKILL the supervisor itself mid-run, then start a
+         replacement on the same spool (the supervisor holds no request
+         state: orphaned workers keep sweeping, the new supervisor's
+         workers join them, claims arbitrate).
+
+    Then the PR-7 global invariant is asserted over the spool, plus the
+    drain contract: every request the drained worker held at SIGTERM
+    time has a .proof.json (terminal `done`, not deferred/stolen)."""
+    import random
+
+    os.makedirs(args.spool, exist_ok=True)
+    rng = random.Random(args.seed)
+    for i in range(args.requests):
+        with open(os.path.join(args.spool, f"q{i:03d}.req.json"), "w") as f:
+            json.dump({"x": rng.randrange(2, 50), "y": rng.randrange(2, 50)}, f)
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ZKP2P_FAULTS"] = args.faults
+    env.pop("ZKP2P_METRICS_SINK", None)  # per-spool sink = the shared record file
+    env.setdefault("ZKP2P_METRICS_PORT", "auto")  # N workers: ephemeral ports
+    worker_argv = [
+        sys.executable, os.path.abspath(__file__), "--worker",
+        "--spool", args.spool,
+        "--batch", str(args.batch),
+        "--stale-claim-s", str(args.stale_claim_s),
+        "--max-seconds", str(args.max_seconds),
+        "--poll-s", str(args.poll_s),
+        "--prove-s", str(args.prove_s),
+    ]
+
+    def sup_cmd(fleet_dir: str) -> list:
+        return [
+            sys.executable, "-m", "zkp2p_tpu", "fleet",
+            "--spool", args.spool,
+            "--workers", str(args.fleet),
+            "--fleet-dir", fleet_dir,
+            "--drain-timeout-s", str(max(4 * args.prove_s, 15.0)),
+            "--restart-backoff-s", "0.2",
+            "--liveness-s", "60",
+            "--max-seconds", str(args.max_seconds + 30.0),
+            "--worker-cmd", json.dumps(worker_argv),
+        ]
+
+    fleet_dir = os.path.join(args.spool, ".fleet1")
+    sup = subprocess.Popen(sup_cmd(fleet_dir), env=env, cwd=REPO)
+    print(f"[chaos] fleet supervisor up (pid {sup.pid}, {args.fleet} workers)", flush=True)
+    deadline = time.time() + args.max_seconds
+
+    def kill_claim_owner(sig, exclude: set) -> tuple:
+        """Deliver `sig` to a fleet worker that owns >=1 live claim;
+        returns (pid, [the rids it held]).  The pid comes from
+        status.json/claim files, which can lag reality (a worker that
+        crashed on an injected fault leaves claims behind, and the
+        supervisor keeps its last pid visible through the backoff
+        window) — a pid that is gone by the time the signal lands is
+        excluded and the hunt continues, never a harness crash."""
+        excl = set(exclude)
+        while time.time() < deadline:
+            pids = set(_fleet_pids(fleet_dir).values()) - excl
+            claims = _live_claims(args.spool)
+            for rid, pid in claims:
+                if pid in pids:
+                    held = sorted(r for r, p in claims if p == pid)
+                    try:
+                        os.kill(pid, sig)
+                    except (ProcessLookupError, PermissionError):
+                        excl.add(pid)  # died between discovery and signal
+                        continue
+                    return pid, held
+            time.sleep(0.02)
+        return None, []
+
+    # phase 1: SIGKILL a worker that provably owns in-flight work
+    killed_pid, _ = kill_claim_owner(signal.SIGKILL, set())
+    if killed_pid is not None:
+        print(f"[chaos] SIGKILL worker {killed_pid} (owned a live claim)", flush=True)
+
+    # phase 2: SIGTERM-drain a DIFFERENT claim-owning worker; remember
+    # exactly what it held — the drain contract is judged on those rids
+    drained_pid, drained_claims = kill_claim_owner(
+        signal.SIGTERM, {killed_pid} if killed_pid else set()
+    )
+    if drained_pid is not None:
+        print(
+            f"[chaos] SIGTERM worker {drained_pid} (drains {len(drained_claims)} "
+            f"held claim(s): {drained_claims})", flush=True,
+        )
+
+    # phase 3: kill the supervisor mid-run, start a replacement
+    supervisor_rcs = []
+    if args.supervisor_kill and sup.poll() is None:
+        sup.send_signal(signal.SIGKILL)
+        supervisor_rcs.append(sup.wait())
+        print("[chaos] SIGKILL supervisor; starting replacement", flush=True)
+        fleet_dir2 = os.path.join(args.spool, ".fleet2")
+        sup = subprocess.Popen(sup_cmd(fleet_dir2), env=env, cwd=REPO)
+
+    try:
+        supervisor_rcs.append(sup.wait(timeout=args.max_seconds + 60.0))
+    except subprocess.TimeoutExpired:
+        sup.kill()
+        supervisor_rcs.append("timeout")
+
+    report = check_invariants(args.spool)
+    report.update({
+        "fleet": args.fleet,
+        "killed_worker": killed_pid,
+        "drained_worker": drained_pid,
+        "drained_claims": drained_claims,
+        "supervisor_rcs": supervisor_rcs,
+        "faults": args.faults,
+    })
+    if killed_pid is None:
+        report["violations"].append("harness: no mid-prove worker SIGKILL landed")
+    if drained_pid is None:
+        report["violations"].append("harness: no claim-owning worker was SIGTERM-drained")
+    # the drain contract: everything the drained worker held at SIGTERM
+    # time finished as `done` — not error, not stolen-and-deferred
+    for rid in drained_claims:
+        if not os.path.exists(os.path.join(args.spool, rid + ".proof.json")):
+            report["violations"].append(
+                f"{rid}: held by the drained worker but did not terminal done"
+            )
+    if supervisor_rcs and supervisor_rcs[-1] != 0:
+        report["violations"].append(
+            f"harness: final supervisor exited rc={supervisor_rcs[-1]} (want 0 = clean)"
+        )
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
@@ -299,6 +488,19 @@ def main(argv=None) -> int:
                     help="claim staleness for takeover; heartbeats keep live claims fresh")
     ap.add_argument("--max-seconds", type=float, default=90.0)
     ap.add_argument("--poll-s", type=float, default=0.05)
+    ap.add_argument("--prove-s", type=float, default=0.0,
+                    help="artificial PER-REQUEST prove time, scaled by batch fill "
+                         "(fleet kill windows / loadgen saturation)")
+    ap.add_argument("--linger", action="store_true",
+                    help="worker: keep sweeping after the spool goes terminal (loadgen fleet workers)")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="fleet-scale chaos: run N workers under the `zkp2p-tpu fleet` "
+                         "supervisor (SIGKILL a worker, SIGTERM-drain a worker, kill + "
+                         "restart the supervisor) instead of bare Popen workers")
+    ap.add_argument("--supervisor-kill", action="store_true", default=None,
+                    help="fleet mode: SIGKILL the supervisor mid-run and start a "
+                         "replacement (default on in fleet mode; --no-supervisor-kill disables)")
+    ap.add_argument("--no-supervisor-kill", dest="supervisor_kill", action="store_false")
     ap.add_argument(
         "--faults",
         default="seed=7,witness:hang=0.2,prove:raise:p=0.2,emit:enospc:once,claim:raise:p=0.05",
@@ -310,7 +512,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.worker:
         return worker_main(args)
-    report = run_chaos(args)
+    if args.supervisor_kill is None:
+        args.supervisor_kill = bool(args.fleet)
+    report = run_fleet_chaos(args) if args.fleet else run_chaos(args)
     print(json.dumps(report, indent=1, default=str))
     if args.report:
         with open(args.report, "w") as f:
@@ -318,8 +522,9 @@ def main(argv=None) -> int:
     if report["violations"]:
         print(f"[chaos] INVARIANT VIOLATED: {report['violations']}", file=sys.stderr)
         return 1
+    kills = report.get("kills", 1 if report.get("killed_worker") else 0)
     print(f"[chaos] invariant holds: {report['requests']} requests, "
-          f"{report['proofs_verified']} proofs verified, {report['kills']} kills", flush=True)
+          f"{report['proofs_verified']} proofs verified, {kills} kills", flush=True)
     return 0
 
 

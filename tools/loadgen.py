@@ -16,8 +16,9 @@ this host shape.
 
   --circuit toy    hermetic 2-constraint circuit (the chaos-harness
                    world) — a stub-speed prover for smokes; --prove-s
-                   adds artificial per-batch service time so saturation
-                   is reachable in a 2-second test.
+                   adds artificial per-request service time (scaled by
+                   batch fill, in-process and --fleet alike) so
+                   saturation is reachable in a 2-second test.
   --circuit venmo  the bench-shape 499k-constraint flagship: one
                    synthetic signed email's witness is built once and
                    replayed per request (witnessing is not what this
@@ -135,14 +136,18 @@ def run_capacity(
     run_service: bool = True,
     circuit: str = "?",
     prove_sleep_s: float = 0.0,
+    fleet_workers: int = 0,
     log: Callable[[str], None] = lambda m: print(m, file=sys.stderr, flush=True),
 ) -> Dict:
     """Drive the ramp and score it; returns the capacity report dict.
 
     svc: a ProvingService (swept in-process when run_service) — pass
     None with run_service=False to only generate load for an external
-    worker.  prove_sleep_s: artificial per-batch service time added
-    around the prover (smoke-scale saturation)."""
+    worker.  prove_sleep_s: artificial PER-REQUEST service time added
+    around the prover, scaled by batch fill — the same model the
+    --fleet toy workers apply, so in-process and fleet capacity
+    numbers share one service-time definition (smoke-scale
+    saturation)."""
     from zkp2p_tpu.pipeline.service import TimeseriesSampler
     from zkp2p_tpu.utils.audit import execution_digest
     from zkp2p_tpu.utils.config import load_config
@@ -187,15 +192,13 @@ def run_capacity(
             payload_fn = lambda r: {"x": r.randrange(2, 50), "y": r.randrange(2, 50)}  # noqa: E731
 
         if prove_sleep_s > 0 and svc is not None and svc.prover_fn is not None:
-            inner = svc.prover_fn
+            # fleet.slowed_prover is THE shared artificial-service-time
+            # model (per request, scaled by fill) — the chaos/fleet toy
+            # workers wrap with the same helper, so the in-process and
+            # --fleet capacity numbers stay comparable by construction
+            from zkp2p_tpu.pipeline.fleet import slowed_prover
 
-            def slowed(dpk, wits):
-                time.sleep(prove_sleep_s)
-                return inner(dpk, wits)
-
-            # keep the knob-reader marker: the degradation ladder checks it
-            slowed.reads_msm_knobs = getattr(inner, "reads_msm_knobs", False)
-            svc.prover_fn = slowed
+            svc.prover_fn = slowed_prover(svc.prover_fn, prove_sleep_s)
 
         stop = threading.Event()
         worker_errors: List[str] = []
@@ -318,6 +321,12 @@ def run_capacity(
             # re-run lower), reported honestly rather than extrapolated.
             "max_sustainable_qps": max(passing) if passing else 0.0,
         }
+        if fleet_workers:
+            # the serving side was an N-worker fleet (external processes
+            # under the `zkp2p-tpu fleet` supervisor), not the
+            # in-process service — capacity numbers at different N are
+            # not comparable without this field
+            report["fleet_workers"] = fleet_workers
         if worker_errors:
             report["worker_errors"] = worker_errors[:3]
         # service-observability counters snapshot for the record
@@ -356,11 +365,16 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=4, help="service batch size")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--prove-s", type=float, default=0.0,
-                    help="artificial per-batch prove time (smoke-scale saturation)")
+                    help="artificial PER-REQUEST prove time, scaled by batch fill "
+                         "(smoke-scale saturation; same model in-process and --fleet)")
     ap.add_argument("--drain-s", type=float, default=None,
                     help="max wait for in-flight work after the ramp (default 2*step)")
     ap.add_argument("--no-service", action="store_true",
                     help="only generate load; an external worker sweeps the spool")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="serve the ramp with N toy workers under the `zkp2p-tpu fleet` "
+                         "supervisor (subprocesses) instead of the in-process service — "
+                         "the fleet-scaling arm of the capacity model (toy circuit only)")
     ap.add_argument("--out", default="", help="also write the capacity JSON to this path")
     args = ap.parse_args(argv)
 
@@ -385,10 +399,74 @@ def main(argv=None) -> int:
         print(f"[loadgen] bad --target {target!r}: need a fraction in (0,1)", file=sys.stderr)
         return 2
 
+    if args.fleet and args.circuit != "toy":
+        print("[loadgen] --fleet serves the toy circuit only (each worker is a "
+              "fresh process; venmo workers would each rebuild the 499k key)", file=sys.stderr)
+        return 2
+
     svc = None
     payload_fn = None
     circuit = args.circuit
-    if not args.no_service:
+    fleet_proc = None
+    if args.fleet:
+        # N subprocess workers under the fleet supervisor sweep the
+        # spool; this process only generates + scores (the external-
+        # worker mode of run_capacity).  Workers linger past spool-
+        # terminal — the ramp writes continuously — and drain on the
+        # supervisor's SIGTERM at the end.
+        import signal as _signal
+        import subprocess
+
+        os.makedirs(args.spool, exist_ok=True)
+        # per-RUN fleet dir: a reused spool's previous .fleet would
+        # satisfy the readiness gate below with STALE heartbeats before
+        # the supervisor even starts, billing N cold starts as queue
+        # latency — the exact artifact the gate exists to prevent
+        fleet_dir = os.path.join(args.spool, f".fleet-{os.getpid():x}{int(time.time()) & 0xFFFF:04x}")
+        worker_argv = [
+            sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "chaos.py"),
+            "--worker", "--linger",
+            "--spool", args.spool,
+            "--batch", str(args.batch),
+            "--prove-s", str(args.prove_s),
+            "--max-seconds", "100000",
+            "--poll-s", "0.05",
+        ]
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        fleet_proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "zkp2p_tpu", "fleet",
+                "--spool", args.spool,
+                "--workers", str(args.fleet),
+                "--fleet-dir", fleet_dir,
+                "--worker-cmd", json.dumps(worker_argv),
+            ],
+            env=env, cwd=REPO,
+        )
+        # readiness gate: score only once every worker heartbeats —
+        # otherwise step 0 pays N cold python/jax imports and reports
+        # them as queue latency
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            try:
+                ups = [f for f in os.listdir(fleet_dir) if f.endswith(".hb")]
+            except OSError:
+                ups = []
+            if len(ups) >= args.fleet:
+                break
+            if fleet_proc.poll() is not None:
+                print("[loadgen] fleet supervisor died before the ramp", file=sys.stderr)
+                return 2
+            time.sleep(0.1)
+        else:
+            fleet_proc.kill()
+            print("[loadgen] fleet workers never became ready", file=sys.stderr)
+            return 2
+        print(f"[loadgen] fleet ready: {args.fleet} workers heartbeating", file=sys.stderr)
+    elif not args.no_service:
         world = _toy_world() if args.circuit == "toy" else _venmo_world()
         cs, dpk, vk, witness_fn, public_fn, payload_fn, circuit = world
         svc = ProvingService(
@@ -401,12 +479,22 @@ def main(argv=None) -> int:
                   log=lambda m: print(f"[loadgen] {m}", file=sys.stderr, flush=True))
         maybe_start_metrics_server()
 
-    report = run_capacity(
-        svc, args.spool, rates, args.step_s, objective_s, target=target,
-        payload_fn=payload_fn, seed=args.seed, drain_s=args.drain_s,
-        run_service=not args.no_service, circuit=circuit,
-        prove_sleep_s=args.prove_s,
-    )
+    try:
+        report = run_capacity(
+            svc, args.spool, rates, args.step_s, objective_s, target=target,
+            payload_fn=payload_fn, seed=args.seed, drain_s=args.drain_s,
+            run_service=not args.no_service and not args.fleet, circuit=circuit,
+            prove_sleep_s=args.prove_s, fleet_workers=args.fleet,
+        )
+    finally:
+        if fleet_proc is not None and fleet_proc.poll() is None:
+            # graceful fleet teardown: SIGTERM fans drain out to the
+            # workers; the supervisor escalates stragglers itself
+            fleet_proc.send_signal(_signal.SIGTERM)
+            try:
+                fleet_proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                fleet_proc.kill()
     print(json.dumps(report, indent=1))
     if args.out:
         with open(args.out, "w") as f:

@@ -262,9 +262,19 @@ def chrome_trace(requests: List[dict], run: Optional[str] = None) -> dict:
         pid = int(r.get("pid") or 0)
         if pid not in seen_pids:
             seen_pids.add(pid)
+            # fleet attribution: records stamped with a worker id (and
+            # fleet id) name the row by WORKER — pids recycle across
+            # supervisor restarts, worker ids don't, so "w1 pid 123" and
+            # "w1 pid 456" read as one worker's two incarnations
+            wname = r.get("worker")
+            fname = r.get("fleet")
+            label = (
+                f"zkp2p {wname}" + (f"@{fname}" if fname else "") + f" (pid {pid})"
+                if wname else f"zkp2p worker {pid}"
+            )
             events.append({
                 "ph": "M", "name": "process_name", "pid": pid,
-                "args": {"name": f"zkp2p worker {pid}"},
+                "args": {"name": label},
             })
         tid = tid_for(pid, r["request_id"])
         t_submit, t_claim = r.get("t_submit"), r.get("t_claim")

@@ -389,8 +389,100 @@ def cmd_service(args):
             cs, dpk, vk, witness_fn, lambda w: list(w[1 : cs.num_public + 1]), **svc_kw
         )
     os.makedirs(args.spool, exist_ok=True)
+    # graceful drain (docs/ROBUSTNESS.md §fleet): SIGTERM/SIGINT stop
+    # claiming, finish in-flight batches, flush sinks, exit 0 — so a
+    # fleet restart (or a plain ^C) loses zero requests
+    from ..pipeline.fleet import install_drain_handlers
+
+    install_drain_handlers(svc)
     _log(f"service sweeping {args.spool} (batch={args.batch})")
-    svc.run(args.spool, poll_s=args.poll, max_sweeps=args.max_sweeps)
+    why = svc.run(
+        args.spool, poll_s=args.poll, max_sweeps=args.max_sweeps,
+        max_seconds=args.max_seconds,
+        exit_when_spool_terminal=args.exit_when_terminal,
+    )
+    # exit-code contract (the supervisor and init systems key off it):
+    # 0 = clean (drained / spool terminal / sweeps done), 2 = timeout
+    sys.exit(0 if why in ("drained", "terminal", "sweeps") else 2)
+
+
+def cmd_fleet(args):
+    """Supervise N `service` workers on one spool (pipeline.fleet):
+    restart with exponential backoff + crash-loop circuit breaker,
+    graceful drain on SIGTERM/SIGINT with bounded SIGKILL escalation,
+    per-worker RSS governor, heartbeat watchdog, and a status.json +
+    per-worker auto metrics ports for scrape discovery.  Exit codes:
+    0 clean, 3 drain escalated, 4 every worker parked."""
+    import json as _json
+
+    from ..pipeline.fleet import FleetSupervisor
+
+    os.makedirs(args.spool, exist_ok=True)
+    if args.worker_cmd:
+        # advanced/chaos arm: the operator supplies the worker argv
+        # (JSON list; '{wid}'/'{spool}' substitute per worker)
+        template = _json.loads(args.worker_cmd)
+        if not isinstance(template, list) or not template:
+            raise SystemExit("--worker-cmd must be a non-empty JSON argv list")
+
+        def worker_cmd(wid):
+            return [str(t).replace("{wid}", wid).replace("{spool}", args.spool) for t in template]
+    else:
+        # default: this same CLI's `service` subcommand, one process per
+        # worker, sharing the spool (the claim files arbitrate) and the
+        # build dir's key material (the flock'd precomp/plan sidecars
+        # serialize the cold builds to ONE across the fleet)
+        base = [
+            sys.executable, "-m", "zkp2p_tpu",
+            "--build-dir", args.build_dir,
+            "--circuit", args.circuit,
+            "--max-header", str(args.max_header),
+            "--max-body", str(args.max_body),
+            "service",
+            "--spool", args.spool,
+            "--batch", str(args.batch),
+            "--poll", str(args.poll),
+            "--prover", args.prover,
+            "--prefetch", str(args.prefetch),
+            "--stale-claim-s", str(args.stale_claim_s),
+        ]
+        if args.zkey:
+            base += ["--zkey", args.zkey]
+        if args.no_infer_widths:
+            base += ["--no-infer-widths"]
+        for flag, v in (
+            ("--deadline-s", args.deadline_s), ("--spool-cap", args.spool_cap),
+            ("--slo-p95-s", args.slo_p95_s), ("--ts-sample-s", args.ts_sample_s),
+        ):
+            if v is not None:
+                base += [flag, str(v)]
+
+        def worker_cmd(_wid):
+            return list(base)
+
+    sup = FleetSupervisor(
+        args.spool, worker_cmd,
+        workers=args.workers,
+        fleet_dir=args.fleet_dir,
+        drain_timeout_s=args.drain_timeout_s,
+        breaker_k=args.breaker_k,
+        breaker_window_s=args.breaker_window_s,
+        restart_backoff_s=args.restart_backoff_s,
+        rss_soft_mb=args.rss_soft_mb,
+        rss_hard_mb=args.rss_hard_mb,
+        liveness_s=args.liveness_s,
+        log=lambda m: _log(f"fleet: {m}"),
+    )
+    # the supervisor's own exposition (fleet gauges/counters) — workers
+    # get auto ports regardless (FleetSupervisor rewrites the env)
+    from ..utils.metrics import maybe_start_metrics_server
+
+    maybe_start_metrics_server()
+    _log(
+        f"fleet {sup.fleet_id}: {sup.n} worker(s) on {args.spool} "
+        f"(fleet dir {sup.fleet_dir}, drain timeout {sup.drain_timeout_s:g}s)"
+    )
+    sys.exit(sup.run(max_seconds=args.max_seconds))
 
 
 def cmd_serve(args):
@@ -565,7 +657,56 @@ def main(argv=None):
     s.add_argument("--ts-sample-s", type=float, default=None,
                    help="time-series sampler interval in s "
                         "(default: ZKP2P_TS_SAMPLE_S; 0 = off)")
+    s.add_argument("--max-seconds", type=float, default=None,
+                   help="exit (rc 2) after this many seconds (tests/fleet smokes)")
+    s.add_argument("--exit-when-terminal", action="store_true",
+                   help="exit 0 once every spool request has a terminal artifact")
     s.set_defaults(fn=cmd_service)
+
+    s = sub.add_parser(
+        "fleet",
+        help="supervise N service workers on one spool (restart/backoff/"
+             "circuit-breaker, graceful drain, RSS governor)",
+    )
+    s.add_argument("--spool", required=True)
+    s.add_argument("--workers", type=int, default=None,
+                   help="worker count (default: ZKP2P_FLEET_WORKERS)")
+    s.add_argument("--batch", type=int, default=4)
+    s.add_argument("--poll", type=float, default=1.0)
+    s.add_argument("--zkey", help="zkey path or chunk glob")
+    s.add_argument("--no-infer-widths", action="store_true",
+                   help="disable the zkey bit-constraint width inference")
+    s.add_argument("--prover", choices=["tpu", "native"], default="native",
+                   help="worker prover arm (native = multi-column C batch path)")
+    s.add_argument("--prefetch", type=int, default=1)
+    s.add_argument("--stale-claim-s", type=float, default=300.0)
+    s.add_argument("--deadline-s", type=float, default=None)
+    s.add_argument("--spool-cap", type=int, default=None)
+    s.add_argument("--slo-p95-s", type=float, default=None)
+    s.add_argument("--ts-sample-s", type=float, default=None)
+    s.add_argument("--fleet-dir", default=None,
+                   help="heartbeat/ctl/status dir (default: <spool>/.fleet)")
+    s.add_argument("--drain-timeout-s", type=float, default=None,
+                   help="bounded wait between SIGTERM and SIGKILL escalation "
+                        "(default: ZKP2P_DRAIN_TIMEOUT_S)")
+    s.add_argument("--liveness-s", type=float, default=60.0,
+                   help="heartbeat age past which a live worker counts as hung")
+    s.add_argument("--rss-soft-mb", type=int, default=None,
+                   help="per-worker RSS soft budget: degrade ctl (default: ZKP2P_RSS_SOFT_MB; 0 = off)")
+    s.add_argument("--rss-hard-mb", type=int, default=None,
+                   help="per-worker RSS hard budget: drain + restart (default: ZKP2P_RSS_HARD_MB; 0 = off)")
+    s.add_argument("--breaker-k", type=int, default=None,
+                   help="failures inside the window that park a worker (default: ZKP2P_BREAKER_K)")
+    s.add_argument("--breaker-window-s", type=float, default=None,
+                   help="circuit-breaker window (default: ZKP2P_BREAKER_WINDOW_S)")
+    s.add_argument("--restart-backoff-s", type=float, default=None,
+                   help="exponential restart-backoff base (default: ZKP2P_RESTART_BACKOFF_S)")
+    s.add_argument("--max-seconds", type=float, default=None,
+                   help="drain + exit after this long (tests/chaos)")
+    s.add_argument("--worker-cmd", default=None,
+                   help="JSON argv for each worker (advanced/chaos; '{wid}' and "
+                        "'{spool}' substitute) — default spawns 'zkp2p-tpu service' workers")
+    s.set_defaults(fn=cmd_fleet)
 
     s = sub.add_parser("serve", help="serve the client order-book UI")
     s.add_argument("--port", type=int, default=8080)

@@ -140,6 +140,23 @@ def _lifespan(reqs, name: str, **attrs):
             r.spans.append(dict(rec))
 
 
+def spool_terminal(spool: str) -> bool:
+    """True when every request in `spool` has a terminal artifact —
+    the exit condition chaos/fleet/loadgen workers share (an unreadable
+    spool reads as not-terminal: keep sweeping, don't die)."""
+    try:
+        names = set(os.listdir(spool))
+    except OSError:
+        return False
+    for fn in names:
+        if not fn.endswith(".req.json"):
+            continue
+        base = fn[: -len(".req.json")]
+        if base + ".proof.json" not in names and base + ".error.json" not in names:
+            return False
+    return True
+
+
 def _is_transient(exc: BaseException) -> bool:
     """Transient = retry may genuinely succeed: injected faults (their
     whole point), allocation pressure, and the exhaustion slice of the
@@ -224,6 +241,16 @@ class TimeseriesSampler:
         self.batch_fill_last = 0
         self._last_ts: Optional[float] = None
         self._last_native: Dict = {}
+        # fleet attribution on every line (same contract as the request
+        # records): resolved once — identity cannot change under a
+        # running sampler
+        try:
+            from ..utils.config import load_config
+
+            cfg = load_config()
+            self._worker_id, self._fleet_id = cfg.worker_id, cfg.fleet_id
+        except Exception:  # noqa: BLE001 — observation only
+            self._worker_id = self._fleet_id = ""
 
     def _scan(self, spool: str, now: float, window_s: float) -> Dict:
         arrivals = backlog = claimable = in_flight = 0
@@ -286,6 +313,10 @@ class TimeseriesSampler:
                 "batch_fill_last": self.batch_fill_last,
                 **scan,
             }
+            if self._worker_id:
+                rec["worker"] = self._worker_id
+            if self._fleet_id:
+                rec["fleet"] = self._fleet_id
             # cumulative service counters out of the registry (post-hoc
             # analysis diffs consecutive lines for rates)
             counters: Dict[str, float] = {}
@@ -413,6 +444,28 @@ class ProvingService:
         # time-series sampler (run() installs one when ZKP2P_TS_SAMPLE_S
         # > 0; process_dir works standalone without it)
         self._sampler: Optional["TimeseriesSampler"] = None
+        # graceful drain (docs/ROBUSTNESS.md §fleet): once set, the
+        # producer claims NO new requests — in-flight batches (already
+        # claimed, possibly queued in ready_q) still prove, verify, and
+        # emit to their terminal states under the sweep heartbeat, so a
+        # SIGTERM'd worker finishes what it owns and strands nothing.
+        # run() exits after the draining sweep completes.
+        self._drain = threading.Event()
+        # fleet identity (ZKP2P_WORKER_ID / ZKP2P_FLEET_ID, stamped by
+        # the supervisor into the worker env) — resolved with the policy
+        # knobs, stamped on every record + time-series line
+        self._worker_id = ""
+        self._fleet_id = ""
+
+    def request_drain(self) -> None:
+        """Flip the drain flag: stop claiming, finish in-flight work,
+        then exit run().  Idempotent; callable from signal handlers
+        (Event.set is async-signal-safe enough for CPython)."""
+        self._drain.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._drain.is_set()
 
     def _resolve_policy(self) -> None:
         """Fill constructor-None policy knobs from the typed config,
@@ -428,6 +481,8 @@ class ProvingService:
         self._retry_backoff_s = (
             self.retry_backoff_s if self.retry_backoff_s is not None else cfg.retry_backoff_s
         )
+        self._worker_id = cfg.worker_id
+        self._fleet_id = cfg.fleet_id
         self._resolved = True
 
     # -------------------------------------------------------- observability
@@ -479,6 +534,13 @@ class ProvingService:
                 # when their digests match — see docs/OBSERVABILITY.md
                 "execution_digest": execution_digest(),
             }
+            # fleet attribution: which worker of which fleet produced
+            # this record — pids recycle across restarts, worker ids
+            # don't, so trace_report groups waterfall rows by worker
+            if self._worker_id:
+                rec["worker"] = self._worker_id
+            if self._fleet_id:
+                rec["fleet"] = self._fleet_id
             # batched-prove attribution: which slot of which batch this
             # request rode, so trace_report can split a batch's prove
             # latency across its requests (a batch=4 multi-column prove
@@ -932,6 +994,10 @@ class ProvingService:
         <name>.proof.json / <name>.error.json out."""
         self._resolve_policy()
         stats = {s: 0 for s in TERMINAL_STATES}
+        # draining before the sweep even starts: claim nothing, scan
+        # nothing — the spool belongs to the peers now
+        if self._drain.is_set():
+            return stats
         # knob manifest stamped on every request record (the acceptance
         # contract: a record is attributable without joining against a
         # separate manifest line) — resolved once per process, not per
@@ -1008,7 +1074,10 @@ class ProvingService:
         # already aged in the spool), each with a visible error-shed
         # terminal + counter, instead of silently aging until every
         # deadline in the queue is dead on arrival.
-        if self._spool_cap and len(pending) > self._spool_cap:
+        # (never shed while draining: this worker is leaving — terminal-
+        # erroring backlog a surviving peer could serve would turn a
+        # routine restart into dropped requests)
+        if self._spool_cap and len(pending) > self._spool_cap and not self._drain.is_set():
             backlog = len(pending)
             pending.sort(key=lambda r: (r.t_submit, r.rid))
             keep, shed = pending[: self._spool_cap], pending[self._spool_cap:]
@@ -1131,6 +1200,15 @@ class ProvingService:
         def produce():
             try:
                 for i in range(0, len(pending), self.batch_size):
+                    # Drain gate: once the flag is up, claim NOTHING
+                    # more.  Checked per batch, before any claim — the
+                    # batches already claimed (proving now, or queued in
+                    # ready_q) finish to terminal under the heartbeat;
+                    # everything unclaimed stays free for peers, so a
+                    # fleet restart loses zero requests and duplicates
+                    # zero proofs (docs/ROBUSTNESS.md §fleet).
+                    if self._drain.is_set():
+                        break
                     # Claim at DEQUEUE, not at scan: a long sweep must
                     # not hold scan-time claims that go stale while
                     # earlier batches prove (peer takeover would then
@@ -1294,7 +1372,21 @@ class ProvingService:
         kw.setdefault("inputs_fn", inputs_fn)
         return cls(cs, dpk, vk, witness_fn, public_fn, **kw)
 
-    def run(self, spool: str, poll_s: float = 1.0, max_sweeps: Optional[int] = None) -> None:
+    def run(
+        self,
+        spool: str,
+        poll_s: float = 1.0,
+        max_sweeps: Optional[int] = None,
+        max_seconds: Optional[float] = None,
+        exit_when_spool_terminal: bool = False,
+    ) -> str:
+        """Sweep `spool` until drained / exhausted; returns WHY the loop
+        ended — "drained" (request_drain / SIGTERM: in-flight work
+        finished, claims all released, sinks flushed), "terminal"
+        (exit_when_spool_terminal and every request reached a terminal
+        state — chaos/fleet workers), "sweeps" (max_sweeps), or
+        "timeout" (max_seconds) — so callers can map a clean drain to a
+        clean exit code."""
         # Prometheus exposition (ZKP2P_METRICS_PORT, default off) — the
         # scrape sees stage histograms, request-state counters, and a
         # scrape-time native counter refresh.
@@ -1328,9 +1420,52 @@ class ProvingService:
 
         slo_arm()
         timeseries_arm()
+        # fleet membership gate: "worker" when the supervisor stamped an
+        # identity into our env, else "off" — a fleet member and a solo
+        # service are digest-distinguishable code paths (the ONE
+        # resolver preflight also calls; a divergent inline copy could
+        # split run()'s digest from doctor's)
+        from .fleet import fleet_member_arm
+
+        self._resolve_policy()
+        fleet_member_arm()
+        fleet_dir = load_config().fleet_dir or None
         self._sampler = TimeseriesSampler(load_config().ts_sample_s, self.stale_claim_s)
+
+        def _flush():
+            rid, pid = run_id(), os.getpid()
+            spans = [
+                {"type": "stage", "run_id": rid, "pid": pid, **r} for r in drain_trace()
+            ]
+            try:
+                self._sink(spool).write_many(spans)
+            except Exception:  # noqa: BLE001 — observation only
+                pass
+            publish_native_stats()
+
+        # first heartbeat BEFORE the first sweep (the supervisor's
+        # watchdog needs a liveness baseline while the worker is still
+        # inside a long first sweep) plus a BACKGROUND heartbeat thread:
+        # a single sweep can legitimately run minutes (cold precomp
+        # build; flock losers block for the winner's whole build), and
+        # a sweep-cadence heartbeat alone would read as a hang — the
+        # watchdog would SIGKILL a healthy cold start mid-build forever
+        hb_stop = None
+        if fleet_dir:
+            try:
+                from .fleet import start_heartbeat_thread, worker_tick
+
+                worker_tick(self, fleet_dir)
+                hb_stop = start_heartbeat_thread(self, fleet_dir)
+            except Exception:  # noqa: BLE001
+                pass
+        deadline = (time.time() + max_seconds) if max_seconds else None
         sweeps = 0
+        why = "sweeps"
         while max_sweeps is None or sweeps < max_sweeps:
+            if deadline is not None and time.time() > deadline:
+                why = "timeout"
+                break
             stats = self.process_dir(spool)
             if any(stats.values()):
                 print(f"[service] {stats}", flush=True)
@@ -1341,18 +1476,48 @@ class ProvingService:
                 # The trace ring is DRAINED, which with the bounded
                 # buffer closes the unbounded-growth leak the run() loop
                 # had.
-                rid, pid = run_id(), os.getpid()
-                spans = [
-                    {"type": "stage", "run_id": rid, "pid": pid, **r} for r in drain_trace()
-                ]
-                try:
-                    self._sink(spool).write_many(spans)
-                except Exception:  # noqa: BLE001 — observation only
-                    pass
-                publish_native_stats()
+                _flush()
             # time-series tick rides the sweep cadence (interval-gated
             # inside; idle sweeps still sample, so a quiet queue is a
             # recorded fact, not a gap in the series)
             self._sampler.maybe_sample(spool, self._sink(spool))
+            # fleet tick: heartbeat out (liveness for the supervisor's
+            # watchdog + the bound metrics port for scrape discovery),
+            # governor ctl in (soft RSS degrade)
+            if fleet_dir:
+                try:
+                    from .fleet import worker_tick
+
+                    worker_tick(self, fleet_dir)
+                except Exception:  # noqa: BLE001 — fleet plumbing must not stop sweeps
+                    pass
+            if self._drain.is_set():
+                why = "drained"
+                break
+            if exit_when_spool_terminal and spool_terminal(spool):
+                why = "terminal"
+                break
             sweeps += 1
-            time.sleep(poll_s)
+            # interruptible sleep: a SIGTERM mid-poll exits promptly
+            # instead of burning up to poll_s — by this point the sweep
+            # above already finished every claim it held
+            if self._drain.wait(poll_s):
+                why = "drained"
+                break
+        # exit flush: whatever the reason, buffered spans and native
+        # stats land in the sink before the process goes away (the
+        # drain contract: in-flight work is not just proven but
+        # RECORDED), and the fleet heartbeat says "draining" so the
+        # supervisor sees a deliberate exit, not a hang
+        _flush()
+        if hb_stop is not None:
+            hb_stop.set()
+        if fleet_dir:
+            try:
+                from .fleet import worker_tick
+
+                worker_tick(self, fleet_dir, state=why)
+            except Exception:  # noqa: BLE001
+                pass
+        print(f"[service] exiting ({why})", flush=True)
+        return why

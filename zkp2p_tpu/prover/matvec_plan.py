@@ -269,21 +269,37 @@ def _resolve_one(lib, dpk, matrix: str, cache_dir: Optional[str], persist_min: i
     persist = cache_dir is not None and nnz >= persist_min
     path = _cache_path(cache_dir, matrix, kh) if persist else None
 
-    plan_arrays = None
-    source = "cache"
-    if path is not None and os.path.exists(path):
+    def _try_load():
         with trace("native/matvec_plan_load", matrix=matrix):
             try:
                 with np.load(path) as data:
-                    plan_arrays = _validate(data, cf, wi, ro, m)
+                    return _validate(data, cf, wi, ro, m)
             except Exception:  # noqa: BLE001 — corrupt cache rebuilds
-                plan_arrays = None
-    if plan_arrays is None:
+                return None
+
+    plan_arrays = None
+    source = "cache"
+    if path is not None and os.path.exists(path):
+        plan_arrays = _try_load()
+    if plan_arrays is None and path is not None:
+        # cross-process build serialization (precomp._build_flock):
+        # plan builds are cheap (one argsort) but the sidecar keeps N
+        # cold fleet workers from racing the persist, and the loser
+        # loads the winner's atomic-renamed file instead of rebuilding
+        from .precomp import _build_flock
+
+        with _build_flock(path):
+            if os.path.exists(path):
+                plan_arrays = _try_load()
+            if plan_arrays is None:
+                source = "built"
+                with trace("native/matvec_plan_build", matrix=matrix):
+                    plan_arrays = _build(cf, wi, ro)
+                _persist(path, *plan_arrays)
+    elif plan_arrays is None:
         source = "built"
         with trace("native/matvec_plan_build", matrix=matrix):
             plan_arrays = _build(cf, wi, ro)
-        if path is not None:
-            _persist(path, *plan_arrays)
     coeff, wire, perm, seg_starts, seg_rows = plan_arrays
     return MatvecPlan(
         matrix=matrix,

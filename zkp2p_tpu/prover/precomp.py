@@ -42,6 +42,7 @@ file rebuilds instead of proving garbage.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import hashlib
 import os
@@ -291,6 +292,41 @@ def _load_table(path: str, bases: np.ndarray, c: int, q: int, levels: int) -> Op
     return np.ascontiguousarray(table)
 
 
+@contextlib.contextmanager
+def _build_flock(path: str):
+    """CROSS-PROCESS build serialization (the JsonlSink sidecar
+    pattern, utils/metrics.py): an exclusive flock on `<path>.lock`
+    around check-build-persist, so N fleet workers cold-starting on one
+    key run ONE multi-minute build — the losers block here, then find
+    the winner's atomic-renamed artifact on the re-check and load it.
+    The in-process `_build_lock` already serializes threads; this
+    sidecar is the process-level tier above it.  No flock (exotic fs) =
+    no cross-process exclusion, same as before this existed — the
+    builds race but each still produces a correct table (atomic
+    rename; last writer wins)."""
+    lock_fd = -1
+    try:
+        import fcntl
+
+        # the sidecar may be the FIRST file in a fresh cache dir (the
+        # artifact write creates the dir otherwise) — without this, two
+        # cold processes both fail the open and race the first build
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        lock_fd = os.open(path + ".lock", os.O_CREAT | os.O_WRONLY, 0o644)
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+    except Exception:  # noqa: BLE001 — degrade to in-process locking only
+        if lock_fd >= 0:
+            os.close(lock_fd)
+            lock_fd = -1
+    try:
+        yield
+    finally:
+        if lock_fd >= 0:
+            os.close(lock_fd)  # releases the flock
+
+
 def _persist_table(path: str, table: np.ndarray) -> None:
     """Atomic write (tmp + rename): service workers may race a cold
     start; a half-written file must never be loadable."""
@@ -325,7 +361,26 @@ def _build_family(lib, dpk, family: str, geom, cache_dir, threads: int) -> Famil
     if path is not None and os.path.exists(path):
         with trace("native/precomp_load", family=family):
             table = _load_table(path, bases, c, q, levels)
-    if table is None:
+    if table is None and path is not None:
+        # cold + persistable: serialize the build ACROSS PROCESSES on
+        # the flock sidecar — N fleet workers sharing one key run ONE
+        # multi-minute build; losers block on the lock, then load the
+        # winner's atomic-renamed artifact on the re-check below
+        with _build_flock(path):
+            if os.path.exists(path):
+                with trace("native/precomp_load", family=family):
+                    table = _load_table(path, bases, c, q, levels)
+            if table is None:
+                source = "built"
+                with trace("native/precomp_build", family=family):
+                    table = np.zeros((levels * n, 8), dtype=np.uint64)
+                    lib.g1_precomp_build(
+                        bases.ctypes.data_as(_u64p), n, c, q, levels, threads,
+                        table.ctypes.data_as(_u64p),
+                    )
+                _persist_table(path, table)
+    elif table is None:
+        # RAM-only family (below persist_min, or persistence off)
         source = "built"
         with trace("native/precomp_build", family=family):
             table = np.zeros((levels * n, 8), dtype=np.uint64)
@@ -333,8 +388,6 @@ def _build_family(lib, dpk, family: str, geom, cache_dir, threads: int) -> Famil
                 bases.ctypes.data_as(_u64p), n, c, q, levels, threads,
                 table.ctypes.data_as(_u64p),
             )
-        if path is not None:
-            _persist_table(path, table)
 
     # the persistent 52-limb form (per process, never persisted: it is
     # one cheap conversion pass — 0.4 s at 8 x 2^19 rows — and keying

@@ -389,6 +389,15 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     slo_arm()
     timeseries_arm()
 
+    # fleet gates (pipeline.fleet): membership ("worker" when a
+    # supervisor stamped ZKP2P_WORKER_ID, else "off") and the resource
+    # governor budgets — a degraded fleet worker must never share a
+    # digest with a clean solo service
+    from ..pipeline.fleet import fleet_member_arm, governor_arm
+
+    fleet_member_arm()
+    governor_arm()
+
     if workload and backend != "unavailable":
         # one tiny jitted op: proves the backend executes and ticks the
         # compile listener.  Deliberately NOT a gated field mul — a

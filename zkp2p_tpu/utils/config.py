@@ -54,12 +54,19 @@ def _opt_int(s: str) -> Optional[int]:
 
 
 def _opt_port(s: str) -> Optional[int]:
-    # metrics exposition port: unset/empty/0/malformed all mean OFF —
-    # a typo must fail closed (no listener), never bind a random port
+    # metrics exposition port: unset/empty/malformed mean OFF (a typo
+    # must fail closed — no listener), "auto" or "0" mean EPHEMERAL (the
+    # OS picks a free port, recorded in the run manifest and the fleet
+    # heartbeat so scrapes stay discoverable — N workers on one host
+    # cannot share one fixed port), anything else is the fixed port
+    if s.strip().lower() == "auto":
+        return 0
     try:
         v = int(s)
     except ValueError:
         return None
+    if v == 0:
+        return 0
     return v if 0 < v < 65536 else None
 
 
@@ -268,6 +275,29 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     # (arrival rate, claimable backlog, in-flight fill, rescue counters,
     # native stats deltas, HBM gauges) to the JSONL sink.  0 = off.
     "ts_sample_s": ("ZKP2P_TS_SAMPLE_S", _nonneg_float(10.0), 10.0),
+    # fleet identity + plumbing (pipeline.fleet): the supervisor stamps
+    # these into each worker's environment — worker_id/fleet_id land on
+    # every service record and time-series line so trace_report can
+    # attribute rows to workers across a fleet run, and fleet_dir is
+    # where the worker writes heartbeats / reads governor control files.
+    # Empty = not a fleet member (solo service).
+    "worker_id": ("ZKP2P_WORKER_ID", str, ""),
+    "fleet_id": ("ZKP2P_FLEET_ID", str, ""),
+    "fleet_dir": ("ZKP2P_FLEET_DIR", str, ""),
+    # fleet policy knobs (pipeline.fleet; CLI flags override): worker
+    # count, the bounded wait between SIGTERM (drain) and SIGKILL
+    # escalation, per-worker RSS budgets for the resource governor
+    # (0 = off; soft = ctl-file degradation, hard = drain + restart),
+    # the crash-loop circuit breaker (K failures inside W seconds parks
+    # the worker; the fleet degrades to N-1 instead of flapping), and
+    # the exponential restart-backoff base.
+    "fleet_workers": ("ZKP2P_FLEET_WORKERS", _pos_int(2), 2),
+    "drain_timeout_s": ("ZKP2P_DRAIN_TIMEOUT_S", _nonneg_float(30.0), 30.0),
+    "rss_soft_mb": ("ZKP2P_RSS_SOFT_MB", _nonneg_int(0), 0),
+    "rss_hard_mb": ("ZKP2P_RSS_HARD_MB", _nonneg_int(0), 0),
+    "breaker_k": ("ZKP2P_BREAKER_K", _pos_int(5), 5),
+    "breaker_window_s": ("ZKP2P_BREAKER_WINDOW_S", _nonneg_float(60.0), 60.0),
+    "restart_backoff_s": ("ZKP2P_RESTART_BACKOFF_S", _nonneg_float(0.5), 0.5),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -319,6 +349,16 @@ class ProverConfig:
     slo_target: float = 0.95
     slo_window_s: float = 300.0
     ts_sample_s: float = 10.0
+    worker_id: str = ""
+    fleet_id: str = ""
+    fleet_dir: str = ""
+    fleet_workers: int = 2
+    drain_timeout_s: float = 30.0
+    rss_soft_mb: int = 0
+    rss_hard_mb: int = 0
+    breaker_k: int = 5
+    breaker_window_s: float = 60.0
+    restart_backoff_s: float = 0.5
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 

@@ -71,6 +71,15 @@ METRIC_HELP: Dict[str, str] = {
     "zkp2p_hbm_stage_peak_bytes": "Max-semantics per-stage device memory peak",
     "zkp2p_precomp_table_bytes": "Resident fixed-base table bytes per G1 family",
     "zkp2p_precomp_total_bytes": "Resident fixed-base table bytes, all families",
+    "zkp2p_fleet_workers": "Fleet worker slots by state (up|backoff|parked|done) at the last supervisor tick",
+    "zkp2p_fleet_restarts_total": "Worker restarts performed by the fleet supervisor, by worker",
+    "zkp2p_fleet_parked_total": "Workers parked by the crash-loop circuit breaker",
+    "zkp2p_fleet_drain_escalations_total": "Drains that exceeded ZKP2P_DRAIN_TIMEOUT_S and were escalated to SIGKILL",
+    "zkp2p_fleet_governor_soft_total": "Soft RSS-budget breaches (degradation ctl written), by worker",
+    "zkp2p_fleet_governor_hard_total": "Hard RSS-budget breaches (worker drained + restarted), by worker",
+    "zkp2p_fleet_worker_rss_bytes": "Per-worker resident-set size at the last governor sample",
+    "zkp2p_fleet_watchdog_kills_total": "Hung workers (stale heartbeat, live pid) killed by the supervisor watchdog",
+    "zkp2p_fleet_degrade_applied_total": "Governor soft-degrade overlays applied inside a worker",
 }
 
 
@@ -383,6 +392,12 @@ def run_manifest() -> Dict:
     probe = last_probe()
     if probe is not None:
         man["tpu_probe"] = probe
+    # where THIS process's /metrics endpoint actually listens — under
+    # ZKP2P_METRICS_PORT=auto the knob value (0) says nothing, so the
+    # manifest records the OS-assigned port (scrape discoverability for
+    # fleet workers; the fleet heartbeat carries the same number)
+    if _bound_port is not None:
+        man["metrics_port_bound"] = _bound_port
     # fixed-base precomputed-table memory accounting (prover.precomp):
     # per-family geometry + resident bytes + build-vs-cache provenance,
     # so table RAM is attributable in every trace/bench artifact
@@ -502,20 +517,33 @@ class JsonlSink:
 
 _server = None
 _server_lock = threading.Lock()
+# the port the endpoint actually bound — equals the configured port for
+# a fixed port, and the OS-assigned ephemeral port under
+# ZKP2P_METRICS_PORT=auto/0 (recorded in the run manifest and the fleet
+# heartbeat so scrapes stay discoverable across N workers on one host)
+_bound_port: Optional[int] = None
+
+
+def bound_metrics_port() -> Optional[int]:
+    """The port the /metrics endpoint is actually listening on (None
+    when exposition is off / the server never started)."""
+    return _bound_port
 
 
 def maybe_start_metrics_server(port: Optional[int] = None, registry: Optional[Registry] = None):
     """Start (idempotently) the /metrics HTTP endpoint when a port is
     configured; returns the server or None when exposition is off.
-    Binds ZKP2P_METRICS_ADDR (default localhost — the payload discloses
-    host facts and knob config; 0.0.0.0 is an explicit opt-in)."""
-    global _server
+    Port 0 ("auto") binds an OS-assigned ephemeral port — read it back
+    via `bound_metrics_port()`.  Binds ZKP2P_METRICS_ADDR (default
+    localhost — the payload discloses host facts and knob config;
+    0.0.0.0 is an explicit opt-in)."""
+    global _server, _bound_port
     reg = registry if registry is not None else REGISTRY
     from .config import load_config
 
     if port is None:
         port = load_config().metrics_port
-    if not port:
+    if port is None:
         return None
     addr = load_config().metrics_addr or "127.0.0.1"
     with _server_lock:
@@ -577,15 +605,23 @@ def maybe_start_metrics_server(port: Optional[int] = None, registry: Optional[Re
             return None
         threading.Thread(target=srv.serve_forever, daemon=True, name="zkp2p-metrics").start()
         _server = srv
+        _bound_port = int(srv.server_address[1])
+        if not port:
+            # auto mode: say which port the OS handed out — the only
+            # place a human would otherwise learn it is the manifest
+            import sys
+
+            print(f"[metrics] auto port: listening on :{_bound_port}", file=sys.stderr)
         return srv
 
 
 def stop_metrics_server() -> None:
     """Tear down the exposition endpoint (tests; service shutdown)."""
-    global _server
+    global _server, _bound_port
     with _server_lock:
         if _server is not None:
             srv = _server
             _server = None
+            _bound_port = None
             srv.shutdown()
             srv.server_close()

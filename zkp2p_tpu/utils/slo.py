@@ -222,6 +222,18 @@ def status_payload() -> Dict:
         "uptime_s": round(time.time() - _t_start, 3),
         "execution_digest": execution_digest(),
     }
+    # fleet identity (when a supervisor stamped it): lets a scrape of N
+    # auto-ported workers say WHICH worker answered
+    try:
+        from .config import load_config
+
+        cfg = load_config()
+        if cfg.worker_id:
+            body["worker"] = cfg.worker_id
+        if cfg.fleet_id:
+            body["fleet"] = cfg.fleet_id
+    except Exception:  # noqa: BLE001 — identity is optional
+        pass
     if pf is None:
         body["reason"] = "preflight has not run (gates unarmed; see zkp2p-tpu doctor)"
     else:
