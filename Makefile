@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-chaos doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -79,6 +79,18 @@ loadgen-smoke: native
 # (`make fleet-chaos`).  See docs/ROBUSTNESS.md §fleet; ~2 min.
 fleet-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_fleet.py -q
+
+# Fleet observability plane smoke (fast; tier-1 resident): federation
+# aggregation rules (counter sum / per-worker gauge labels / histogram
+# bucket-merge with mismatch refusal), merged-window SLO pinned against
+# a pooled oracle, alert rules + hysteresis on synthetic time-series,
+# fleet /status fail-closed, chrome-trace flow events across pids, and
+# the 2-worker toy-fleet smoke: fleet /metrics + /status scrape 200,
+# merged request counters equal the per-worker sums AND the proof
+# artifacts, trace_report --fleet-dir renders valid JSON.  See
+# docs/OBSERVABILITY.md §fleet plane; ~15 s on the 2-core box.
+fleet-obs-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_fleet_obs.py -q
 
 # The full fleet acceptance (slow): N=3 supervised workers, seeded
 # faults, worker SIGKILL + worker SIGTERM drain + supervisor

@@ -78,6 +78,14 @@ def test_env_overrides_every_knob():
         "ZKP2P_BREAKER_K": "3",
         "ZKP2P_BREAKER_WINDOW_S": "45",
         "ZKP2P_RESTART_BACKOFF_S": "0.1",
+        "ZKP2P_FLEET_METRICS_PORT": "9470",
+        "ZKP2P_FLEET_SCRAPE_S": "1.5",
+        "ZKP2P_SLO_FAST_WINDOW_S": "90",
+        "ZKP2P_ALERT_BURN_RATE": "4",
+        "ZKP2P_ALERT_RESTARTS": "5",
+        "ZKP2P_ALERT_FOR_S": "7",
+        "ZKP2P_ALERT_CLEAR_S": "20",
+        "ZKP2P_ALERT_HB_GAP_S": "8",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -106,6 +114,11 @@ def test_env_overrides_every_knob():
     assert cfg.rss_soft_mb == 2048 and cfg.rss_hard_mb == 4096
     assert cfg.breaker_k == 3 and cfg.breaker_window_s == 45.0
     assert cfg.restart_backoff_s == 0.1
+    assert cfg.fleet_metrics_port == 9470 and cfg.fleet_scrape_s == 1.5
+    assert cfg.slo_fast_window_s == 90.0
+    assert cfg.alert_burn_rate == 4.0 and cfg.alert_restarts == 5
+    assert cfg.alert_for_s == 7.0 and cfg.alert_clear_s == 20.0
+    assert cfg.alert_hb_gap_s == 8.0
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -126,6 +139,19 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_METRICS_PORT": "junk"}).metrics_port is None
     assert load_config(environ={"ZKP2P_METRICS_PORT": "9464"}).metrics_port == 9464
     assert load_config(environ={"ZKP2P_METRICS_PORT": "99999"}).metrics_port is None
+    # fleet plane port follows the metrics-port grammar exactly:
+    # auto/0 = ephemeral, junk fails CLOSED (plane off), range-checked
+    assert load_config(environ={"ZKP2P_FLEET_METRICS_PORT": "auto"}).fleet_metrics_port == 0
+    assert load_config(environ={"ZKP2P_FLEET_METRICS_PORT": "0"}).fleet_metrics_port == 0
+    assert load_config(environ={"ZKP2P_FLEET_METRICS_PORT": "junk"}).fleet_metrics_port is None
+    assert load_config(environ={"ZKP2P_FLEET_METRICS_PORT": "9470"}).fleet_metrics_port == 9470
+    assert load_config(environ={}).fleet_metrics_port is None  # default: plane off
+    # alert thresholds: malformed keeps the committed default, negative
+    # seconds clamp to 0 (fire/clear immediately, never a time machine)
+    assert load_config(environ={"ZKP2P_ALERT_BURN_RATE": "junk"}).alert_burn_rate == 2.0
+    assert load_config(environ={"ZKP2P_ALERT_RESTARTS": "0"}).alert_restarts == 1
+    assert load_config(environ={"ZKP2P_ALERT_FOR_S": "-3"}).alert_for_s == 0.0
+    assert load_config(environ={"ZKP2P_FLEET_SCRAPE_S": "junk"}).fleet_scrape_s == 2.0
     # fleet knobs: breaker/backoff clamp like their service siblings
     assert load_config(environ={"ZKP2P_FLEET_WORKERS": "0"}).fleet_workers == 1
     assert load_config(environ={"ZKP2P_FLEET_WORKERS": "junk"}).fleet_workers == 2

@@ -334,6 +334,189 @@ def _live_claims(spool: str) -> list:
     return out
 
 
+def _http_json(url: str, timeout: float = 3.0):
+    # the ONE fleet-status client (fleet_obs): a 503 body is still the
+    # status JSON
+    from zkp2p_tpu.pipeline.fleet_obs import http_status_json
+
+    return http_status_json(url, timeout=timeout)
+
+
+def _http_text(url: str, timeout: float = 3.0):
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode()
+    except (OSError, ValueError):
+        return None
+
+
+def _prom_counters(text: str, name: str) -> dict:
+    """{label-string: value} for one counter family out of Prometheus
+    exposition text (the fleet /metrics side of the parity check)."""
+    out = {}
+    for line in (text or "").splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest.startswith("{"):
+            labels, _, val = rest[1:].partition("} ")
+        elif rest.startswith(" "):
+            labels, val = "", rest[1:]
+        else:
+            continue
+        try:
+            out[labels] = out.get(labels, 0.0) + float(val)
+        except ValueError:
+            pass
+    return out
+
+
+def check_plane(args, env) -> dict:
+    """The fleet-observability-plane assertions (ISSUE-12 satellite),
+    run as two self-contained mini-fleets after the main chaos phases:
+
+      A. FEDERATION PARITY under fault: a lingering 2-worker fleet
+         serves a small spool to terminal (faults still armed); once
+         quiesced, the fleet /metrics `zkp2p_service_requests_total`
+         counters must EQUAL the sum of the live workers' /snapshot
+         counters — the merge invents nothing and loses nothing.
+      B. RESTART STORM: a crash-looping worker under breaker_k=2 must
+         get PARKED, and the plane's restart_storm alert must FIRE
+         (status.json alert state + zkp2p_fleet_alerts_total).
+    """
+    report = {"violations": []}
+    env = dict(env)
+    env["ZKP2P_FLEET_SCRAPE_S"] = "0.5"
+    env["ZKP2P_FLEET_METRICS_PORT"] = "auto"
+
+    # ---- A: counter federation parity
+    spool = args.spool.rstrip("/") + "_plane"
+    os.makedirs(spool, exist_ok=True)
+    for i in range(6):
+        with open(os.path.join(spool, f"p{i:03d}.req.json"), "w") as f:
+            json.dump({"x": 3 + i, "y": 5 + i}, f)
+    fleet_dir = os.path.join(spool, ".fleet")
+    worker_argv = [
+        sys.executable, os.path.abspath(__file__), "--worker", "--linger",
+        "--spool", spool, "--batch", "2", "--poll-s", "0.05",
+        "--max-seconds", "90", "--prove-s", "0.1",
+    ]
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "zkp2p_tpu", "fleet",
+         "--spool", spool, "--workers", "2", "--fleet-dir", fleet_dir,
+         "--fleet-metrics-port", "auto", "--restart-backoff-s", "0.2",
+         "--max-seconds", "90", "--worker-cmd", json.dumps(worker_argv)],
+        env=env, cwd=REPO,
+    )
+    try:
+        from zkp2p_tpu.pipeline.fleet_obs import discover_fleet_port
+
+        deadline = time.time() + 60
+        port = None
+        while time.time() < deadline and port is None:
+            port = discover_fleet_port(fleet_dir)
+            time.sleep(0.1)
+        status = None
+        while time.time() < deadline:
+            status = _http_json(f"http://127.0.0.1:{port}/status") if port else None
+            if status and status.get("ok"):
+                break
+            time.sleep(0.2)
+        if not (status and status.get("ok")):
+            report["violations"].append("plane: fleet /status never reached 200")
+            return report
+        # serve to terminal, then let the scrape loop catch up.  A
+        # quiesce TIMEOUT is its own violation and ends the check: a
+        # counter comparison against a still-moving fleet would report
+        # a misleading federation-parity failure for what is really a
+        # slow-host harness problem.
+        from zkp2p_tpu.pipeline.service import spool_terminal
+
+        while time.time() < deadline and not spool_terminal(spool):
+            time.sleep(0.2)
+        if not spool_terminal(spool):
+            report["violations"].append(
+                "plane: harness spool never quiesced inside the deadline "
+                "(parity not comparable; not a federation failure)"
+            )
+            return report
+        time.sleep(2.0)  # >= 2 scrape intervals: counters quiesced AND federated
+        status = _http_json(f"http://127.0.0.1:{port}/status")
+        fleet_text = _http_text(f"http://127.0.0.1:{port}/metrics")
+        fleet_counts = _prom_counters(fleet_text, "zkp2p_service_requests_total")
+        worker_sum: dict = {}
+        scraped = 0
+        for wid, w in (status.get("workers") or {}).items():
+            if w.get("state") not in ("up", "starting", "draining") or not w.get("port"):
+                continue
+            snap = _http_json(f"http://127.0.0.1:{w['port']}/snapshot")
+            if snap is None:
+                report["violations"].append(f"plane: worker {wid} /snapshot unreachable")
+                continue
+            scraped += 1
+            for m in snap.get("metrics") or []:
+                if m["name"] == "zkp2p_service_requests_total" and m["kind"] == "counter":
+                    key = ",".join(f'{k}="{v}"' for k, v in sorted(m["labels"].items()))
+                    worker_sum[key] = worker_sum.get(key, 0.0) + m["value"]
+        report["parity"] = {
+            "fleet": fleet_counts, "worker_sum": worker_sum, "workers_scraped": scraped,
+        }
+        if scraped < 2:
+            report["violations"].append(f"plane: only {scraped} worker snapshots scraped")
+        if fleet_counts != worker_sum:
+            report["violations"].append(
+                f"plane: fleet /metrics request counters {fleet_counts} != "
+                f"per-worker sums {worker_sum}"
+            )
+        n_done = sum(v for k, v in worker_sum.items() if 'state="done"' in k)
+        n_proofs = len([f for f in os.listdir(spool) if f.endswith(".proof.json")])
+        if n_done != n_proofs:
+            report["violations"].append(
+                f"plane: summed done counter {n_done} != {n_proofs} proof artifacts"
+            )
+    finally:
+        if sup.poll() is None:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                sup.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+
+    # ---- B: breaker park -> restart_storm alert
+    spool_b = args.spool.rstrip("/") + "_storm"
+    os.makedirs(spool_b, exist_ok=True)
+    fleet_dir_b = os.path.join(spool_b, ".fleet")
+    sup_b = subprocess.run(
+        [sys.executable, "-m", "zkp2p_tpu", "fleet",
+         "--spool", spool_b, "--workers", "1", "--fleet-dir", fleet_dir_b,
+         "--fleet-metrics-port", "auto", "--breaker-k", "2",
+         "--breaker-window-s", "60", "--restart-backoff-s", "0.05",
+         "--max-seconds", "45",
+         "--worker-cmd", json.dumps([sys.executable, "-c", "import sys; sys.exit(1)"])],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    storm = {"supervisor_rc": sup_b.returncode}
+    try:
+        with open(os.path.join(fleet_dir_b, "status.json")) as f:
+            st = json.load(f)
+        storm["alerts_state"] = st.get("alerts_state")
+        fired = ((st.get("alerts_state") or {}).get("restart_storm") or {}).get("fired_count", 0)
+        if sup_b.returncode != 4:
+            report["violations"].append(
+                f"plane: storm fleet exited rc={sup_b.returncode} (want 4 = all parked)"
+            )
+        if not fired:
+            report["violations"].append(
+                "plane: breaker parked the worker but restart_storm never fired"
+            )
+    except (OSError, ValueError) as e:
+        report["violations"].append(f"plane: storm status.json unreadable ({e})")
+    report["restart_storm"] = storm
+    return report
+
+
 def run_fleet_chaos(args) -> dict:
     """Fleet-scale chaos (the ISSUE-10 acceptance shape): a SUPERVISED
     fleet of N workers on one spool, faults armed in every worker, then
@@ -365,6 +548,16 @@ def run_fleet_chaos(args) -> dict:
     env["ZKP2P_FAULTS"] = args.faults
     env.pop("ZKP2P_METRICS_SINK", None)  # per-spool sink = the shared record file
     env.setdefault("ZKP2P_METRICS_PORT", "auto")  # N workers: ephemeral ports
+    # the observability plane rides the chaos run: the supervisor
+    # federates /metrics + /status while workers are being killed —
+    # the plane must tolerate exactly this.  Parse-checked: an empty
+    # inherited ZKP2P_FLEET_METRICS_PORT means plane-off and would
+    # silently skip every plane assertion.
+    from zkp2p_tpu.utils.config import _opt_port
+
+    if _opt_port(env.get("ZKP2P_FLEET_METRICS_PORT") or "") is None:
+        env["ZKP2P_FLEET_METRICS_PORT"] = "auto"
+    env.setdefault("ZKP2P_FLEET_SCRAPE_S", "0.5")
     worker_argv = [
         sys.executable, os.path.abspath(__file__), "--worker",
         "--spool", args.spool,
@@ -472,6 +665,13 @@ def run_fleet_chaos(args) -> dict:
         report["violations"].append(
             f"harness: final supervisor exited rc={supervisor_rcs[-1]} (want 0 = clean)"
         )
+    # fleet-plane assertions (federation parity + restart-storm alert)
+    # as their own mini-fleets — the main run's workers exit the moment
+    # the spool goes terminal, too racy a target for a counter-equality
+    # check that needs a quiesced, still-scrapable fleet
+    plane = check_plane(args, env)
+    report["plane"] = {k: v for k, v in plane.items() if k != "violations"}
+    report["violations"].extend(plane["violations"])
     return report
 
 

@@ -436,6 +436,22 @@ def main(argv=None) -> int:
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
+        # the fleet observability plane rides the run (auto port, bound
+        # port in status.json): it IS the readiness gate below, and its
+        # merged SLO + fired alerts land in the capacity JSON.  Parse-
+        # checked, not setdefault: an explicitly EMPTY (or junk) value
+        # in the caller's environment also means plane-off, and a
+        # plane-less fleet can never pass the /status gate.
+        from zkp2p_tpu.utils.config import _opt_port
+
+        if _opt_port(env.get("ZKP2P_FLEET_METRICS_PORT") or "") is None:
+            env["ZKP2P_FLEET_METRICS_PORT"] = "auto"
+        # the scoring objective is the WORKERS' objective too — the
+        # merged fleet window recorded at teardown must judge "good"
+        # by the same bound the capacity math scores against (the
+        # in-process arm writes the same env through run_capacity)
+        env["ZKP2P_SLO_P95_S"] = f"{objective_s:g}"
+        env["ZKP2P_SLO_TARGET"] = f"{target:g}"
         fleet_proc = subprocess.Popen(
             [
                 sys.executable, "-m", "zkp2p_tpu", "fleet",
@@ -446,26 +462,40 @@ def main(argv=None) -> int:
             ],
             env=env, cwd=REPO,
         )
-        # readiness gate: score only once every worker heartbeats —
-        # otherwise step 0 pays N cold python/jax imports and reports
-        # them as queue latency
+        # readiness gate: score only once the FLEET /status answers 200
+        # — i.e. every live worker is up, scrapable, AND has armed its
+        # gates (preflight).  Stronger than the old N-heartbeat-files
+        # check: a stale .hb can't fake readiness, an unarmed worker
+        # can't hide, and step 0 never pays N cold python/jax imports
+        # billed as queue latency.
+        from zkp2p_tpu.pipeline.fleet_obs import discover_fleet_port, http_status_json
+
         deadline = time.time() + 120.0
+        fleet_status_url = None
+        last_reason = "status.json has no metrics_port yet"
         while time.time() < deadline:
-            try:
-                ups = [f for f in os.listdir(fleet_dir) if f.endswith(".hb")]
-            except OSError:
-                ups = []
-            if len(ups) >= args.fleet:
-                break
             if fleet_proc.poll() is not None:
                 print("[loadgen] fleet supervisor died before the ramp", file=sys.stderr)
                 return 2
+            if fleet_status_url is None:
+                port = discover_fleet_port(fleet_dir)
+                if port:
+                    fleet_status_url = f"http://127.0.0.1:{port}/status"
+            if fleet_status_url is not None:
+                st = http_status_json(fleet_status_url)
+                if st and st.get("ok"):
+                    break
+                if st:
+                    last_reason = st.get("reason", "not ready")
             time.sleep(0.1)
         else:
             fleet_proc.kill()
-            print("[loadgen] fleet workers never became ready", file=sys.stderr)
+            print(f"[loadgen] fleet never became ready ({last_reason})", file=sys.stderr)
             return 2
-        print(f"[loadgen] fleet ready: {args.fleet} workers heartbeating", file=sys.stderr)
+        print(
+            f"[loadgen] fleet ready: /status 200 ({args.fleet} armed workers)",
+            file=sys.stderr,
+        )
     elif not args.no_service:
         world = _toy_world() if args.circuit == "toy" else _venmo_world()
         cs, dpk, vk, witness_fn, public_fn, payload_fn, circuit = world
@@ -486,6 +516,22 @@ def main(argv=None) -> int:
             run_service=not args.no_service and not args.fleet, circuit=circuit,
             prove_sleep_s=args.prove_s, fleet_workers=args.fleet,
         )
+        if args.fleet and fleet_status_url:
+            # the serving fleet's own read of the run, BEFORE teardown:
+            # merged SLO (sample count = sum of worker windows) and
+            # every alert that fired — a capacity number whose run
+            # tripped restart_storm or slo_burn is not a capacity number
+            fs = http_status_json(fleet_status_url, timeout=5)
+            if fs:
+                report["fleet_slo"] = fs.get("slo")
+                report["fleet_alerts"] = {
+                    "active": fs.get("alerts", []),
+                    "fired": {
+                        rule: st.get("fired_count", 0)
+                        for rule, st in (fs.get("alerts_state") or {}).items()
+                        if st.get("fired_count")
+                    },
+                }
     finally:
         if fleet_proc is not None and fleet_proc.poll() is None:
             # graceful fleet teardown: SIGTERM fans drain out to the

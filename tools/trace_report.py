@@ -28,8 +28,23 @@ Modes:
   --chrome-trace OUT
                export the request records' lifecycle spans as Chrome
                trace-event JSON (one pid per worker process, one tid
-               per request, queue-wait vs witness/prove/emit slices) —
-               load OUT in https://ui.perfetto.dev.  Honors --run.
+               per request, queue-wait vs witness/prove/emit slices,
+               FLOW arrows stitching a deferred/taken-over request's
+               attempts across worker process rows) — load OUT in
+               https://ui.perfetto.dev.  Honors --run.
+  --fleet-dir DIR
+               cross-worker mode: discover every sink a fleet run left
+               behind (the shared spool sink + rotation backups, plus
+               any per-worker ZKP2P_METRICS_SINK files dropped inside
+               DIR) instead of naming files by hand — `--fleet-dir
+               <spool>/.fleet --chrome-trace out.json` renders the
+               whole fleet, one process row per worker.
+  --request RID
+               single-request forensics: a text timeline of RID's
+               journey — arrival, every claim with its owning worker
+               and queue-wait, defer/takeover hops, spans per attempt,
+               terminal state.  The "which worker did what, when" view
+               chasing one stuck request needs.
 
 Exact percentiles from the raw records (the registry's histograms are
 bucket-resolution; this reads the records themselves).
@@ -38,7 +53,9 @@ bucket-resolution; this reads the records themselves).
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -236,8 +253,17 @@ def chrome_trace(requests: List[dict], run: Optional[str] = None) -> dict:
     verify / emit, `spans` on the record), and an instant marker at the
     terminal/deferred transition.  Deferred attempt records share their
     request's tid, so a defer→re-prove cycle reads as one row with two
-    prove slices.  Timestamps are µs relative to the earliest event
-    (Chrome's `ts` unit), emitted sorted so they are monotonic."""
+    prove slices.
+
+    Cross-attempt FLOW events: a request with more than one record
+    (defer→re-prove, takeover after a SIGKILL) gets a flow arrow from
+    each attempt's last slice to the next attempt's first slice — the
+    ph "s"/"f" pair Perfetto draws as an arrow BETWEEN process rows.
+    Before this, a defer whose re-prove landed on another worker
+    rendered as two unrelated rows with nothing saying they were the
+    same request's journey.  Timestamps are µs relative to the
+    earliest event (Chrome's `ts` unit), emitted sorted so they are
+    monotonic."""
     recs = [
         r for r in requests
         if r.get("request_id") and (not run or r.get("run_id") == run)
@@ -300,6 +326,55 @@ def chrome_trace(requests: List[dict], run: Optional[str] = None) -> dict:
                 "args": {k: r[k] for k in ("batch_index", "batch_n", "degraded_rung",
                                            "deferred_reason") if r.get(k) is not None},
             })
+
+    # ---- flow events: stitch a request's attempts across process rows.
+    # Each record is one ATTEMPT; consecutive attempts get an arrow
+    # from the earlier attempt's last slice to the later attempt's
+    # first slice.  The "s"/"f" anchors must land INSIDE a slice on
+    # their row for importers to bind them, so the ts is nudged one µs
+    # off the slice edge.
+    def _anchor_slices(r: dict) -> Tuple[Optional[dict], Optional[dict]]:
+        """(first, last) anchorable slices of one record: lifecycle
+        spans preferred; the synthesized queue_wait slice as the
+        fallback for span-less records (a claim-then-shed terminal)."""
+        spans = [s for s in (r.get("spans") or []) if s.get("ms", 0) > 0]
+        if spans:
+            first = min(spans, key=lambda s: float(s["t0"]))
+            last = max(spans, key=lambda s: float(s["t0"]) + float(s["ms"]) / 1e3)
+            return first, last
+        t_submit, t_claim = r.get("t_submit"), r.get("t_claim")
+        if t_submit and t_claim and t_claim > t_submit:
+            qw = {"t0": t_submit, "ms": (t_claim - t_submit) * 1e3}
+            return qw, qw
+        return None, None
+
+    by_rid: Dict[str, List[dict]] = {}
+    for r in recs:
+        by_rid.setdefault(r["request_id"], []).append(r)
+    flow_id = 0
+    for rid, attempts in sorted(by_rid.items()):
+        if len(attempts) < 2:
+            continue
+        attempts.sort(key=lambda r: float(r.get("ts") or 0.0))
+        for prev, cur in zip(attempts, attempts[1:]):
+            _, prev_last = _anchor_slices(prev)
+            cur_first, _ = _anchor_slices(cur)
+            if prev_last is None or cur_first is None:
+                continue
+            flow_id += 1
+            prev_pid, cur_pid = int(prev.get("pid") or 0), int(cur.get("pid") or 0)
+            start_ts = float(prev_last["t0"]) * 1e6 + max(0.0, float(prev_last["ms"]) * 1e3 - 1.0)
+            finish_ts = float(cur_first["t0"]) * 1e6 + min(1.0, float(cur_first["ms"]) * 1e3 / 2)
+            hop = "takeover" if cur_pid != prev_pid else "re-prove"
+            common = {"cat": "flow", "name": f"{rid} {hop}", "id": flow_id}
+            events.append({
+                "ph": "s", **common, "pid": prev_pid,
+                "tid": tid_for(prev_pid, rid), "ts": start_ts,
+            })
+            events.append({
+                "ph": "f", "bp": "e", **common, "pid": cur_pid,
+                "tid": tid_for(cur_pid, rid), "ts": max(finish_ts, start_ts + 1.0),
+            })
     # normalize to the earliest event and sort: Perfetto wants sane
     # (small, monotonic-sortable) µs timestamps, not epoch µs
     slices = [e for e in events if "ts" in e]
@@ -316,6 +391,81 @@ def chrome_trace(requests: List[dict], run: Optional[str] = None) -> dict:
     # slices both anchored at t_submit (shorter-first would mis-nest).
     slices.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
     return {"traceEvents": meta + slices, "displayTimeUnit": "ms"}
+
+
+def fleet_sinks(fleet_dir: str) -> List[str]:
+    """Discover every JSONL sink a fleet run left behind, from its
+    fleet dir (default `<spool>/.fleet`): the shared per-spool sink
+    `<spool>.metrics.jsonl` with its rotation backups, plus any
+    `*.jsonl` dropped inside the fleet dir itself (a per-worker
+    ZKP2P_METRICS_SINK override pointed there).  The spool path comes
+    from status.json when present (the supervisor records it), else
+    from the directory layout."""
+    spool = None
+    try:
+        with open(os.path.join(fleet_dir, "status.json")) as f:
+            spool = json.load(f).get("spool")
+    except (OSError, ValueError):
+        pass
+    if not spool:
+        spool = os.path.dirname(os.path.abspath(fleet_dir))
+    base = spool.rstrip("/") + ".metrics.jsonl"
+    paths = [p for p in [base] + [f"{base}.{i}" for i in range(1, 10)] if os.path.exists(p)]
+    paths += sorted(
+        p for p in _glob.glob(os.path.join(fleet_dir, "*.jsonl")) if os.path.isfile(p)
+    )
+    return paths
+
+
+def request_timeline(requests: List[dict], rid: str) -> str:
+    """Single-request forensics: every attempt (record) for `rid` in
+    time order — owning worker, claim offset, queue-wait for THAT hop,
+    span breakdown, outcome — with takeover hops called out where the
+    owner changed between attempts.  Offsets are relative to the spool
+    arrival (t_submit), the clock every worker shares."""
+    recs = sorted(
+        (r for r in requests if r.get("request_id") == rid),
+        key=lambda r: float(r.get("ts") or 0.0),
+    )
+    if not recs:
+        return f"(no records for request {rid!r})"
+    t0 = min(
+        [float(r["t_submit"]) for r in recs if r.get("t_submit")]
+        or [float(r.get("t_claim") or r.get("ts") or 0.0) for r in recs]
+    )
+
+    def owner(r: dict) -> str:
+        w = r.get("worker")
+        return f"{w} (pid {r.get('pid')})" if w else f"pid {r.get('pid')}"
+
+    lines = [f"request {rid} — {len(recs)} attempt(s)"]
+    lines.append("  +0.000s  arrival (spool mtime)")
+    prev_owner = None
+    for i, r in enumerate(recs, 1):
+        hop = ""
+        if prev_owner is not None and owner(r) != prev_owner:
+            hop = "  TAKEOVER"
+        prev_owner = owner(r)
+        t_claim = r.get("t_claim")
+        claim_s = f"+{float(t_claim) - t0:.3f}s" if t_claim else "?"
+        qw = r.get("queue_wait_s")
+        qw_s = f"  queue_wait {float(qw):.3f}s" if qw is not None else ""
+        spans = r.get("spans") or []
+        span_s = ", ".join(f"{s['name']} {float(s['ms']):.0f}ms" for s in spans)
+        state = r.get("state", "?")
+        outcome = state
+        if state == "deferred" and r.get("deferred_reason"):
+            outcome += f" ({r['deferred_reason']})"
+        if r.get("degraded_rung"):
+            outcome += f" [rescued: {r['degraded_rung']}]"
+        ts = r.get("ts")
+        end_s = f" at +{float(ts) - t0:.3f}s" if ts else ""
+        lines.append(
+            f"  attempt {i}  {owner(r)}{hop}  claim {claim_s}{qw_s}"
+            + (f"\n             {span_s}" if span_s else "")
+            + f"\n             -> {outcome}{end_s}"
+        )
+    return "\n".join(lines)
 
 
 def _aggregate_timeseries(timeseries: List[dict], run: Optional[str] = None) -> dict:
@@ -472,7 +622,7 @@ def _runs_summary(runs: List[dict]) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("files", nargs="+", help="JSONL sink file(s)")
+    ap.add_argument("files", nargs="*", help="JSONL sink file(s)")
     ap.add_argument("--tree", action="store_true", help="stage-path tree view")
     ap.add_argument("--runs", action="store_true", help="list run_ids and exit")
     ap.add_argument("--run", help="restrict to one run_id")
@@ -485,7 +635,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--chrome-trace", metavar="OUT",
         help="write the request waterfalls as Chrome trace-event JSON (Perfetto-loadable)",
     )
+    ap.add_argument(
+        "--fleet-dir", metavar="DIR",
+        help="discover a fleet run's sinks from its fleet dir (<spool>/.fleet) "
+             "instead of naming files — composes with every other mode",
+    )
+    ap.add_argument(
+        "--request", metavar="RID",
+        help="single-request timeline: arrival -> claims -> takeovers -> terminal, "
+             "with owning worker and queue-wait per hop",
+    )
     args = ap.parse_args(argv)
+    if args.fleet_dir:
+        found = fleet_sinks(args.fleet_dir)
+        if not found and not args.files:
+            print(f"[trace_report] no sinks found for fleet dir {args.fleet_dir}", file=sys.stderr)
+            return 1
+        args.files = list(args.files) + [p for p in found if p not in args.files]
+    if not args.files:
+        ap.error("need sink file(s) or --fleet-dir")
 
     if args.diff and len(args.files) == 2:
         # file-vs-file diff: --diff labels the columns
@@ -498,13 +666,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     stages, requests, manifests, timeseries = load_records(args.files)
+    if args.request:
+        reqs = [r for r in requests if not args.run or r.get("run_id") == args.run]
+        print(request_timeline(reqs, args.request))
+        return 0
     if args.chrome_trace:
         trace = chrome_trace(requests, run=args.run)
         n_slices = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        n_flows = sum(1 for e in trace["traceEvents"] if e.get("ph") == "s")
         with open(args.chrome_trace, "w") as f:
             json.dump(trace, f)
         print(
-            f"[trace_report] wrote {n_slices} spans across "
+            f"[trace_report] wrote {n_slices} spans + {n_flows} cross-attempt flow(s) across "
             f"{len({e['pid'] for e in trace['traceEvents']})} worker pid(s) to "
             f"{args.chrome_trace} (load in https://ui.perfetto.dev)",
             file=sys.stderr,

@@ -460,6 +460,19 @@ def cmd_fleet(args):
         def worker_cmd(_wid):
             return list(base)
 
+    # fleet observability plane port (stable aggregated /metrics +
+    # /status): flag wins, else ZKP2P_FLEET_METRICS_PORT; same
+    # "auto"/"0" = ephemeral semantics as the worker metrics port
+    fleet_metrics_port = None
+    if args.fleet_metrics_port is not None:
+        from ..utils.config import _opt_port
+
+        fleet_metrics_port = _opt_port(str(args.fleet_metrics_port))
+        if fleet_metrics_port is None:
+            raise SystemExit(
+                f"--fleet-metrics-port {args.fleet_metrics_port!r}: want a port, 'auto', or 0"
+            )
+
     sup = FleetSupervisor(
         args.spool, worker_cmd,
         workers=args.workers,
@@ -471,6 +484,7 @@ def cmd_fleet(args):
         rss_soft_mb=args.rss_soft_mb,
         rss_hard_mb=args.rss_hard_mb,
         liveness_s=args.liveness_s,
+        fleet_metrics_port=fleet_metrics_port,
         log=lambda m: _log(f"fleet: {m}"),
     )
     # the supervisor's own exposition (fleet gauges/counters) — workers
@@ -483,6 +497,52 @@ def cmd_fleet(args):
         f"(fleet dir {sup.fleet_dir}, drain timeout {sup.drain_timeout_s:g}s)"
     )
     sys.exit(sup.run(max_seconds=args.max_seconds))
+
+
+def cmd_top(args):
+    """Live fleet terminal view: poll the fleet plane's /status and
+    render worker table + merged SLO + active alerts (pipeline.fleet_obs
+    renders; this loop only fetches).  The endpoint is found from
+    --url, --port, or a --fleet-dir's status.json (`metrics_port` —
+    the supervisor records its bound port there, so `zkp2p-tpu top
+    --fleet-dir <spool>/.fleet` needs no port bookkeeping)."""
+    import time as _time
+
+    from ..pipeline.fleet_obs import discover_fleet_port, http_status_json, render_top
+
+    def resolve_url() -> str:
+        if args.url:
+            return args.url.rstrip("/") + ("" if args.url.rstrip("/").endswith("/status") else "/status")
+        port = args.port
+        if port is None and args.fleet_dir:
+            port = discover_fleet_port(args.fleet_dir)
+            if port is None:
+                raise SystemExit(
+                    f"{args.fleet_dir}/status.json has no metrics_port — is the "
+                    "fleet running with --fleet-metrics-port (or ZKP2P_FLEET_METRICS_PORT)?"
+                )
+        if port is None:
+            raise SystemExit("top needs --url, --port, or --fleet-dir")
+        return f"http://127.0.0.1:{port}/status"
+
+    url = resolve_url()
+    try:
+        while True:
+            # a 503 body still renders (the reason line is the point);
+            # transport failure degrades to an unreachable frame, not a die
+            body = http_status_json(url, timeout=5) or {"ok": False, "reason": f"unreachable: {url}"}
+            frame = render_top(body)
+            if args.once:
+                print(frame)
+                return
+            # clear + home, then the frame (plain ANSI; no curses dep)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        # Ctrl-C is the live view's ONLY interactive exit — leave the
+        # last frame on screen, not a stack trace over it
+        print()
 
 
 def cmd_serve(args):
@@ -706,7 +766,20 @@ def main(argv=None):
     s.add_argument("--worker-cmd", default=None,
                    help="JSON argv for each worker (advanced/chaos; '{wid}' and "
                         "'{spool}' substitute) — default spawns 'zkp2p-tpu service' workers")
+    s.add_argument("--fleet-metrics-port", default=None,
+                   help="fleet observability plane port: aggregated /metrics + /status "
+                        "+ /healthz ('auto'/0 = ephemeral, recorded in status.json; "
+                        "default: ZKP2P_FLEET_METRICS_PORT; unset = plane off)")
     s.set_defaults(fn=cmd_fleet)
+
+    s = sub.add_parser("top", help="live fleet view: poll the fleet /status and render it")
+    s.add_argument("--url", help="full fleet status URL (overrides --port/--fleet-dir)")
+    s.add_argument("--port", type=int, default=None, help="fleet plane port on 127.0.0.1")
+    s.add_argument("--fleet-dir", help="read the port from <fleet-dir>/status.json")
+    s.add_argument("--interval", type=float, default=2.0, help="poll interval in s")
+    s.add_argument("--once", action="store_true",
+                   help="print one frame and exit (scripts/tests)")
+    s.set_defaults(fn=cmd_top)
 
     s = sub.add_parser("serve", help="serve the client order-book UI")
     s.add_argument("--port", type=int, default=8080)

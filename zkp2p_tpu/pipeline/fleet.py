@@ -175,19 +175,28 @@ def _write_heartbeat(svc, fleet_dir: str, state: Optional[str] = None) -> None:
 
     wid = getattr(svc, "_worker_id", "") or f"pid{os.getpid()}"
     os.makedirs(fleet_dir, exist_ok=True)
-    _atomic_write_json(
-        os.path.join(fleet_dir, wid + ".hb"),
-        {
-            "pid": os.getpid(),
-            "ts": round(time.time(), 3),
-            "worker": wid,
-            "fleet": getattr(svc, "_fleet_id", ""),
-            "state": state or ("draining" if svc.draining else "up"),
-            "port": bound_metrics_port(),
-            "rss_mb": _rss_mb(os.getpid()),
-            "degraded": bool(getattr(svc, "_fleet_degraded", False)),
-        },
-    )
+    hb = {
+        "pid": os.getpid(),
+        "ts": round(time.time(), 3),
+        "worker": wid,
+        "fleet": getattr(svc, "_fleet_id", ""),
+        "state": state or ("draining" if svc.draining else "up"),
+        "port": bound_metrics_port(),
+        "rss_mb": _rss_mb(os.getpid()),
+        "degraded": bool(getattr(svc, "_fleet_degraded", False)),
+    }
+    # serialized SLO window (capped — the heartbeat is written every
+    # ~5 s): the fleet plane's FALLBACK merge source when the worker's
+    # /snapshot scrape fails (port not yet bound, worker mid-restart),
+    # so a scrape gap degrades fleet attainment to slightly-stale
+    # instead of punching a worker-sized hole in it
+    try:
+        from ..utils.slo import default_tracker
+
+        hb["slo_window"] = default_tracker().window_state(max_samples=512)
+    except Exception:  # noqa: BLE001 — the heartbeat must always land
+        pass
+    _atomic_write_json(os.path.join(fleet_dir, wid + ".hb"), hb)
 
 
 def start_heartbeat_thread(svc, fleet_dir: str, interval_s: float = 5.0) -> threading.Event:
@@ -315,6 +324,7 @@ class FleetSupervisor:
         rss_soft_mb: Optional[int] = None,
         rss_hard_mb: Optional[int] = None,
         liveness_s: float = 60.0,
+        fleet_metrics_port: Optional[int] = None,
         log: Callable[[str], None] = lambda m: print(f"[fleet] {m}", flush=True),
     ):
         from ..utils.audit import record_arm
@@ -347,6 +357,14 @@ class FleetSupervisor:
         self._stop = threading.Event()
         self._draining = False
         os.makedirs(self.fleet_dir, exist_ok=True)
+        # fleet observability plane (pipeline.fleet_obs): scrape +
+        # merge + alert + serve, when ZKP2P_FLEET_METRICS_PORT (or the
+        # ctor arg) configures a port.  None = plane off — the PR-10
+        # per-worker ephemeral-port behavior, unchanged.
+        self.fleet_metrics_port = (
+            fleet_metrics_port if fleet_metrics_port is not None else cfg.fleet_metrics_port
+        )
+        self.plane = None
         record_arm("service_fleet", f"supervisor:{self.n}")
         governor_arm()
 
@@ -358,6 +376,17 @@ class FleetSupervisor:
         env["ZKP2P_WORKER_ID"] = slot.wid
         env["ZKP2P_FLEET_ID"] = self.fleet_id
         env["ZKP2P_FLEET_DIR"] = self.fleet_dir
+        # the fleet plane needs scrape targets: when it is on, workers
+        # get auto-bound exposition even if the operator configured none
+        # (the plane without per-worker /snapshot endpoints would be an
+        # aggregator of nothing).  Parse-checked, not setdefault: an
+        # explicitly EMPTY ZKP2P_METRICS_PORT also means exposition off,
+        # and leaving it would strand /status at 503 for the whole run.
+        if self.fleet_metrics_port is not None:
+            from ..utils.config import _opt_port
+
+            if _opt_port(env.get("ZKP2P_METRICS_PORT") or "") is None:
+                env["ZKP2P_METRICS_PORT"] = "auto"
         # N workers cannot share one fixed metrics port: force auto-bind
         # for the children whenever exposition is on at all (the bound
         # port comes back via the heartbeat + run manifest)
@@ -384,6 +413,11 @@ class FleetSupervisor:
         self.log(f"{slot.wid}: up (pid {slot.proc.pid})")
 
     def start(self) -> None:
+        if self.fleet_metrics_port is not None and self.plane is None:
+            from .fleet_obs import FleetPlane
+
+            self.plane = FleetPlane(self, port=self.fleet_metrics_port, log=self.log)
+            self.plane.start()
         for slot in self.slots.values():
             self._spawn(slot)
 
@@ -591,7 +625,17 @@ class FleetSupervisor:
         }
 
     def _write_status(self, _now: float) -> None:
-        _atomic_write_json(os.path.join(self.fleet_dir, "status.json"), self.status())
+        # with the plane on, status.json is the FULL service-health view
+        # (merged SLO, active alerts, scrape health, the plane's bound
+        # port for endpoint discovery) — the same payload /status serves
+        if self.plane is not None:
+            try:
+                status = self.plane.status_payload()
+            except Exception:  # noqa: BLE001 — status must always land
+                status = self.status()
+        else:
+            status = self.status()
+        _atomic_write_json(os.path.join(self.fleet_dir, "status.json"), status)
 
     # ------------------------------------------------------------ drain
 
@@ -676,6 +720,16 @@ class FleetSupervisor:
             self._stop.wait(poll_s)
         clean = self.drain()
         self.tick()
+        if self.plane is not None:
+            # final view into status.json (alert history survives the
+            # exit — a storm that fired mid-run is still on record),
+            # then stop the scrape thread and release the port
+            try:
+                self.plane.scrape_once()
+            except Exception:  # noqa: BLE001
+                pass
+            self._write_status(time.time())
+            self.plane.stop()
         parked = sum(1 for s in self.slots.values() if s.state == "parked")
         if parked:
             self.log(f"{parked} worker(s) parked by the circuit breaker")

@@ -140,6 +140,49 @@ def _lifespan(reqs, name: str, **attrs):
             r.spans.append(dict(rec))
 
 
+def scan_spool(spool: str, now: float, window_s: float, stale_claim_s: float) -> Dict:
+    """One queue-state pass over a spool: arrivals inside the trailing
+    `window_s` (counted BEFORE the terminal skip — a request that
+    arrived and completed inside one window is still offered load),
+    open backlog, the claimable/in-flight split by claim freshness.
+    Shared by the worker TimeseriesSampler and the fleet supervisor's
+    scrape loop (pipeline.fleet_obs) — one definition of "backlog", so
+    the per-worker time-series and the fleet alert signals can never
+    disagree about what the queue looks like.  An unreadable spool
+    degrades to zeros (observation must never raise)."""
+    arrivals = backlog = claimable = in_flight = 0
+    try:
+        names = set(os.listdir(spool))
+    except OSError:
+        return {"arrivals": 0, "backlog": 0, "claimable": 0, "in_flight": 0}
+    for fn in names:
+        if not fn.endswith(".req.json"):
+            continue
+        base = fn[: -len(".req.json")]
+        try:
+            if window_s > 0 and now - os.path.getmtime(os.path.join(spool, fn)) <= window_s:
+                arrivals += 1
+        except OSError:
+            pass
+        if base + ".proof.json" in names or base + ".error.json" in names:
+            continue
+        backlog += 1
+        fresh = False
+        if base + ".claim" in names:
+            try:
+                fresh = now - os.path.getmtime(os.path.join(spool, base + ".claim")) < stale_claim_s
+            except OSError:
+                pass
+        if fresh:
+            in_flight += 1
+        else:
+            claimable += 1
+    return {
+        "arrivals": arrivals, "backlog": backlog,
+        "claimable": claimable, "in_flight": in_flight,
+    }
+
+
 def spool_terminal(spool: str) -> bool:
     """True when every request in `spool` has a terminal artifact —
     the exit condition chaos/fleet/loadgen workers share (an unreadable
@@ -253,42 +296,10 @@ class TimeseriesSampler:
             self._worker_id = self._fleet_id = ""
 
     def _scan(self, spool: str, now: float, window_s: float) -> Dict:
-        arrivals = backlog = claimable = in_flight = 0
-        try:
-            names = set(os.listdir(spool))
-        except OSError:
-            return {"arrivals": 0, "backlog": 0, "claimable": 0, "in_flight": 0}
-        for fn in names:
-            if not fn.endswith(".req.json"):
-                continue
-            base = fn[: -len(".req.json")]
-            # arrivals count BEFORE the terminal skip: a request that
-            # arrived and completed inside one sample window is still
-            # an arrival (at smoke-scale prove times most are), or the
-            # reported arrival_rate_hz would track backlog growth
-            # instead of offered load
-            try:
-                if window_s > 0 and now - os.path.getmtime(os.path.join(spool, fn)) <= window_s:
-                    arrivals += 1
-            except OSError:
-                pass
-            if base + ".proof.json" in names or base + ".error.json" in names:
-                continue
-            backlog += 1
-            fresh = False
-            if base + ".claim" in names:
-                try:
-                    fresh = now - os.path.getmtime(os.path.join(spool, base + ".claim")) < self.stale_claim_s
-                except OSError:
-                    pass
-            if fresh:
-                in_flight += 1
-            else:
-                claimable += 1
-        return {
-            "arrivals": arrivals, "backlog": backlog,
-            "claimable": claimable, "in_flight": in_flight,
-        }
+        # delegates to the module-level scan_spool — the fleet plane's
+        # supervisor scrape uses the same function, so "backlog" means
+        # one thing whether a worker or the supervisor measured it
+        return scan_spool(spool, now, window_s, self.stale_claim_s)
 
     def maybe_sample(self, spool: str, sink: JsonlSink, force: bool = False) -> Optional[Dict]:
         """Sample when the interval elapsed (or `force`); returns the

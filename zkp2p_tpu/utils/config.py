@@ -298,6 +298,27 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "breaker_k": ("ZKP2P_BREAKER_K", _pos_int(5), 5),
     "breaker_window_s": ("ZKP2P_BREAKER_WINDOW_S", _nonneg_float(60.0), 60.0),
     "restart_backoff_s": ("ZKP2P_RESTART_BACKOFF_S", _nonneg_float(0.5), 0.5),
+    # fleet observability plane (pipeline.fleet_obs; docs/OBSERVABILITY
+    # §fleet plane): the supervisor's STABLE aggregated endpoint
+    # (/metrics /status /healthz; unset = plane off, "auto"/"0" =
+    # ephemeral with the bound port in status.json — port semantics
+    # identical to metrics_port), the worker-scrape/merge cadence, and
+    # the fast sub-window for the multi-window burn-rate pair.
+    "fleet_metrics_port": ("ZKP2P_FLEET_METRICS_PORT", _opt_port, None),
+    "fleet_scrape_s": ("ZKP2P_FLEET_SCRAPE_S", _nonneg_float(2.0), 2.0),
+    "slo_fast_window_s": ("ZKP2P_SLO_FAST_WINDOW_S", _nonneg_float(60.0), 60.0),
+    # alert-engine thresholds (utils.alerts; the rule table lives in
+    # docs/OBSERVABILITY.md): burn-rate multiple that pages when BOTH
+    # the fast and slow merged windows exceed it, supervisor restarts
+    # inside the breaker window that count as a storm, how long a
+    # condition must hold to fire (for_s) and how long it must be
+    # clean to clear (clear_s — the hysteresis damper), and the
+    # heartbeat age that counts as a gap.
+    "alert_burn_rate": ("ZKP2P_ALERT_BURN_RATE", _nonneg_float(2.0), 2.0),
+    "alert_restarts": ("ZKP2P_ALERT_RESTARTS", _pos_int(3), 3),
+    "alert_for_s": ("ZKP2P_ALERT_FOR_S", _nonneg_float(5.0), 5.0),
+    "alert_clear_s": ("ZKP2P_ALERT_CLEAR_S", _nonneg_float(30.0), 30.0),
+    "alert_hb_gap_s": ("ZKP2P_ALERT_HB_GAP_S", _nonneg_float(15.0), 15.0),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -359,6 +380,14 @@ class ProverConfig:
     breaker_k: int = 5
     breaker_window_s: float = 60.0
     restart_backoff_s: float = 0.5
+    fleet_metrics_port: Optional[int] = None
+    fleet_scrape_s: float = 2.0
+    slo_fast_window_s: float = 60.0
+    alert_burn_rate: float = 2.0
+    alert_restarts: int = 3
+    alert_for_s: float = 5.0
+    alert_clear_s: float = 30.0
+    alert_hb_gap_s: float = 15.0
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 

@@ -80,6 +80,17 @@ METRIC_HELP: Dict[str, str] = {
     "zkp2p_fleet_worker_rss_bytes": "Per-worker resident-set size at the last governor sample",
     "zkp2p_fleet_watchdog_kills_total": "Hung workers (stale heartbeat, live pid) killed by the supervisor watchdog",
     "zkp2p_fleet_degrade_applied_total": "Governor soft-degrade overlays applied inside a worker",
+    "zkp2p_fleet_scrapes_total": "Fleet-plane scrape cycles completed by the supervisor",
+    "zkp2p_fleet_scrape_failures_total": "Worker snapshot scrapes that failed (counted, never fatal), by worker",
+    "zkp2p_fleet_merge_refusals_total": "Metric families refused during fleet merge (histogram bucket-layout mismatch), by family",
+    "zkp2p_fleet_alerts_total": "Alert FIRE transitions by rule (hysteresis: one inc per episode, not per flap)",
+    "zkp2p_fleet_slo_attainment": "Merged-window fleet SLO attainment (pooled worker samples)",
+    "zkp2p_fleet_slo_burn_fast": "Fleet error-budget burn over the trailing fast window (merged samples)",
+    "zkp2p_fleet_slo_burn_slow": "Fleet error-budget burn over the full merged window",
+    "zkp2p_fleet_slo_window_p95_s": "Exact p95 over the pooled fleet SLO window",
+    "zkp2p_fleet_slo_window_requests": "Samples across every worker's SLO window (sum of window sizes)",
+    "zkp2p_fleet_slo_objective_s": "Configured p95 objective the fleet windows are judged against",
+    "zkp2p_fleet_backlog": "Open spool requests at the last supervisor scrape (supervisor's own scan)",
 }
 
 
@@ -586,6 +597,42 @@ def maybe_start_metrics_server(port: Optional[int] = None, registry: Optional[Re
                     # liveness only: the process is up and serving HTTP.
                     # Readiness (gates armed, SLO state) is /status's job.
                     self._send(200, b'{"ok": true}\n', "application/json")
+                elif path == "/snapshot":
+                    # machine scrape for the FLEET PLANE (docs/
+                    # OBSERVABILITY.md §fleet plane): the raw registry
+                    # snapshot (mergeable — Registry.merge consumes it
+                    # verbatim) plus the serialized SLO window, so the
+                    # supervisor can sum counters, label gauges,
+                    # bucket-merge histograms and pool SLO samples
+                    # instead of re-parsing Prometheus text
+                    publish_native_stats(reg)
+                    try:  # same refresh-where-read contract as /metrics
+                        from .slo import publish_slo
+
+                        publish_slo(reg)
+                    except Exception:  # noqa: BLE001 — exposition only
+                        pass
+                    body: Dict = {
+                        "ts": round(time.time(), 3),
+                        "pid": os.getpid(),
+                        "run_id": run_id(),
+                        "metrics": reg.snapshot(),
+                    }
+                    try:
+                        from .audit import last_preflight
+                        from .config import load_config
+                        from .slo import default_tracker
+
+                        body["armed"] = last_preflight() is not None
+                        body["slo_window"] = default_tracker().window_state()
+                        cfg = load_config()
+                        if cfg.worker_id:
+                            body["worker"] = cfg.worker_id
+                        if cfg.fleet_id:
+                            body["fleet"] = cfg.fleet_id
+                    except Exception:  # noqa: BLE001 — a partial snapshot
+                        pass           # still merges; armed defaults absent
+                    self._send(200, (json.dumps(body) + "\n").encode(), "application/json")
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -21,6 +21,19 @@ Definitions (the standard SRE framing):
               than sustainable the error budget is burning.  1.0 =
               exactly at target; 0 = no misses; >1 = paging territory.
 
+Fleet extension (docs/OBSERVABILITY.md §fleet plane): a worker's
+rolling window SERIALIZES (`window_state()` — samples carried as
+age-relative triples, so two processes with unrelated monotonic clocks
+stay comparable) and N windows MERGE at the supervisor
+(`merge_window_states()`) by pooling the raw samples and recomputing
+attainment/percentiles over the pooled set — merged-sample
+percentiles, never averaged percentiles (the mean of two p95s is not
+any percentile of the fleet).  The merged snapshot also carries
+multi-window burn rates: `burn_fast` over the trailing
+`fast_window_s` slice and `burn_slow` over the full window — the
+standard multi-window burn-rate alerting pair (fast catches a cliff,
+slow suppresses a blip).
+
 Design constraints match utils.metrics: stdlib only, GIL-cheap
 `observe()` (deque append + opportunistic prune), bounded memory
 (window cap, evictions counted), and observation must never fail the
@@ -32,7 +45,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 # Hard cap on samples held regardless of the time window: a runaway
 # arrival burst must not grow the deque unboundedly.  Evictions beyond
@@ -122,6 +135,124 @@ class SloTracker:
             "max_s": round(lats[-1], 6) if lats else 0.0,
             "capped": capped,
         }
+
+    def window_state(self, max_samples: int = 4096, now: Optional[float] = None) -> Dict:
+        """Serializable window for cross-process merging (heartbeats,
+        the worker `/snapshot` route): samples travel as
+        [age_s, latency_s, good] triples — ages, not timestamps,
+        because each worker's monotonic clock has its own epoch and a
+        raw `t` would be meaningless at the supervisor.  Newest-last;
+        when the window exceeds `max_samples` the OLDEST are dropped
+        and counted in `dropped` (n stays the true window size, so the
+        merged fleet sample count still equals the sum of worker
+        windows even when a transport cap trimmed the payload)."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            self._prune(t)
+            samples = list(self._samples)
+            capped = self._capped
+        dropped = max(0, len(samples) - max_samples)
+        kept = samples[dropped:]
+        return {
+            "objective_s": self.objective_s,
+            "target": self.target,
+            "window_s": self.window_s,
+            "n": len(samples),
+            "samples": [
+                [round(max(0.0, t - s[0]), 3), round(s[1], 6), 1 if s[2] else 0]
+                for s in kept
+            ],
+            "dropped": dropped,
+            "capped": capped,
+        }
+
+
+def merge_window_states(
+    states: List[Dict],
+    fast_window_s: float = 60.0,
+    target: Optional[float] = None,
+) -> Dict:
+    """Merge N serialized worker windows into ONE fleet SLO snapshot.
+
+    The merge pools the raw (age, latency, good) samples and recomputes
+    attainment and percentiles over the pooled set — exactly what a
+    single tracker observing every worker's traffic would report
+    (tests pin this against a pooled oracle).  Averaging the workers'
+    snapshots instead would weight an idle worker's vacuous 1.0
+    attainment equally with a drowning worker's 0.5, and the mean of
+    per-worker p95s is not any percentile of anything.
+
+    `n` = sum of the true worker window sizes (including samples a
+    transport cap dropped); percentiles/attainment are computed over
+    the samples that actually arrived (`n_merged`).  Burn rates come in
+    the multi-window pair: `burn_slow` over every pooled sample,
+    `burn_fast` over the trailing `fast_window_s` by age — fast
+    detects a fresh cliff in seconds, slow stops a single blip from
+    paging (utils.alerts fires on the AND of the two)."""
+    states = [s for s in states if s]
+    tgt = target
+    if tgt is None:
+        tgt = max((s.get("target", 0.95) for s in states), default=0.95)
+    if not 0.0 < tgt < 1.0:
+        tgt = 0.95
+    pooled: List[List[float]] = []  # [age_s, latency_s, good]
+    n_true = 0
+    capped = 0
+    objective = 0.0
+    for s in states:
+        pooled.extend(s.get("samples") or [])
+        n_true += int(s.get("n", len(s.get("samples") or [])))
+        capped += int(s.get("capped", 0))
+        objective = max(objective, float(s.get("objective_s", 0.0)))
+
+    def _burn(sub: List[List[float]]) -> Dict:
+        k = len(sub)
+        good = sum(1 for x in sub if x[2])
+        att = (good / k) if k else 1.0
+        return {"n": k, "good": good, "attainment": round(att, 6),
+                "burn": round((1.0 - att) / (1.0 - tgt), 4)}
+
+    full = _burn(pooled)
+    fast = _burn([x for x in pooled if x[0] <= fast_window_s])
+    lats = sorted(x[1] for x in pooled)
+
+    def pct(q: float) -> float:
+        if not lats:
+            return 0.0
+        k = max(0, min(len(lats) - 1, int(round(q * (len(lats) - 1)))))
+        return lats[k]
+
+    return {
+        "objective_p95_s": objective,
+        "target": tgt,
+        "fast_window_s": fast_window_s,
+        "workers": len(states),
+        "n": n_true,
+        "n_merged": full["n"],
+        "good": full["good"],
+        "attainment": full["attainment"],
+        "burn_slow": full["burn"],
+        "burn_fast": fast["burn"],
+        "n_fast": fast["n"],
+        "p50_s": round(pct(0.50), 6),
+        "p95_s": round(pct(0.95), 6),
+        "max_s": round(lats[-1], 6) if lats else 0.0,
+        "capped": capped,
+    }
+
+
+def publish_fleet_slo(snap: Dict, registry=None) -> None:
+    """Mirror a merged fleet snapshot into `zkp2p_fleet_slo_*` gauges
+    (the supervisor's /metrics view of the merged windows)."""
+    from .metrics import REGISTRY
+
+    reg = registry if registry is not None else REGISTRY
+    reg.gauge("zkp2p_fleet_slo_attainment").set(snap["attainment"])
+    reg.gauge("zkp2p_fleet_slo_burn_fast").set(snap["burn_fast"])
+    reg.gauge("zkp2p_fleet_slo_burn_slow").set(snap["burn_slow"])
+    reg.gauge("zkp2p_fleet_slo_window_p95_s").set(snap["p95_s"])
+    reg.gauge("zkp2p_fleet_slo_window_requests").set(snap["n"])
+    reg.gauge("zkp2p_fleet_slo_objective_s").set(snap["objective_p95_s"])
 
 
 # ---------------------------------------------------------------------------
