@@ -6,10 +6,19 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan native-tsan lint test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
+
+# Static invariant checks (tier-1 resident; docs/STATIC_ANALYSIS.md):
+# knob/gate discipline, csrc StatSlot vs STATS_FIELDS ABI drift, metric
+# naming, spool durability, clock rules, and the pyflakes-tier baseline
+# (an installed ruff is grafted on automatically).  Pure AST — runs in
+# seconds with NO native build, NO jax import; exits nonzero on any
+# finding.  This is the pre-commit gate: run it before every push.
+lint:
+	python -m tools.lint
 
 # Sanitizer smoke: build the ASan+UBSan library and run the MSM parity
 # check against it (tests/test_native_asan.py LD_PRELOADs libasan into a
@@ -18,6 +27,18 @@ native:
 native-asan:
 	$(MAKE) -C csrc libzkp2p_native_asan.so
 	env -u PALLAS_AXON_POOL_IPS ZKP2P_RUN_SLOW=1 python -m pytest tests/test_native_asan.py -q
+
+# Race-detector smoke (slow tier; mirrors the native-asan layout): build
+# the TSan-instrumented library and drive the native CONCURRENCY surface
+# — the WorkPool MPMC queue from two submitter threads, the
+# relaxed-atomics stats block under a concurrent reader, pool-parallel
+# NTT stages, segmented matvec and the multi-column MSM at threads=2 —
+# with parity asserts against the host oracle.  Suppressions:
+# csrc/tsan.supp (currently empty; policy in docs/STATIC_ANALYSIS.md).
+# First green run caught a real race: the ifma_enabled plain-int cache.
+native-tsan:
+	$(MAKE) -C csrc libzkp2p_native_tsan.so
+	env -u PALLAS_AXON_POOL_IPS ZKP2P_RUN_SLOW=1 python -m pytest tests/test_native_tsan.py -q
 
 # Observability smoke (fast; also a tier-1 resident): a tiny prove with
 # the JSONL sink + Prometheus endpoint enabled must yield nonzero native
@@ -125,6 +146,8 @@ doctor:
 # touch the tunnel (tests/conftest.py documents the same for subprocesses).
 test:
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -x -q
+	@echo "hint: 'make lint' (static invariants, seconds) and" \
+	  "'make native-asan' / 'make native-tsan' (sanitizer tiers) are separate gates"
 
 # THREE fresh pytest processes, unlimited stack, persistent cache OFF:
 # long single-process runs segfault inside XLA:CPU on the biggest

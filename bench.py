@@ -102,12 +102,12 @@ def build_keys(cs):
         except KeyCacheSchemaError as exc:
             log(f"stale key cache: {exc}")
     log("array-path setup (native fixed-base batches; cached for future runs) ...")
-    t0 = time.time()
+    t0 = time.perf_counter()
     with trace("setup"):
         from zkp2p_tpu.prover.setup_device import setup_device
 
         dpk, vk = setup_device(cs, seed="bench")
-    log(f"setup took {time.time() - t0:.0f}s")
+    log(f"setup took {time.perf_counter() - t0:.0f}s")
     save_dpk(path, dpk, vk, digest=digest)
     return dpk, vk
 
@@ -239,14 +239,14 @@ def _native_fallback_bench(plat: str) -> bool:
         with trace("witness_gen"):
             w = cs.witness(inputs.public_signals, inputs.seed)
         with trace("first_prove_native"):
-            t0 = time.time()
+            t0 = time.perf_counter()
             proof = prove_native(dpk, w)
-            first = time.time() - t0
+            first = time.perf_counter() - t0
         assert verify(vk, proof, inputs.public_signals), "proof failed verification"
         with trace("prove_native"):
-            t0 = time.time()
+            t0 = time.perf_counter()
             prove_native(dpk, w)
-            best = time.time() - t0
+            best = time.perf_counter() - t0
     except Exception:
         import traceback
 
@@ -262,9 +262,9 @@ def _native_fallback_bench(plat: str) -> bool:
     n_steady = int(os.environ.get("BENCH_NATIVE_RUNS", "4"))
     for i in range(n_steady - 1):
         with trace(f"prove_native_{i + 2}"):
-            t0 = time.time()
+            t0 = time.perf_counter()
             prove_native(dpk, w)
-            steady.append(time.time() - t0)
+            steady.append(time.perf_counter() - t0)
     best = min(steady)
     p50 = sorted(steady)[(len(steady) - 1) // 2]
     log(
@@ -311,9 +311,9 @@ def _native_fallback_bench(plat: str) -> bool:
             bt = []
             for i in range(int(os.environ.get("BENCH_NATIVE_BATCH_RUNS", "3"))):
                 with trace(f"prove_native_batch_{i + 1}", batch=batch_n):
-                    t0 = time.time()
+                    t0 = time.perf_counter()
                     prove_native_batch(dpk, [w] * batch_n)
-                    bt.append(time.time() - t0)
+                    bt.append(time.perf_counter() - t0)
             b_best = min(bt)
             b_p50 = sorted(bt)[(len(bt) - 1) // 2]
             log(
@@ -516,13 +516,13 @@ def _cpu_fallback_bench(plat: str):
     pk, vk = setup(cs, seed="bench-cpu")
     dpk = device_pk(pk, cs)
     with trace("first_prove_incl_compile"):
-        t0 = time.time()
+        t0 = time.perf_counter()
         proof = prove_tpu(dpk, w)
-        first = time.time() - t0
+        first = time.perf_counter() - t0
     assert verify(vk, proof, pubs)
-    t0 = time.time()
+    t0 = time.perf_counter()
     prove_tpu(dpk, w)
-    best = time.time() - t0
+    best = time.perf_counter() - t0
     log(f"CPU fallback: amount circuit {cs.num_constraints} constraints, first={first:.1f}s steady={best:.1f}s")
     dump_trace()
     vs = ((1 / best) * cs.num_constraints / BASELINE_CONSTRAINTS) / BASELINE_PROOFS_PER_SEC
@@ -699,11 +699,11 @@ def main():
             pubs.append(inputs.public_signals)
 
     log("warmup (compile) ...")
-    t0 = time.time()
+    t0 = time.perf_counter()
     try:
         with trace("first_batch_incl_compile", batch=BATCH):
             proofs = prove_tpu_batch(dpk, wits)
-        first = time.time() - t0
+        first = time.perf_counter() - t0
         log(f"first batch (incl compile): {first:.1f}s")
         assert verify(vk, proofs[0], pubs[0]), "proof failed verification"
     except Exception:
@@ -734,10 +734,10 @@ def main():
     times = []
     n_runs = int(os.environ.get("BENCH_TIMED_RUNS", "3"))
     for run in range(n_runs):
-        t0 = time.time()
+        t0 = time.perf_counter()
         with trace("prove_batch", run=run, batch=BATCH):
             prove_tpu_batch(dpk, wits)
-        times.append(time.time() - t0)
+        times.append(time.perf_counter() - t0)
     best = min(times)
     proofs_per_sec = BATCH / best
     vs = (proofs_per_sec * cs.num_constraints / BASELINE_CONSTRAINTS) / BASELINE_PROOFS_PER_SEC

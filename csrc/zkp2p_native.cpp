@@ -1252,13 +1252,19 @@ static Ifma52Field &fq52_field() {
 }
 
 static bool ifma_enabled() {
-  static int cached = -1;
-  if (cached < 0) {
+  // atomic, not a plain int: the first call can come from several pool
+  // workers at once (TSan caught the plain-int version racing here).
+  // Both racers compute the same value, so relaxed ordering suffices —
+  // the atomic only removes the UB, not any needed synchronization.
+  static std::atomic<int> cached{-1};
+  int v = cached.load(std::memory_order_relaxed);
+  if (v < 0) {
     const char *e = getenv("ZKP2P_NATIVE_IFMA");
     bool off = e && e[0] == '0';
-    cached = (!off && __builtin_cpu_supports("avx512ifma")) ? 1 : 0;
+    v = (!off && __builtin_cpu_supports("avx512ifma")) ? 1 : 0;
+    cached.store(v, std::memory_order_relaxed);
   }
-  return cached == 1;
+  return v == 1;
 }
 
 // ---- vector kernel: out = a*b*2^-260, lanes independent, in/out < 2p.

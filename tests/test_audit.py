@@ -166,10 +166,10 @@ def test_execution_digest_stable_and_arm_sensitive():
 
 
 def test_record_arm_counters_and_map():
-    base = REGISTRY.counter("zkp2p_path_taken", {"gate": "test_gate", "arm": "x"}).value
+    base = REGISTRY.counter("zkp2p_path_taken_total", {"gate": "test_gate", "arm": "x"}).value
     assert audit.record_arm("test_gate", "x") == "x"
     audit.record_arm("test_gate", "x")
-    assert REGISTRY.counter("zkp2p_path_taken", {"gate": "test_gate", "arm": "x"}).value == base + 2
+    assert REGISTRY.counter("zkp2p_path_taken_total", {"gate": "test_gate", "arm": "x"}).value == base + 2
     assert audit.gate_arms()["test_gate"] == "x"
     # bools render as on/off and pass through unchanged
     assert audit.record_arm("test_gate_b", True) is True
@@ -182,7 +182,7 @@ def test_record_arm_survives_registry_reset():
     audit.record_arm("test_gen_gate", "a")
     REGISTRY.reset()
     audit.record_arm("test_gen_gate", "a")
-    assert REGISTRY.counter("zkp2p_path_taken", {"gate": "test_gen_gate", "arm": "a"}).value == 1
+    assert REGISTRY.counter("zkp2p_path_taken_total", {"gate": "test_gen_gate", "arm": "a"}).value == 1
 
 
 def test_run_manifest_carries_gates_and_digest():

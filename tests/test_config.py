@@ -227,11 +227,13 @@ def test_every_zkp2p_env_read_is_registered():
     (or an explicitly test-scoped variable), so no code path can grow a
     config knob outside the typed config again."""
     registered = {var for var, _p, _d in KNOBS.values()}
-    allowed_extra = {
-        "ZKP2P_RUN_SLOW",   # test-tier gate, read only by the suite
-        "ZKP2P_",           # prefix literals in scanners/docs
-        "ZKP2P_HAVE_IFMA",  # C compile-time macro, not an env knob
-    }
+    # ONE allowlist, shared with the zkp2p-lint knob checker (which runs
+    # this same scan as a tier-1 static pass) — two diverging lists
+    # would let a token pass one gate and fail the other
+    import sys
+
+    sys.path.insert(0, REPO)
+    from tools.lint.knobs import ALLOWED_EXTRA as allowed_extra
     found = set()
     scan_roots = ["zkp2p_tpu", "csrc", "bench.py", "__graft_entry__.py", "tools"]
     for root in scan_roots:

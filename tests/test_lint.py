@@ -1,0 +1,368 @@
+"""The zkp2p-lint checker suite (tools/lint) — tier-1 resident.
+
+Two halves, same discipline the chaos harness applies to its invariant
+checker (docs/ROBUSTNESS.md "checker proven able to fail"):
+
+  1. **Seeded violations**: one fixture per rule, each a minimal tree
+     carrying exactly that violation, asserting the rule FIRES.  A
+     checker that cannot fail proves nothing — this half is what makes
+     the clean-tree half meaningful.
+  2. **Clean tree**: the full linter over the real repo exits with zero
+     findings.  This is the PR gate `make lint` enforces; the fixture
+     half keeps it honest.
+
+Plus the static stats-ABI cross-check that retires the runtime-only
+drift guard's monopoly: the StatSlot enum parsed out of the C++ source
+must mirror STATS_FIELDS even on a host that cannot build the .so.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.lint import run_lint  # noqa: E402
+from tools.lint.core import Tree, run_checkers  # noqa: E402
+
+# Minimal registry anchor every fixture tree carries (the knob checker
+# refuses to run without one — by design).
+CONFIG_PY = '''
+KNOBS = {
+    "msm_glv": ("ZKP2P_MSM_GLV", str, "0"),
+    "faults": ("ZKP2P_FAULTS", str, ""),
+}
+ARMABLE = ("msm_glv",)
+'''
+
+
+def mini_tree(tmp_path, files):
+    """Write a fixture tree ({relpath: source}) and lint it."""
+    base = {"zkp2p_tpu/utils/config.py": CONFIG_PY}
+    base.update(files)
+    for rel, src in base.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return run_checkers(Tree(str(tmp_path)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# 1. seeded violations — every rule proven able to fail
+
+
+def test_knob_registry_fires(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/rogue.py": 'import os\nX = os.environ.get("ZKP2P_BOGUS_KNOB")\n',
+    })
+    assert "knob-registry" in rules_of(fs), fs
+
+
+def test_knob_registry_fires_in_csrc(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/native/lib.py": 'STATS_FIELDS = ()\n',
+        "csrc/zkp2p_native.cpp": (
+            'enum StatSlot { ST_COUNT };\n'
+            'int zkp2p_stats_count(void) { return ST_COUNT; }\n'
+            'void zkp2p_stats_snapshot(long long *o) {}\n'
+            'static bool f() { return getenv("ZKP2P_SECRET_LEVER") != 0; }\n'
+        ),
+    })
+    assert "knob-registry" in rules_of(fs), fs
+
+
+def test_env_read_fires(tmp_path):
+    # a REGISTERED knob read raw outside the sanctioned sites: the
+    # registry rule stays quiet, the read rule must not
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/rogue.py": 'import os\nX = os.environ["ZKP2P_FAULTS"]\n',
+    })
+    assert "env-read" in rules_of(fs), fs
+    assert "knob-registry" not in rules_of(fs), fs
+
+
+def test_env_write_is_transport_not_flagged(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/ok.py": 'import os\nos.environ["ZKP2P_FAULTS"] = "x"\n',
+    })
+    assert "env-read" not in rules_of(fs), fs
+
+
+def test_gate_arm_fires(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/gatey.py": (
+            "def pick(cfg):\n"
+            "    if cfg.msm_glv:\n"
+            "        return 'glv'\n"
+            "    return 'plain'\n"
+        ),
+    })
+    assert "gate-arm" in rules_of(fs), fs
+
+
+def test_gate_arm_satisfied_by_record_arm(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/gatey.py": (
+            "from .utils.audit import record_arm\n"
+            "def pick(cfg):\n"
+            "    return record_arm('glv', cfg.msm_glv)\n"
+        ),
+    })
+    assert "gate-arm" not in rules_of(fs), fs
+
+
+_LIB_OK = 'STATS_FIELDS = (\n    "pool_jobs",\n    "pool_tasks",\n)\n'
+_CPP_OK = (
+    "enum StatSlot {\n  ST_POOL_JOBS = 0,\n  ST_POOL_TASKS,\n  ST_COUNT\n};\n"
+    "int zkp2p_stats_count(void) { return ST_COUNT; }\n"
+    "void zkp2p_stats_snapshot(long long *out) {}\n"
+)
+
+
+def test_abi_clean_mirror_quiet(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/native/lib.py": _LIB_OK,
+        "csrc/zkp2p_native.cpp": _CPP_OK,
+    })
+    assert "abi-drift" not in rules_of(fs) and "abi-export" not in rules_of(fs), fs
+
+
+def test_abi_drift_fires_on_inserted_slot(tmp_path):
+    cpp = _CPP_OK.replace("  ST_POOL_TASKS,", "  ST_POOL_WAIT_NS,\n  ST_POOL_TASKS,")
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/native/lib.py": _LIB_OK,
+        "csrc/zkp2p_native.cpp": cpp,
+    })
+    drift = [f for f in fs if f.rule == "abi-drift"]
+    assert drift and "index 1" in drift[0].msg, fs
+
+
+def test_abi_export_fires(tmp_path):
+    cpp = _CPP_OK.replace("int zkp2p_stats_count(void) { return ST_COUNT; }\n", "")
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/native/lib.py": _LIB_OK,
+        "csrc/zkp2p_native.cpp": cpp,
+    })
+    assert "abi-export" in rules_of(fs), fs
+
+
+def test_metric_name_fires(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/m.py": (
+            "from .utils.metrics import REGISTRY\n"
+            "REGISTRY.counter('zkp2p_widgets')\n"         # counter sans _total
+            "REGISTRY.gauge('zkp2p_depth_total')\n"        # gauge WITH _total
+            "REGISTRY.histogram('zkp2p_lat_ms_bucket')\n"  # reserved suffix
+            "REGISTRY.counter('Widgets_total')\n"          # prefix/charset
+        ),
+    })
+    names = [f for f in fs if f.rule == "metric-name"]
+    assert len(names) >= 4, fs
+
+
+def test_metric_kind_fires(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/m.py": (
+            "from .utils.metrics import REGISTRY\n"
+            "REGISTRY.gauge('zkp2p_depth')\n"
+            "REGISTRY.histogram('zkp2p_depth')\n"
+        ),
+    })
+    assert "metric-kind" in rules_of(fs), fs
+
+
+def test_metric_help_fires_both_directions(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/utils/metrics.py": (
+            'METRIC_HELP = {\n'
+            '    "zkp2p_ghost_total": "documented but never registered",\n'
+            '}\n'
+        ),
+        "zkp2p_tpu/m.py": (
+            "from .utils.metrics import REGISTRY\n"
+            "REGISTRY.counter('zkp2p_undocumented_total')\n"
+        ),
+    })
+    msgs = [f.msg for f in fs if f.rule == "metric-help"]
+    assert any("no METRIC_HELP entry" in m for m in msgs), fs
+    assert any("stale" in m for m in msgs), fs
+
+
+def test_durable_write_fires(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/pipeline/service.py": (
+            "def write_status(path, body):\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write(body)\n"
+        ),
+    })
+    assert "durable-write" in rules_of(fs), fs
+
+
+def test_durable_write_tmp_rename_quiet(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/pipeline/service.py": (
+            "import os\n"
+            "def write_status(path, body):\n"
+            "    tmp = f'{path}.tmp.{os.getpid()}'\n"
+            "    with open(tmp, 'w') as f:\n"
+            "        f.write(body)\n"
+            "    os.replace(tmp, path)\n"
+        ),
+    })
+    assert "durable-write" not in rules_of(fs), fs
+
+
+def test_durable_open_fires(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/pipeline/fleet.py": (
+            "import os\n"
+            "def claim(p):\n"
+            "    return os.open(p, os.O_CREAT | os.O_WRONLY)\n"
+        ),
+    })
+    assert "durable-open" in rules_of(fs), fs
+
+
+def test_clock_span_fires(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/t.py": (
+            "import time\n"
+            "def span():\n"
+            "    t0 = time.time()\n"
+            "    work()\n"
+            "    return time.time() - t0\n"
+        ),
+    })
+    assert "clock-span" in rules_of(fs), fs
+
+
+def test_clock_span_wall_anchor_quiet(tmp_path):
+    # t0 stored as a timestamp too -> cross-process anchor, wall is right
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/t.py": (
+            "import time\n"
+            "def span(rec):\n"
+            "    t0 = time.time()\n"
+            "    rec['t0'] = t0\n"
+            "    rec['ms'] = (time.time() - t0) * 1e3\n"
+        ),
+    })
+    assert "clock-span" not in rules_of(fs), fs
+
+
+def test_clock_mix_fires(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/t.py": (
+            "import time\n"
+            "def bad():\n"
+            "    t0 = time.perf_counter()\n"
+            "    return time.time() - t0\n"
+        ),
+    })
+    assert "clock-mix" in rules_of(fs), fs
+
+
+def test_pyflakes_rules_fire(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/p.py": (
+            "import os\n"                       # unused-import
+            "def f():\n"
+            "    try:\n"
+            "        x = f'nothing here'\n"     # fstring-placeholder
+            "    except:\n"                     # bare-except
+            "        pass\n"
+            "    d = {'a': 1, 'a': 2}\n"        # dict-dup-key
+            "    assert (x, 'msg')\n"           # assert-tuple
+            "    return d\n"
+        ),
+    })
+    got = rules_of(fs)
+    for rule in ("unused-import", "fstring-placeholder", "bare-except",
+                 "dict-dup-key", "assert-tuple"):
+        assert rule in got, (rule, fs)
+
+
+def test_unused_import_reexport_exempt(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/a.py": "from .b import helper\n",   # unused here...
+        "zkp2p_tpu/b.py": "def helper():\n    pass\n",
+        "zkp2p_tpu/c.py": "from .a import helper\nX = helper\n",  # ...but re-exported
+    })
+    assert "unused-import" not in rules_of(fs), fs
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    fs = mini_tree(tmp_path, {"zkp2p_tpu/broken.py": "def f(:\n"})
+    assert "syntax" in rules_of(fs), fs
+
+
+def test_inline_waiver_suppresses(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/t.py": (
+            "import time\n"
+            "def span():\n"
+            "    t0 = time.time()  # lint: allow[clock-span] oracle needs wall\n"
+            "    return time.time() - t0\n"
+        ),
+    })
+    assert "clock-span" not in rules_of(fs), fs
+
+
+# ---------------------------------------------------------------------------
+# 2. the real tree
+
+
+def test_clean_tree_and_budget():
+    t0 = time.perf_counter()
+    findings = run_lint(REPO)
+    dt = time.perf_counter() - t0
+    assert findings == [], "\n".join(str(f) for f in findings)
+    # the acceptance budget is 30 s WITHOUT building the native library;
+    # leave headroom for slower hosts but catch a quadratic regression
+    assert dt < 30, f"lint took {dt:.1f}s — budget is 30s"
+
+
+def test_stats_abi_static_mirror():
+    """The satellite-6 guard: StatSlot == STATS_FIELDS proven from
+    SOURCE, so the drift invariant holds even where the .so cannot
+    build (the runtime test in test_metrics.py silently skips there)."""
+    from tools.lint.abi import parse_enum, parse_stats_fields
+
+    tree = Tree(REPO)
+    _line, slots = parse_enum(tree.c_files["csrc/zkp2p_native.cpp"])
+    _pline, fields = parse_stats_fields(tree.files["zkp2p_tpu/native/lib.py"])
+    assert slots, "enum StatSlot not parseable"
+    assert fields, "STATS_FIELDS not parseable"
+    assert [s[len("ST_"):].lower() for s in slots] == list(fields)
+    # and the count export is the verbatim ST_COUNT return
+    assert not [f for f in run_lint(REPO, rules=["abi-export"])]
+
+
+def test_cli_lint_subcommand_fast():
+    """`zkp2p-tpu lint` must answer without importing jax or building
+    the .so — it is the pre-commit path."""
+    import subprocess
+    import sys as _sys
+
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [_sys.executable, "-m", "tools.lint"], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    dt = time.perf_counter() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stderr
+    assert dt < 30, f"CLI lint took {dt:.1f}s"
+
+
+def test_rule_filter_and_json():
+    fs = run_lint(REPO, rules=["abi-drift", "abi-export"])
+    assert fs == []

@@ -54,7 +54,7 @@ def main():
     bases = g1_to_affine_arrays(pts)
     for w in (4, 8):
         mags, negs = jmsm.signed_digit_planes_from_limbs(limbs(scalars), w)
-        t0 = time.time()
+        t0 = time.perf_counter()
         got = g1_jac_to_host(
             jax.jit(lambda b, m, s, w=w: msm_windowed_affine(G1J, b, m, s, lanes=512, window=w))(
                 bases, mags, negs
@@ -66,7 +66,7 @@ def main():
             )
         )[0]
         ok = got == want
-        print(f"correctness w={w}: {'OK' if ok else 'MISMATCH'} ({time.time()-t0:.1f}s incl compile)", flush=True)
+        print(f"correctness w={w}: {'OK' if ok else 'MISMATCH'} ({time.perf_counter()-t0:.1f}s incl compile)", flush=True)
         if not ok:
             print("AFFINE TIER MISCOMPARES ON HARDWARE — do not arm", flush=True)
             return 1
@@ -107,15 +107,15 @@ def main():
     aff = jax.jit(lambda b, m, s: msm_windowed_affine(G1J, b, m, s, lanes=4096, window=w))
     jac = jax.jit(lambda b, m, s: jmsm.msm_windowed_signed(G1J, b, m, s, lanes=4096, window=w))
     for name, fn in (("jacobian", jac), ("affine", aff)):
-        t0 = time.time()
+        t0 = time.perf_counter()
         r = fn(bases, mags, negs)
         jax.block_until_ready(r)
-        compile_s = time.time() - t0
+        compile_s = time.perf_counter() - t0
         ts = []
         for _ in range(3):
-            t0 = time.time()
+            t0 = time.perf_counter()
             jax.block_until_ready(fn(bases, mags, negs))
-            ts.append(time.time() - t0)
+            ts.append(time.perf_counter() - t0)
         best = min(ts)
         print(
             f"{name}: first={compile_s:.1f}s steady={best:.3f}s -> {n/best/1e6:.3f} M pts/s",
@@ -132,7 +132,7 @@ def main():
     sc_b[7] = 0
     bases_b = g1_to_affine_arrays(pts_b)
     mags8, negs8 = jmsm.signed_digit_planes_from_limbs(limbs(sc_b), 8)
-    t0 = time.time()
+    t0 = time.perf_counter()
     got = g1_jac_to_host(
         jax.jit(lambda b, m, s: msm_bucket_affine(G1J, b, m, s, window=8))(bases_b, mags8, negs8)
     )[0]
@@ -142,21 +142,21 @@ def main():
         )
     )[0]
     ok = got == want
-    print(f"bucket correctness w=8: {'OK' if ok else 'MISMATCH'} ({time.time()-t0:.1f}s incl compile)", flush=True)
+    print(f"bucket correctness w=8: {'OK' if ok else 'MISMATCH'} ({time.perf_counter()-t0:.1f}s incl compile)", flush=True)
     if not ok:
         print("BUCKET TIER MISCOMPARES ON HARDWARE — do not arm", flush=True)
         return 1
 
     mags16, negs16 = jmsm.signed_digit_planes_from_limbs(limbs(scalars), 16)
     bkt = jax.jit(lambda b, m, s: msm_bucket_affine(G1J, b, m, s, window=16))
-    t0 = time.time()
+    t0 = time.perf_counter()
     jax.block_until_ready(bkt(bases, mags16, negs16))
-    compile_s = time.time() - t0
+    compile_s = time.perf_counter() - t0
     ts = []
     for _ in range(3):
-        t0 = time.time()
+        t0 = time.perf_counter()
         jax.block_until_ready(bkt(bases, mags16, negs16))
-        ts.append(time.time() - t0)
+        ts.append(time.perf_counter() - t0)
     best = min(ts)
     print(
         f"bucket w=16: first={compile_s:.1f}s steady={best:.3f}s -> {n/best/1e6:.3f} M pts/s",

@@ -70,12 +70,12 @@ def main():
             return 2
 
     timing = {}
-    t = time.time()
+    t = time.perf_counter()
     log("loading device key (npz -> host arrays)")
     dpk, vk = load_dpk(key_path)
-    timing["load_key_s"] = round(time.time() - t, 1)
+    timing["load_key_s"] = round(time.perf_counter() - t, 1)
 
-    t = time.time()
+    t = time.perf_counter()
     z = np.load(wit_path)
     # (n, 4) u64 standard-form limbs — witness_to_device's vectorized
     # fast path consumes this directly (no Python bigint loop).
@@ -83,27 +83,27 @@ def main():
     pubs = [
         sum(int(limb) << (64 * i) for i, limb in enumerate(row)) for row in z["pubs"]
     ]
-    timing["load_witness_s"] = round(time.time() - t, 1)
+    timing["load_witness_s"] = round(time.perf_counter() - t, 1)
     log(f"witness loaded ({w.shape[0]} wires) in {timing['load_witness_s']}s")
 
     # Deterministic (r, s) so the proof is byte-comparable to the native
     # run's committed artifact (same contract as prove_native there).
-    t = time.time()
+    t = time.perf_counter()
     log("prove_tpu (first call: key transfer + compile + prove) ...")
     with trace("fullsize_tpu_first"):
         proof = prove_tpu(dpk, w, r=123456789, s=987654321)
-    timing["first_prove_incl_compile_s"] = round(time.time() - t, 1)
+    timing["first_prove_incl_compile_s"] = round(time.perf_counter() - t, 1)
     log(f"first prove (incl compile/transfer): {timing['first_prove_incl_compile_s']}s")
 
-    t = time.time()
+    t = time.perf_counter()
     assert verify(vk, proof, pubs), "full-size TPU proof failed pairing verification"
-    timing["verify_s"] = round(time.time() - t, 1)
+    timing["verify_s"] = round(time.perf_counter() - t, 1)
     log("pairing verified")
 
-    t = time.time()
+    t = time.perf_counter()
     with trace("fullsize_tpu_steady"):
         proof2 = prove_tpu(dpk, w, r=123456789, s=987654321)
-    timing["steady_prove_s"] = round(time.time() - t, 1)
+    timing["steady_prove_s"] = round(time.perf_counter() - t, 1)
     assert proof2 == proof, "determinism: same (witness, r, s) must re-emit the same proof"
     log(f"steady-state prove: {timing['steady_prove_s']}s")
 

@@ -1,0 +1,52 @@
+"""zkp2p-lint: the repo's invariants, enforced statically.
+
+Entry points:
+    python -m tools.lint          (from the repo root; what `make lint` runs)
+    python -m zkp2p_tpu lint      (the CLI wrapper)
+
+See docs/STATIC_ANALYSIS.md for the rule table — every rule encodes a
+bug this repo has already shipped (or nearly shipped) once.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from .core import REPO, Finding, Tree, run_checkers
+
+__all__ = ["main", "run_lint", "Tree", "Finding", "run_checkers"]
+
+
+def run_lint(root: str = REPO, rules: Optional[List[str]] = None) -> List[Finding]:
+    return run_checkers(Tree(root), rules=rules)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zkp2p-tpu lint",
+        description="static invariant checks (knobs, gates, ABI, metrics, "
+        "durability, clocks, pyflakes-tier) — docs/STATIC_ANALYSIS.md",
+    )
+    ap.add_argument("--root", default=REPO, help="tree to lint (default: this repo)")
+    ap.add_argument("--rules", default="", help="comma-separated rule filter")
+    ap.add_argument("--json", action="store_true", help="machine-readable findings")
+    args = ap.parse_args(argv)
+
+    t0 = time.perf_counter()
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    tree = Tree(args.root)
+    findings = run_checkers(tree, rules=rules)
+    dt = time.perf_counter() - t0
+    if args.json:
+        import json
+
+        print(json.dumps([f.__dict__ for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f)
+        status = "clean" if not findings else f"{len(findings)} finding(s)"
+        print(f"zkp2p-lint: {status} across {len(tree.files)} files in {dt:.2f}s", file=sys.stderr)
+    return 1 if findings else 0

@@ -1,0 +1,107 @@
+"""Cross-language ABI drift: csrc StatSlot vs native/lib.py STATS_FIELDS.
+
+Rules (historical risk they encode — docs/STATIC_ANALYSIS.md):
+
+  abi-drift    the `enum StatSlot` parsed out of csrc/zkp2p_native.cpp
+               must mirror native/lib.py's STATS_FIELDS tuple EXACTLY —
+               same count, same order, each ST_<NAME> lowercasing to the
+               Python field name.  Index i on the Python side reads
+               g_stats[i] on the C side; one inserted slot silently
+               shifts every counter after it (pool_wait_ns becomes
+               pool_run_ns and every derived rate lies).  The runtime
+               guard (zkp2p_stats_count() == len(STATS_FIELDS), pinned
+               in tests/test_metrics.py) only runs when the .so builds;
+               this check holds on a toolchain-less tree too.
+
+  abi-export   the C side must export `zkp2p_stats_count` returning
+               ST_COUNT and `zkp2p_stats_snapshot` looping to ST_COUNT —
+               the two symbols the ctypes bridge version-skew logic
+               (native/lib.py stats_snapshot) depends on.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from .core import Finding, Tree, str_const
+
+CPP = "csrc/zkp2p_native.cpp"
+LIB = "zkp2p_tpu/native/lib.py"
+
+_ENUM_RE = re.compile(r"enum\s+StatSlot\s*\{(.*?)\}\s*;", re.S)
+_ENTRY_RE = re.compile(r"^\s*(ST_[A-Z0-9_]+)", re.M)
+
+
+def parse_enum(text: str) -> Tuple[Optional[int], List[str]]:
+    """(line of the enum, ordered ST_* names minus ST_COUNT)."""
+    m = _ENUM_RE.search(text)
+    if not m:
+        return None, []
+    line = text[: m.start()].count("\n") + 1
+    entries = [e for e in _ENTRY_RE.findall(m.group(1)) if e != "ST_COUNT"]
+    return line, entries
+
+
+def parse_stats_fields(sf) -> Tuple[Optional[int], List[str]]:
+    """(line, entries) of the STATS_FIELDS tuple from lib.py's AST."""
+    if sf is None or sf.tree is None:
+        return None, []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "STATS_FIELDS" and isinstance(node.value, (ast.Tuple, ast.List)):
+                fields = [s for s in (str_const(e) for e in node.value.elts) if s]
+                return node.lineno, fields
+    return None, []
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    cpp = tree.c_files.get(CPP)
+    sf = tree.files.get(LIB)
+    if cpp is None and sf is None:
+        return findings  # no native layer in this tree — nothing to drift
+    if cpp is None or sf is None:
+        findings.append(Finding("abi-drift", CPP if cpp is None else LIB, 1,
+                                "stats ABI source missing — cannot verify StatSlot mirror"))
+        return findings
+
+    enum_line, slots = parse_enum(cpp)
+    py_line, fields = parse_stats_fields(sf)
+    if enum_line is None:
+        findings.append(Finding("abi-drift", CPP, 1, "enum StatSlot not found"))
+    if py_line is None:
+        findings.append(Finding("abi-drift", LIB, 1, "STATS_FIELDS tuple not found"))
+    if enum_line is not None and py_line is not None:
+        mirrored = [s[len("ST_"):].lower() for s in slots]
+        if mirrored != list(fields):
+            # name the first divergent index — that is where every later
+            # counter starts lying
+            n = min(len(mirrored), len(fields))
+            at = next((i for i in range(n) if mirrored[i] != fields[i]), n)
+            cpp_at = mirrored[at] if at < len(mirrored) else "<missing>"
+            py_at = fields[at] if at < len(fields) else "<missing>"
+            findings.append(Finding(
+                "abi-drift", LIB, py_line,
+                f"STATS_FIELDS diverges from csrc enum StatSlot at index {at}: "
+                f"C says {cpp_at!r}, Python says {py_at!r} "
+                f"(C has {len(mirrored)} slots, Python {len(fields)}) — every slot "
+                "from there on reads the wrong counter",
+            ))
+
+    # exports the ctypes bridge's version-skew logic relies on
+    if not re.search(r"zkp2p_stats_count\s*\(\s*void\s*\)\s*\{\s*return\s+ST_COUNT\s*;", cpp):
+        findings.append(Finding(
+            "abi-export", CPP, enum_line or 1,
+            "zkp2p_stats_count export must return ST_COUNT verbatim — it is the "
+            "runtime drift guard the ctypes bridge sizes its read buffer by",
+        ))
+    if "zkp2p_stats_snapshot" not in cpp:
+        findings.append(Finding(
+            "abi-export", CPP, enum_line or 1,
+            "zkp2p_stats_snapshot export missing — stats_snapshot() would "
+            "AttributeError instead of degrading",
+        ))
+    return findings

@@ -1,0 +1,99 @@
+"""Clock discipline: wall time is for timestamps, perf_counter for spans.
+
+trace.py's rule (its spans use time.perf_counter; its manifests stamp
+time.time): wall clock is CORRECT for anything compared across
+processes — request arrival anchors, claim-file mtimes, deadlines — and
+WRONG for measuring an in-process duration, where an NTP step or a
+suspend/resume silently corrupts the reading.  Two rules:
+
+  clock-span    a local variable assigned from time.time() whose ONLY
+                use is as the subtrahend of a subtraction (the
+                `t0 = time.time(); ... time.time() - t0` span idiom) is
+                a wall-clock span: use time.perf_counter().  A t0 that
+                is ALSO stored/passed/compared is a cross-process
+                timestamp anchor and stays wall-clock by design (the
+                service waterfall records both the anchor and the
+                elapsed, so its wall-wall subtraction is deliberate).
+
+  clock-mix     subtracting across the two clocks (a perf_counter
+                reading minus a time.time() reading, either direction,
+                direct or via locals) is meaningless in every case.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import Finding, Tree, call_name, functions_of, parent_map
+
+_WALL = ("time.time",)
+_PERF = ("time.perf_counter", "time.monotonic")
+
+
+def _clock_of_call(node) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _WALL:
+            return "wall"
+        if name in _PERF:
+            return "perf"
+    return None
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in tree.py_files():
+        if sf.tree is None:
+            continue
+        parents = parent_map(sf.tree)
+        for fn in functions_of(sf.tree):
+            clock_vars: Dict[str, str] = {}  # local name -> "wall"|"perf"
+            assigns: Dict[str, int] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    c = _clock_of_call(node.value)
+                    if isinstance(t, ast.Name) and c:
+                        clock_vars[t.id] = c
+                        assigns[t.id] = node.lineno
+
+            def clock_of(expr) -> Optional[str]:
+                c = _clock_of_call(expr)
+                if c:
+                    return c
+                if isinstance(expr, ast.Name):
+                    return clock_vars.get(expr.id)
+                return None
+
+            # clock-mix: any subtraction across clock families
+            for node in ast.walk(fn):
+                if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                    lc, rc = clock_of(node.left), clock_of(node.right)
+                    if lc and rc and lc != rc:
+                        findings.append(Finding(
+                            "clock-mix", sf.relpath, node.lineno,
+                            f"subtraction mixes {lc} and {rc} clocks — the result "
+                            "is meaningless on every host",
+                        ))
+
+            # clock-span: wall-assigned locals used only as subtrahends
+            for var, clock in clock_vars.items():
+                if clock != "wall":
+                    continue
+                only_sub, used = True, False
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Name) and node.id == var and isinstance(node.ctx, ast.Load):
+                        used = True
+                        p = parents.get(node)
+                        if not (isinstance(p, ast.BinOp) and isinstance(p.op, ast.Sub) and p.right is node):
+                            only_sub = False
+                            break
+                if used and only_sub:
+                    findings.append(Finding(
+                        "clock-span", sf.relpath, assigns[var],
+                        f"{var} = time.time() is used only to measure an in-process "
+                        "span — use time.perf_counter() (trace.py clock rule; an "
+                        "NTP step mid-span corrupts wall-clock durations)",
+                    ))
+    return findings

@@ -1,0 +1,226 @@
+"""zkp2p-lint core: finding model, source walking, waivers, the runner.
+
+The checkers in this package encode invariants the repo already bled
+for (each rule's docstring names the historical bug it fossilizes —
+docs/STATIC_ANALYSIS.md carries the full table).  Design constraints:
+
+  * **No imports of the checked code.**  Everything is AST/regex over
+    source text, so `make lint` runs in seconds on a box with no
+    toolchain, no jax, and no built `.so` — the ABI-drift checker in
+    particular must work when the native library cannot build.
+  * **Zero findings on a healthy tree.**  A rule that cries wolf gets
+    deleted; anything intentionally exempt carries an inline waiver
+    (`# lint: allow[<rule>] <reason>`) or a named sanction in the
+    checker itself, so every exception is greppable and justified.
+  * **Provably able to fail.**  tests/test_lint.py seeds one violation
+    per rule and asserts the checker reports it — the same "checker
+    proven able to fail" discipline the chaos harness applies to its
+    invariants (docs/ROBUSTNESS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Python source the domain checkers (knobs/gates/metrics/durability/
+# clocks) police.  tools/lint itself is excluded everywhere: the scanner
+# necessarily contains the patterns it hunts.
+PY_SCAN_ROOTS = ("zkp2p_tpu", "tools", "bench.py", "__graft_entry__.py")
+EXCLUDE_DIRS = ("tools/lint",)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    msg: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+class SourceFile:
+    """One parsed source file: text, line list, AST (py only), waivers."""
+
+    _WAIVER_RE = re.compile(r"#\s*lint:\s*allow\[([a-z0-9\-,\s]+)\]")
+
+    def __init__(self, relpath: str, text: str):
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        if relpath.endswith(".py"):
+            try:
+                self.tree = ast.parse(text)
+            except SyntaxError as e:
+                self.parse_error = f"{e.msg} (line {e.lineno})"
+        # line -> set of waived rule names
+        self.waivers: Dict[int, set] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = self._WAIVER_RE.search(ln)
+            if m:
+                self.waivers[i] = {r.strip() for r in m.group(1).split(",")}
+
+    def waived(self, rule: str, line: int) -> bool:
+        return rule in self.waivers.get(line, ())
+
+
+class Tree:
+    """The lint target: every scanned file, parsed once, shared by all
+    checkers (the AST cache is what keeps the whole pass under seconds)."""
+
+    def __init__(self, root: str = REPO, roots: Iterable[str] = PY_SCAN_ROOTS):
+        self.root = root
+        self.files: Dict[str, SourceFile] = {}
+        for r in roots:
+            path = os.path.join(root, r)
+            if os.path.isfile(path):
+                self._add(r)
+            elif os.path.isdir(path):
+                for dirpath, dirs, names in os.walk(path):
+                    rel_dir = os.path.relpath(dirpath, root)
+                    if any(rel_dir == e or rel_dir.startswith(e + os.sep) for e in EXCLUDE_DIRS):
+                        dirs[:] = []
+                        continue
+                    for n in sorted(names):
+                        if n.endswith(".py"):
+                            self._add(os.path.join(rel_dir, n))
+        # C sources are scanned by regex only (getenv sites, StatSlot)
+        self.c_files: Dict[str, str] = {}
+        csrc = os.path.join(root, "csrc")
+        if os.path.isdir(csrc):
+            for n in sorted(os.listdir(csrc)):
+                if n.endswith((".cpp", ".cc", ".h")):
+                    with open(os.path.join(csrc, n), errors="ignore") as f:
+                        self.c_files[os.path.join("csrc", n)] = f.read()
+
+    def _add(self, rel: str) -> None:
+        with open(os.path.join(self.root, rel), errors="ignore") as f:
+            self.files[rel] = SourceFile(rel, f.read())
+
+    def py_files(self) -> List[SourceFile]:
+        return list(self.files.values())
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('os.environ.get', 'record_arm')."""
+    parts: List[str] = []
+    n = node.func
+    while isinstance(n, ast.Attribute):
+        parts.append(n.attr)
+        n = n.value
+    if isinstance(n, ast.Name):
+        parts.append(n.id)
+    return ".".join(reversed(parts))
+
+
+def str_const(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def functions_of(tree: ast.AST):
+    """Every function/method definition (nested included)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def parse_config_registry(tree_obj: "Tree") -> Tuple[Dict[str, str], Tuple[str, ...]]:
+    """(knob attr -> env var) and the ARMABLE tuple, read from
+    utils/config.py WITHOUT importing it (the linter must run on a tree
+    whose imports are broken — that is exactly when it is most useful)."""
+    sf = tree_obj.files.get(os.path.join("zkp2p_tpu", "utils", "config.py"))
+    knobs: Dict[str, str] = {}
+    armable: Tuple[str, ...] = ()
+    if sf is None or sf.tree is None:
+        return knobs, armable
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            t, value = node.target, node.value
+            if isinstance(t, ast.Name) and t.id == "KNOBS" and isinstance(value, ast.Dict):
+                for k, v in zip(value.keys, value.values):
+                    attr = str_const(k)
+                    if attr is None or not isinstance(v, ast.Tuple) or not v.elts:
+                        continue
+                    var = str_const(v.elts[0])
+                    if var:
+                        knobs[attr] = var
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "KNOBS" and isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    attr = str_const(k)
+                    if attr is None or not isinstance(v, ast.Tuple) or not v.elts:
+                        continue
+                    var = str_const(v.elts[0])
+                    if var:
+                        knobs[attr] = var
+            elif isinstance(t, ast.Name) and t.id == "ARMABLE" and isinstance(node.value, ast.Tuple):
+                armable = tuple(s for s in (str_const(e) for e in node.value.elts) if s)
+    return knobs, armable
+
+
+# ---------------------------------------------------------------------------
+# Runner
+
+
+def run_checkers(tree: Tree, rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    from . import abi, clocks, durability, gates, knobs, metric_names, pyflakes_lite
+
+    checkers = [
+        knobs.check,
+        gates.check,
+        abi.check,
+        metric_names.check,
+        durability.check,
+        clocks.check,
+        pyflakes_lite.check,
+    ]
+    findings: List[Finding] = []
+    for c in checkers:
+        findings.extend(c(tree))
+    # a file that does not parse is itself a finding — every other
+    # checker silently skipped it, and silence is the failure mode this
+    # tool exists to kill
+    for sf in tree.py_files():
+        if sf.parse_error:
+            findings.append(Finding("syntax", sf.relpath, 1, f"unparseable: {sf.parse_error}"))
+    if rules:
+        want = set(rules)
+        findings = [f for f in findings if f.rule in want]
+    # drop waived findings (inline `# lint: allow[rule] reason`) and
+    # dedupe (nested functions can surface one site twice)
+    out = []
+    seen = set()
+    for f in findings:
+        sf = tree.files.get(f.path)
+        if sf is not None and sf.waived(f.rule, f.line):
+            continue
+        key = (f.rule, f.path, f.line, f.msg)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))

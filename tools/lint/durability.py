@@ -1,0 +1,119 @@
+"""Durability discipline for the spool/fleet state machine.
+
+Rules (historical bug they encode — docs/STATIC_ANALYSIS.md):
+
+  durable-write   in zkp2p_tpu/pipeline/*, a truncating `open(path,
+                  "w"/"wb")` is only legal when the enclosing function
+                  also renames the result into place (os.replace /
+                  os.rename — the tmp+rename idiom `_atomic_write`
+                  uses) or the path itself is a `.tmp` staging name.
+                  A bare truncating write on a status/claim/heartbeat
+                  path is the takeover-protocol bug waiting to happen:
+                  a reader (a peer worker deciding whether to steal a
+                  claim, the supervisor reading status.json) can see a
+                  half-written or empty file and act on it.
+
+  durable-open    `os.open` with O_WRONLY/O_RDWR in the same modules
+                  must carry O_EXCL (the claim-file create-or-lose
+                  protocol) or O_APPEND (the JSONL sink contract:
+                  one atomic append per record) — a bare O_CREAT|
+                  O_WRONLY silently truncates-and-races the same way.
+
+`os.fdopen` over an already-O_EXCL fd is exempt (the fd carries the
+atomicity); read-mode opens are exempt everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, Tree, call_name, functions_of, str_const
+
+# the spool/fleet state-machine modules — the files whose writes have
+# concurrent readers applying the takeover/heartbeat/status protocols.
+# cli.py's one-shot build artifacts (verifier.sol, proof.json) have no
+# concurrent reader and stay out of scope.
+SCOPE = (
+    "zkp2p_tpu/pipeline/service.py",
+    "zkp2p_tpu/pipeline/fleet.py",
+    "zkp2p_tpu/pipeline/fleet_obs.py",
+)
+_RENAMERS = {"os.replace", "os.rename", "replace", "rename"}
+
+
+def _mode_of(call: ast.Call):
+    if len(call.args) >= 2:
+        return str_const(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            return str_const(kw.value)
+    return None
+
+
+def _flag_names(expr) -> set:
+    """All os.O_* attribute names in a flags expression."""
+    out = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr.startswith("O_"):
+            out.add(node.attr)
+    return out
+
+
+def _path_is_tmp(fn: ast.AST, arg) -> bool:
+    """True when the written path is visibly a .tmp staging name: a
+    literal/f-string containing '.tmp', or a local Name assigned from
+    one inside the same function."""
+    def expr_tmp(e) -> bool:
+        for node in ast.walk(e):
+            s = str_const(node)
+            if s and ".tmp" in s:
+                return True
+        return False
+
+    if expr_tmp(arg):
+        return True
+    if isinstance(arg, ast.Name):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == arg.id and expr_tmp(node.value):
+                        return True
+    return False
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in tree.py_files():
+        if sf.relpath not in SCOPE or sf.tree is None:
+            continue
+        for fn in functions_of(sf.tree):
+            renames = any(
+                isinstance(n, ast.Call) and call_name(n) in _RENAMERS
+                for n in ast.walk(fn)
+            )
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name == "open" and node.args:
+                    mode = _mode_of(node)
+                    if mode and "w" in mode and not renames and not _path_is_tmp(fn, node.args[0]):
+                        findings.append(Finding(
+                            "durable-write", sf.relpath, node.lineno,
+                            f"truncating open(..., {mode!r}) in {fn.name}() without "
+                            "tmp+rename — a concurrent reader can observe a torn "
+                            "file (spool/fleet durability contract)",
+                        ))
+                elif name in ("os.open",) and len(node.args) >= 2:
+                    flags = _flag_names(node.args[1])
+                    if ("O_WRONLY" in flags or "O_RDWR" in flags) and not (
+                        "O_EXCL" in flags or "O_APPEND" in flags
+                    ):
+                        findings.append(Finding(
+                            "durable-open", sf.relpath, node.lineno,
+                            f"os.open with {'|'.join(sorted(flags))} in {fn.name}() "
+                            "needs O_EXCL (claim protocol) or O_APPEND (JSONL "
+                            "contract) — bare write flags truncate-and-race",
+                        ))
+    return findings

@@ -1,0 +1,62 @@
+"""Gate discipline: a branch on an armable knob must record its arm.
+
+Rule (historical bug it encodes — docs/STATIC_ANALYSIS.md):
+
+  gate-arm   any function in zkp2p_tpu/ that references an ARMABLE
+             config attribute (cfg.msm_glv, load_config().ntt_pool, ...)
+             must also call audit.record_arm — otherwise a knob flip
+             changes the executed code path while the execution digest
+             stays identical.  That is the round-2 silent-disarm bug
+             class: `default_backend() == "tpu"` gates quietly armed
+             "off" for three rounds with nothing in any artifact to
+             show it.  Two digest-equal runs must be PROVABLY the same
+             code path, so every armable consultation records itself
+             (directly, or by being resolved inside a *_arm/_use_*
+             resolver that does).
+
+Module-level snapshot constants (`MSM_GLV = _CFG.msm_glv` in
+groth16_tpu) are exempt: their jit-time consumers resolve through
+record_arm-bearing resolver functions, and the constant assignment
+itself takes no branch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, Tree, call_name, functions_of, parse_config_registry
+
+_RECORDERS = ("record_arm", "_record_arm")
+
+
+def check(tree: Tree) -> List[Finding]:
+    _knobs, armable = parse_config_registry(tree)
+    armable_set = set(armable)
+    findings: List[Finding] = []
+    if not armable_set:
+        return findings
+    for sf in tree.py_files():
+        if not sf.relpath.startswith("zkp2p_tpu/") or sf.tree is None:
+            continue
+        if sf.relpath.endswith(("utils/config.py", "utils/audit.py")):
+            # config defines the knobs; audit's doctor COMPARES config
+            # to recorded arms (mis-arm warnings) without taking a path
+            continue
+        for fn in functions_of(sf.tree):
+            refs = []
+            records = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) and node.attr in armable_set and isinstance(node.ctx, ast.Load):
+                    refs.append(node)
+                elif isinstance(node, ast.Call) and call_name(node).split(".")[-1] in _RECORDERS:
+                    records = True
+            if refs and not records:
+                for r in refs:
+                    findings.append(Finding(
+                        "gate-arm", sf.relpath, r.lineno,
+                        f"function {fn.name}() branches on armable knob .{r.attr} "
+                        "without a record_arm call — the arm is invisible to the "
+                        "execution digest (round-2 silent-disarm class)",
+                    ))
+    return findings

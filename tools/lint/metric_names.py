@@ -1,0 +1,149 @@
+"""Metric naming discipline: one namespace, Prometheus-conventional.
+
+Rules (docs/STATIC_ANALYSIS.md):
+
+  metric-name   every literal family registered via .counter/.gauge/
+                .histogram must match ^zkp2p_[a-z0-9_]+$; counters must
+                end `_total` (Prometheus counter convention — scrapers
+                and the fleet merge both key on it), non-counters must
+                NOT end `_total` (the fleet plane SUMS `_total` families
+                across workers; a gauge named like a counter would be
+                summed into nonsense), and no family may end in the
+                exposition-reserved `_bucket`/`_sum`/`_count`/`_info`.
+
+  metric-kind   one family name, one instrument kind.  The same name
+                registered as both a counter and a gauge would merge
+                under one HELP/TYPE block in the exposition and take
+                different merge rules in the fleet plane.
+
+  metric-help   every literal zkp2p_* family must carry a METRIC_HELP
+                entry in utils/metrics.py (the exposition emits a HELP
+                block per family — an unknown family gets boilerplate),
+                and every METRIC_HELP key must still be registered
+                somewhere (stale help rots into documentation of
+                metrics that no longer exist).  The templated
+                `zkp2p_native_<field>` gauges are exempt: their help is
+                generated from the slot name at exposition time.
+
+Dynamic names (f-strings) are checked for the zkp2p_ prefix on their
+literal head and skipped otherwise — the registry cannot know the
+interpolated tail statically.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, Tree, str_const
+
+_NAME_RE = re.compile(r"^zkp2p_[a-z0-9_]+$")
+_RESERVED = ("_bucket", "_sum", "_count", "_info")
+_KINDS = {"counter", "gauge", "histogram"}
+METRICS_MOD = "zkp2p_tpu/utils/metrics.py"
+
+
+def _registrations(tree: Tree):
+    """Yield (relpath, line, kind, name_node) for every instrument call."""
+    for sf in tree.py_files():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+                continue
+            kind = node.func.attr
+            if kind not in _KINDS or not node.args:
+                continue
+            yield sf.relpath, node.lineno, kind, node.args[0]
+
+
+def parse_metric_help(tree: Tree) -> Set[str]:
+    sf = tree.files.get(METRICS_MOD)
+    if sf is None or sf.tree is None:
+        return set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id == "METRIC_HELP" and isinstance(node.value, ast.Dict):
+                return {s for s in (str_const(k) for k in node.value.keys) if s}
+    return set()
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    kinds_seen: Dict[str, Tuple[str, str, int]] = {}  # name -> (kind, path, line)
+    literal_names: Set[str] = set()
+    help_keys = parse_metric_help(tree)
+
+    for relpath, line, kind, name_node in _registrations(tree):
+        name = str_const(name_node)
+        if name is None:
+            # dynamic family: enforce the prefix on the literal head only
+            if isinstance(name_node, ast.JoinedStr) and name_node.values:
+                head = str_const(name_node.values[0]) or ""
+                if not head.startswith("zkp2p_"):
+                    findings.append(Finding(
+                        "metric-name", relpath, line,
+                        "dynamic metric family does not start with the zkp2p_ "
+                        "namespace prefix",
+                    ))
+            continue
+        literal_names.add(name)
+        if not _NAME_RE.match(name):
+            findings.append(Finding(
+                "metric-name", relpath, line,
+                f"family {name!r} must match ^zkp2p_[a-z0-9_]+$ (one namespace, "
+                "Prometheus-safe charset)",
+            ))
+        if kind == "counter" and not name.endswith("_total"):
+            findings.append(Finding(
+                "metric-name", relpath, line,
+                f"counter {name!r} must end `_total` — the fleet merge and every "
+                "Prometheus rate() consumer key on the suffix",
+            ))
+        if kind != "counter" and name.endswith("_total"):
+            findings.append(Finding(
+                "metric-name", relpath, line,
+                f"{kind} {name!r} must not end `_total`: the fleet plane SUMS "
+                "_total families across workers",
+            ))
+        if any(name.endswith(s) for s in _RESERVED):
+            findings.append(Finding(
+                "metric-name", relpath, line,
+                f"family {name!r} ends in an exposition-reserved suffix "
+                f"({'/'.join(_RESERVED)}) — histogram serialization would collide",
+            ))
+        prev = kinds_seen.get(name)
+        if prev is None:
+            kinds_seen[name] = (kind, relpath, line)
+        elif prev[0] != kind:
+            findings.append(Finding(
+                "metric-kind", relpath, line,
+                f"family {name!r} registered as {kind} here but as {prev[0]} at "
+                f"{prev[1]}:{prev[2]} — one family, one kind",
+            ))
+        if (
+            help_keys
+            and name not in help_keys
+            and not name.startswith("zkp2p_native_")
+        ):
+            findings.append(Finding(
+                "metric-help", relpath, line,
+                f"family {name!r} has no METRIC_HELP entry in utils/metrics.py — "
+                "the exposition would emit boilerplate HELP for it",
+            ))
+
+    # stale help keys (reverse direction)
+    sf = tree.files.get(METRICS_MOD)
+    if sf is not None and help_keys:
+        for key in sorted(help_keys - literal_names):
+            line = next(
+                (i for i, ln in enumerate(sf.lines, 1) if f'"{key}"' in ln), 1
+            )
+            findings.append(Finding(
+                "metric-help", METRICS_MOD, line,
+                f"METRIC_HELP documents {key!r} but nothing registers it — stale "
+                "help describes a metric that no longer exists",
+            ))
+    return findings

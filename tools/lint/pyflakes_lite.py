@@ -1,0 +1,234 @@
+"""Curated pyflakes-tier baseline, with `ruff` grafted on when present.
+
+The container this repo builds in has no ruff/pyflakes and nothing may
+be installed, so the pyflakes-tier rules that have bitten (or nearly
+bitten) this tree are reimplemented here over the shared AST cache, and
+an installed `ruff` binary — when one exists on PATH — is run on top
+with the same curated rule set (F401,F541,F632,F811,F821,E722) so a
+richer environment gets the richer checker for free.  Rules:
+
+  unused-import   a module-level import never referenced in its file.
+                  Exemptions keep it zero-noise on a healthy tree:
+                  `__init__.py` files (re-export surface), names listed
+                  in `__all__`, imports inside try/except (availability
+                  probes), `from __future__`, `# noqa` lines, and names
+                  another scanned module imports FROM this module (the
+                  cross-file re-export check — removing those breaks
+                  the importer, which pyflakes famously cannot see).
+
+  fstring-placeholder   an f-string with no {placeholders}: almost
+                  always a forgotten interpolation (the r2 bench once
+                  logged the literal text "{rate} proofs/s").
+
+  bare-except     `except:` catches SystemExit/KeyboardInterrupt — a
+                  drain-loop worker becomes unkillable.  The repo
+                  standard is `except Exception:  # noqa: BLE001 + why`.
+
+  dict-dup-key    duplicate literal keys in a dict display: the first
+                  value is silently discarded.
+
+  assert-tuple    `assert (cond, "msg")` is always true.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, Tree, str_const
+
+RUFF_RULES = "F401,F541,F632,F811,F821,E722"
+
+
+def _module_of(relpath: str) -> str:
+    return relpath[:-3].replace(os.sep, ".").replace("/", ".")
+
+
+def _resolve_from(relpath: str, node: ast.ImportFrom) -> str:
+    """Dotted module an ImportFrom pulls from, relative imports resolved
+    against the importing file's package."""
+    if node.level == 0:
+        return node.module or ""
+    pkg = _module_of(relpath).split(".")
+    # drop the filename, then (level-1) more packages
+    pkg = pkg[: max(0, len(pkg) - node.level)]
+    return ".".join(pkg + ([node.module] if node.module else []))
+
+
+def _reexport_edges(tree: Tree) -> Set[Tuple[str, str]]:
+    """(module, name) pairs some OTHER file imports — an unused import
+    in `module` named `name` is a re-export, not dead code.  tests/ is
+    parsed as an edge SOURCE even though it is never linted: removing an
+    import a test consumes breaks the suite, which pyflakes-class tools
+    famously cannot see."""
+    edges: Set[Tuple[str, str]] = set()
+
+    def add_edges(relpath: str, tree_node: ast.AST) -> None:
+        for node in ast.walk(tree_node):
+            if isinstance(node, ast.ImportFrom):
+                mod = _resolve_from(relpath, node)
+                for a in node.names:
+                    edges.add((mod, a.name))
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    # `import pkg.sub` marks every name in pkg.sub reachable
+                    edges.add((a.name, "*"))
+
+    for sf in tree.py_files():
+        if sf.tree is not None:
+            add_edges(sf.relpath, sf.tree)
+    tests_dir = os.path.join(tree.root, "tests")
+    if os.path.isdir(tests_dir):
+        for n in sorted(os.listdir(tests_dir)):
+            if not n.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(tests_dir, n), errors="ignore") as f:
+                    add_edges(os.path.join("tests", n), ast.parse(f.read()))
+            except SyntaxError:
+                pass
+    return edges
+
+
+def _used_names(tree_node: ast.AST) -> Set[str]:
+    used: Set[str] = set()
+    for node in ast.walk(tree_node):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    return used
+
+
+def _all_list(tree_node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for e in ast.walk(node.value):
+                        s = str_const(e)
+                        if s:
+                            out.add(s)
+    return out
+
+
+def check(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    edges = _reexport_edges(tree)
+    for sf in tree.py_files():
+        if sf.tree is None:
+            continue
+        findings.extend(_check_file(sf, edges))
+    findings.extend(_run_ruff(tree))
+    return findings
+
+
+def _check_file(sf, edges) -> List[Finding]:
+    findings: List[Finding] = []
+    mod = _module_of(sf.relpath)
+    is_init = os.path.basename(sf.relpath) == "__init__.py"
+    used = _used_names(sf.tree)
+    exported = _all_list(sf.tree)
+
+    # ---- unused-import (module level, outside try/except probes) ----
+    if not is_init:
+        probe_lines: Set[int] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Try):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                        probe_lines.add(sub.lineno)
+        for node in sf.tree.body:
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                continue
+            if node.lineno in probe_lines or "noqa" in sf.lines[node.lineno - 1]:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                name = a.asname or a.name.split(".")[0]
+                if name in used or name in exported or name.startswith("_"):
+                    continue
+                if (mod, name) in edges or (mod, "*") in edges:
+                    continue  # re-exported: another module imports it from here
+                findings.append(Finding(
+                    "unused-import", sf.relpath, node.lineno,
+                    f"{name!r} imported but unused (and not re-exported by any "
+                    "scanned module)",
+                ))
+
+    # ---- AST-shape rules ----
+    # format specs (`f"{x:.0f}"`) are themselves JoinedStr nodes with no
+    # FormattedValue children — collect them so the placeholder rule only
+    # sees top-level f-strings
+    spec_nodes = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FormattedValue) and node.format_spec is not None:
+            spec_nodes.add(id(node.format_spec))
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.JoinedStr) and id(node) not in spec_nodes:
+            if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+                findings.append(Finding(
+                    "fstring-placeholder", sf.relpath, node.lineno,
+                    "f-string without any placeholder — forgotten interpolation?",
+                ))
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding(
+                "bare-except", sf.relpath, node.lineno,
+                "bare `except:` swallows SystemExit/KeyboardInterrupt — a "
+                "drain-loop worker becomes unkillable; catch Exception",
+            ))
+        elif isinstance(node, ast.Dict):
+            seen: Dict[object, int] = {}
+            for k in node.keys:
+                if isinstance(k, ast.Constant):
+                    key = (type(k.value).__name__, k.value)
+                    if key in seen:
+                        findings.append(Finding(
+                            "dict-dup-key", sf.relpath, k.lineno,
+                            f"duplicate dict key {k.value!r} — the first value is "
+                            "silently discarded",
+                        ))
+                    seen[key] = k.lineno
+        elif isinstance(node, ast.Assert) and isinstance(node.test, ast.Tuple) and node.test.elts:
+            findings.append(Finding(
+                "assert-tuple", sf.relpath, node.lineno,
+                "assert on a non-empty tuple is always true",
+            ))
+    return findings
+
+
+def _run_ruff(tree: Tree) -> List[Finding]:
+    """Graft an installed ruff on top (curated rule set, same output
+    model).  Absent binary = silently skipped: the container bakes no
+    linters and installing one is off the table, so the built-in rules
+    above are the floor and ruff is the opportunistic ceiling."""
+    ruff = shutil.which("ruff")
+    if not ruff:
+        return []
+    targets = [os.path.join(tree.root, r) for r in ("zkp2p_tpu", "bench.py")]
+    try:
+        r = subprocess.run(
+            [ruff, "check", "--select", RUFF_RULES, "--output-format", "concise", *targets],
+            capture_output=True, text=True, timeout=120,
+        )
+    except Exception:  # noqa: BLE001 — opportunistic layer only
+        return []
+    findings = []
+    for line in r.stdout.splitlines():
+        # path:line:col: CODE message
+        parts = line.split(":", 3)
+        if len(parts) == 4 and parts[1].isdigit():
+            rel = os.path.relpath(parts[0], tree.root)
+            findings.append(Finding("ruff", rel, int(parts[1]), parts[3].strip()))
+    return findings

@@ -129,9 +129,9 @@ def _native_bench(args):
 
     times = []
     for _ in range(reps):
-        t0 = time.time()
+        t0 = time.perf_counter()
         run()
-        times.append(time.time() - t0)
+        times.append(time.perf_counter() - t0)
     best = min(times)
     x = int.from_bytes(out[:4].tobytes(), "little")
     print(
@@ -172,10 +172,10 @@ def _native_precomp_bench(args, lib, bm, sc, threads):
     # No argtype declarations here: the `lib` handle comes from
     # native_prove._lib(), which already configures the precomp ABI.
     cf, q, levels = _resolve_geometry(n, args.table_depth, 1 << 62)
-    t0 = time.time()
+    t0 = time.perf_counter()
     table = np.zeros((levels * n, 8), dtype=np.uint64)
     lib.g1_precomp_build(_p(bm), n, cf, q, levels, threads, _p(table))
-    t_build = time.time() - t0
+    t_build = time.perf_counter() - t0
     table52 = np.zeros((levels * n, 10), dtype=np.uint64)
     p52 = _p(table52) if lib.g1_precomp_to52(_p(table), levels * n, _p(table52)) else None
     print(
@@ -227,12 +227,12 @@ def _native_precomp_bench(args, lib, bm, sc, threads):
 
     t_fixed, t_ref = [], []
     for _ in range(reps):
-        t0 = time.time()
+        t0 = time.perf_counter()
         run_fixed()
-        t_fixed.append(time.time() - t0)
-        t0 = time.time()
+        t_fixed.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
         run_ref()
-        t_ref.append(time.time() - t0)
+        t_ref.append(time.perf_counter() - t0)
     bf, br = min(t_fixed), min(t_ref)
     parity = "OK" if np.array_equal(out_fixed, out_ref) else "MISMATCH"
     h = hashlib.sha256(out_fixed.tobytes()).hexdigest()[:16]
@@ -314,12 +314,12 @@ def _native_multi_bench(args, lib, bm, threads):
 
     t_multi, t_seq = [], []
     for _ in range(reps):
-        t0 = time.time()
+        t0 = time.perf_counter()
         run_multi()
-        t_multi.append(time.time() - t0)
-        t0 = time.time()
+        t_multi.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
         run_seq()
-        t_seq.append(time.time() - t0)
+        t_seq.append(time.perf_counter() - t0)
     bm_multi, bm_seq = min(t_multi), min(t_seq)
     parity = "OK" if np.array_equal(out_multi, out_seq) else "MISMATCH"
     h = hashlib.sha256(out_multi.tobytes()).hexdigest()[:16]
@@ -391,7 +391,7 @@ def _ladder_bench(args):
     for _ in range(args.reps):
         for arm in ("oracle", "seg"):  # interleaved
             out = np.zeros((m, 4), dtype=np.uint64)
-            t0 = time.time()
+            t0 = time.perf_counter()
             if arm == "oracle":
                 lib.fr_matvec(
                     _p(coeff), wire.ctypes.data_as(u32p), row.ctypes.data_as(u32p),
@@ -404,7 +404,7 @@ def _ladder_bench(args):
                     seg_rows.ctypes.data_as(u32p), seg_rows.shape[0],
                     _p(w_mont), m, threads, _p(out),
                 )
-            times[arm].append(time.time() - t0)
+            times[arm].append(time.perf_counter() - t0)
             outs[arm] = out
     assert np.array_equal(outs["oracle"], outs["seg"]), "segmented matvec diverged"
     mo, ms = min(times["oracle"]), min(times["seg"])
@@ -429,11 +429,11 @@ def _ladder_bench(args):
             os.environ["ZKP2P_NTT_POOL"] = knob
             abc = [np.ascontiguousarray(base[i].copy()) for i in range(3)]
             d = np.zeros((m, 4), dtype=np.uint64)
-            t0 = time.time()
+            t0 = time.perf_counter()
             lib.fr_h_ladder(
                 _p(abc[0]), _p(abc[1]), _p(abc[2]), m, _p(wroot), _p(gcos), _p(d)
             )
-            lt[arm].append(time.time() - t0)
+            lt[arm].append(time.perf_counter() - t0)
             louts[arm] = d
     os.environ.pop("ZKP2P_NTT_POOL", None)
     assert np.array_equal(louts["pool"], louts["unfused"]), "pooled ladder diverged"
@@ -588,12 +588,12 @@ def main():
         addm = jax.jit(lambda p, a: curve.add_mixed(p, a))
         out = addm(P, (qx, qy))
         jax.block_until_ready(out)
-        t0 = time.time()
+        t0 = time.perf_counter()
         iters = 4
         for _ in range(iters):
             out = addm(P, (qx, qy))
         jax.block_until_ready(out)
-        dt = (time.time() - t0) / iters
+        dt = (time.perf_counter() - t0) / iters
         print(f"add_mixed: B={B} {dt*1e3:.1f} ms -> {B/dt/1e6:.2f} M adds/s", flush=True)
 
     if args.skip_msm:
@@ -621,15 +621,15 @@ def main():
         planes = digit_planes_from_limbs(jnp.asarray(limbs_np), window=args.window)
         f = jax.jit(lambda b, p: msm_windowed(curve, b, p, lanes=lanes, window=args.window))
         fargs = (bases, planes)
-    t0 = time.time()
+    t0 = time.perf_counter()
     r = f(*fargs)
     jax.block_until_ready(r)
-    compile_and_first = time.time() - t0
+    compile_and_first = time.perf_counter() - t0
     print(f"msm first (incl compile): {compile_and_first:.1f}s", flush=True)
-    t0 = time.time()
+    t0 = time.perf_counter()
     r = f(*fargs)
     jax.block_until_ready(r)
-    dt = time.time() - t0
+    dt = time.perf_counter() - t0
     print(f"msm_windowed: {tag} {dt:.2f} s -> {n/dt/1e6:.3f} M pts/s", flush=True)
 
 

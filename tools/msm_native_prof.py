@@ -80,9 +80,9 @@ def main():
     print("warm prove ...", flush=True)
     prove_native(dpk, w)
     read_prof("warm (discard)")
-    t0 = time.time()
+    t0 = time.perf_counter()
     proof = prove_native(dpk, w)
-    total = time.time() - t0
+    total = time.perf_counter() - t0
     fill, apply_, suffix = read_prof("steady")
     assert verify(vk, proof, inputs.public_signals)
     print(f"prove total {total:.2f}s; G1 phases sum {(fill + suffix) / 1e3:.2f}s", flush=True)

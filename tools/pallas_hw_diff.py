@@ -32,10 +32,10 @@ def main():
     from zkp2p_tpu.utils.jaxcfg import on_tpu
 
     interp = not on_tpu()
-    t0 = time.time()
+    t0 = time.perf_counter()
 
     def log(m):
-        print(f"[{time.time()-t0:6.1f}s] {m}", flush=True)
+        print(f"[{time.perf_counter()-t0:6.1f}s] {m}", flush=True)
 
     from zkp2p_tpu.curve.host import G1_GENERATOR, G2_GENERATOR, g1_mul, g2_mul
     from zkp2p_tpu.curve.jcurve import G1J, G2J, g1_to_affine_arrays, g2_to_affine_arrays

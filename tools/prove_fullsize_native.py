@@ -60,10 +60,10 @@ def main():
     wit_path = os.path.join(CACHE, "venmo_witness_1024_6400.npz")
     key_path = os.path.join(CACHE, "venmo_1024_6400.npz")
 
-    t = time.time()
+    t = time.perf_counter()
     log("building full-size circuit (expect ~7 min) ...")
     cs, lay = build_venmo_circuit(params)
-    timing["build_circuit_s"] = round(time.time() - t, 1)
+    timing["build_circuit_s"] = round(time.perf_counter() - t, 1)
     log(f"constraints={cs.num_constraints} wires={cs.num_wires} domain={domain_size_for(cs)}")
 
     wit_digest = circuit_digest(cs)
@@ -85,17 +85,17 @@ def main():
     else:
         w = None
     if w is None:
-        t = time.time()
+        t = time.perf_counter()
         key = make_test_key(1)
         email = make_venmo_email(key, raw_id="1234567891234567891", amount="42", body_filler=40)
         inputs = generate_inputs(email, key.n, order_id=1, claim_id=1, params=params, layout=lay)
         w = cs.witness(inputs.public_signals, inputs.seed)
         pubs = inputs.public_signals
-        timing["witness_s"] = round(time.time() - t, 1)
+        timing["witness_s"] = round(time.perf_counter() - t, 1)
         log(f"witness generated in {timing['witness_s']}s; checking")
-        t = time.time()
+        t = time.perf_counter()
         cs.check_witness(w)
-        timing["check_witness_s"] = round(time.time() - t, 1)
+        timing["check_witness_s"] = round(time.perf_counter() - t, 1)
         from zkp2p_tpu.native.lib import _scalars_to_u64
 
         np.savez(
@@ -113,9 +113,9 @@ def main():
     dpk = vk = None
     if os.path.exists(key_path):
         try:
-            t = time.time()
+            t = time.perf_counter()
             dpk, vk = load_dpk(key_path, digest=digest)
-            timing["load_key_s"] = round(time.time() - t, 1)
+            timing["load_key_s"] = round(time.perf_counter() - t, 1)
             if dpk.n_wires != n_wires_expect or (1 << dpk.log_m) != domain_expect:
                 log("cached key does not match the rebuilt circuit; re-running setup")
                 dpk = vk = None
@@ -130,24 +130,24 @@ def main():
         cs = lay = None
         gc.collect()
     if dpk is None:
-        t = time.time()
+        t = time.perf_counter()
         log("full-size device setup (native fixed-base batches; expect ~15 min) ...")
         from zkp2p_tpu.prover.setup_device import setup_device
 
         dpk, vk = setup_device(cs, seed="bench")
-        timing["setup_s"] = round(time.time() - t, 1)
+        timing["setup_s"] = round(time.perf_counter() - t, 1)
         log(f"setup took {timing['setup_s']}s; caching")
         save_dpk(key_path, dpk, vk, digest=digest)
 
-    t = time.time()
+    t = time.perf_counter()
     log("native prove ...")
     proof = prove_native(dpk, w, r=123456789, s=987654321)
-    timing["prove_native_s"] = round(time.time() - t, 1)
+    timing["prove_native_s"] = round(time.perf_counter() - t, 1)
     log(f"native prove took {timing['prove_native_s']}s; verifying")
 
-    t = time.time()
+    t = time.perf_counter()
     assert verify(vk, proof, pubs), "full-size proof failed pairing verification"
-    timing["verify_s"] = round(time.time() - t, 1)
+    timing["verify_s"] = round(time.perf_counter() - t, 1)
     timing["constraints"] = n_constraints
     timing["wires"] = n_wires_expect
     timing["reference_rapidsnark_s_48core"] = 9.2

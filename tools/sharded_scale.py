@@ -97,9 +97,9 @@ def main() -> None:
     oracle = prove_native(dpk, w, r=r, s=s)  # byte-pinned to prove_host
     stage("native oracle proof done")
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     proof = prove_tpu_sharded(dpk, w, mesh, r=r, s=s, unified=True, progress=stage)
-    stage(f"prove_tpu_sharded done in {time.time() - t0:.1f}s (incl. compile)")
+    stage(f"prove_tpu_sharded done in {time.perf_counter() - t0:.1f}s (incl. compile)")
     assert proof == oracle, "sharded proof != native/host oracle proof"
     assert verify(vk, proof, [])
     # Observability flush, wired the way bench.py's native tier is: the

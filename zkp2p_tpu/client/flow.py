@@ -19,7 +19,7 @@ OnRamper object — same trust shape, the chain never checks it.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import List
 
 from ..contracts.ramp import Ramp
 from ..inputs.email import SyntheticEmail, VenmoInputs, generate_inputs, venmo_id_hash

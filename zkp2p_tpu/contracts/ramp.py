@@ -16,9 +16,9 @@ Semantics mirrored with file:line cites inline.
 from __future__ import annotations
 
 import time as _time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import IntEnum
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 from ..snark.groth16 import Proof, VerifyingKey, verify
 

@@ -39,7 +39,6 @@ JacPoint = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
 AffPoint = Tuple[jnp.ndarray, jnp.ndarray]
 
 
-import os
 
 # Curve-op implementation selector: "auto" (default — pallas on a real
 # TPU backend, xla elsewhere), "xla" (force the packed-mul formulas

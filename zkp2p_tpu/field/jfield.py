@@ -20,7 +20,6 @@ from __future__ import annotations
 
 from functools import lru_cache
 
-import os
 
 import jax
 import jax.numpy as jnp

@@ -18,9 +18,8 @@ import gzip
 import hashlib
 import json
 import os
-import shutil
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Protocol
+from typing import List, Optional, Protocol
 
 CHUNK_SUFFIXES = "bcdefghijk"  # 10 chunks, zkp.ts:13
 

@@ -17,11 +17,11 @@ The witness side (host hooks) uses Python bigints (`long_div` twin of
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
 from ..field.bn254 import R
 from ..snark.r1cs import LC, ConstraintSystem
-from .core import lc_sum, num2bits
+from .core import num2bits
 
 
 def limbs_to_int_host(limbs: Sequence[int], n: int) -> int:

@@ -20,10 +20,9 @@ from __future__ import annotations
 
 from typing import Dict, FrozenSet, List, Sequence, Tuple
 
-from ..field.bn254 import R
-from ..regexc.compiler import DEAD, DFA
+from ..regexc.compiler import DFA
 from ..snark.r1cs import LC, ConstraintSystem
-from .core import and_gate, lc_sum, num2bits
+from .core import lc_sum, num2bits
 
 
 def _ranges(chars: FrozenSet[int]) -> List[Tuple[int, int]]:

@@ -16,7 +16,7 @@ from __future__ import annotations
 import hashlib
 from base64 import b64encode
 from dataclasses import dataclass
-from typing import Dict, List, Tuple
+from typing import Dict, List
 
 from ..gadgets.bigint import int_to_limbs_host
 from ..gadgets.poseidon_params import poseidon_hash

@@ -9,7 +9,7 @@ parallelisable body prefix is hashed outside the circuit.
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import Tuple
 
 from ..gadgets.sha256 import H0, K
 

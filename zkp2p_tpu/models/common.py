@@ -13,7 +13,6 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
-from ..field.bn254 import R
 from ..gadgets import base64 as b64
 from ..gadgets import core, sha256
 from ..gadgets.regex import CharClassCache, dfa_scan, match_count, reveal_bytes

@@ -17,7 +17,6 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from ..field.bn254 import R
 from ..gadgets import core, rsa, sha256
 from ..gadgets.regex import CharClassCache, dfa_scan, reveal_bytes
 from ..regexc import compiler as regexc

@@ -32,7 +32,7 @@ one instantiation (`main = P2POnrampVerify(1024, 6400, 121, 17)`).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Sequence
+from typing import List
 
 from ..field.bn254 import R
 

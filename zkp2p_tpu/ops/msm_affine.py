@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..curve.jcurve import AffPoint, JacPoint, JCurve
-from .msm import fold_lanes_per_curve, horner_fold_planes, tree_reduce
+from .msm import fold_lanes_per_curve, horner_fold_planes
 
 
 def _one(F, like: jnp.ndarray) -> jnp.ndarray:

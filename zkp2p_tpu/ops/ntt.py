@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..field.bn254 import R, fr_domain_root, fr_inv
-from ..field.jfield import FR, NUM_LIMBS
+from ..field.jfield import FR
 
 
 def _bit_reverse_perm(m: int) -> np.ndarray:

@@ -19,7 +19,7 @@ the driver's `dryrun_multichip` exercises it on virtual CPU devices.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 from typing import Optional, Tuple
 
 import jax
@@ -28,8 +28,8 @@ import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..curve.jcurve import AffPoint, G1J, G2J, JacPoint, JCurve
-from ..ops.msm import SCALAR_BITS, msm, msm_windowed
+from ..curve.jcurve import AffPoint, JacPoint, JCurve
+from ..ops.msm import msm, msm_windowed
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "shard") -> Mesh:

@@ -38,7 +38,7 @@ import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..field.bn254 import R, fr_domain_root, fr_inv
+from ..field.bn254 import fr_domain_root, fr_inv
 from ..field.jfield import FR, NUM_LIMBS
 from ..ops.ntt import _ntt_core, domain
 

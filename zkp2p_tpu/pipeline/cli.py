@@ -78,10 +78,10 @@ def cmd_setup(args):
     from ..snark.groth16 import qap_rows, setup
 
     os.makedirs(args.build_dir, exist_ok=True)
-    t0 = time.time()
+    t0 = time.perf_counter()
     _log(f"building circuit {args.circuit} ...")
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
-    _log(f"constraints={cs.num_constraints} wires={cs.num_wires} ({time.time()-t0:.0f}s)")
+    _log(f"constraints={cs.num_constraints} wires={cs.num_wires} ({time.perf_counter()-t0:.0f}s)")
     _log("running development setup (production: import a ceremony zkey instead)")
     pk, vk = setup(cs, seed=args.seed)
     zkey_path = os.path.join(args.build_dir, "circuit_final.zkey")
@@ -101,7 +101,7 @@ def cmd_setup(args):
     dump(vkey_to_json(vk), os.path.join(args.build_dir, "verification_key.json"))
     with open(os.path.join(args.build_dir, "verifier.sol"), "w") as f:
         f.write(export_verifier(vk))
-    _log(f"setup done in {time.time()-t0:.0f}s -> {args.build_dir}/")
+    _log(f"setup done in {time.perf_counter()-t0:.0f}s -> {args.build_dir}/")
 
 
 def _infer_widths(args) -> bool:
@@ -238,9 +238,9 @@ def cmd_prove(args):
             raise SystemExit(f"witness has {len(w)} wires, zkey expects {zk.n_vars}")
         dpk = device_pk_from_zkey(zk, infer_widths=_infer_widths(args))
         pub = w[1 : zk.n_public + 1]
-        t0 = time.time()
+        t0 = time.perf_counter()
         proof = prove_fn(dpk, w)
-        _log(f"proved in {time.time()-t0:.1f}s (incl. first-call compile)")
+        _log(f"proved in {time.perf_counter()-t0:.1f}s (incl. first-call compile)")
         dump(proof_to_json(proof), args.proof)
         dump(public_to_json(pub), args.public)
         _log(f"wrote {args.proof} {args.public}")
@@ -251,9 +251,9 @@ def cmd_prove(args):
     _check_zkey_matches(zk, cs)
     dpk = device_pk_from_zkey(zk, infer_widths=_infer_widths(args))
     w, pub = _witness_for(args, cs, meta)
-    t0 = time.time()
+    t0 = time.perf_counter()
     proof = prove_fn(dpk, w)
-    _log(f"proved in {time.time()-t0:.1f}s (incl. first-call compile)")
+    _log(f"proved in {time.perf_counter()-t0:.1f}s (incl. first-call compile)")
     dump(proof_to_json(proof), args.proof)
     dump(public_to_json(pub or w[1 : cs.num_public + 1]), args.public)
     _log(f"wrote {args.proof} {args.public}")
@@ -323,9 +323,9 @@ def cmd_batch(args):
         w, pub = _witness_for(args, cs, meta, source=fp)
         wits.append(w)
         pubs.append(pub)
-    t0 = time.time()
+    t0 = time.perf_counter()
     proofs = prove_tpu_batch(dpk, wits)
-    dt = time.time() - t0
+    dt = time.perf_counter() - t0
     _log(f"batch of {len(wits)} proved in {dt:.1f}s -> {len(wits)/dt:.2f} proofs/s")
     os.makedirs(args.outdir, exist_ok=True)
     for fp, proof, pub in zip(files, proofs, pubs):
@@ -596,6 +596,24 @@ def cmd_serve(args):
         srv.shutdown()
 
 
+def cmd_lint(args):
+    """Run the zkp2p-lint suite (tools/lint) over this checkout.  The
+    linter lives beside the tools it polices rather than inside the
+    package, so it can parse a tree whose imports are broken — exactly
+    the tree that needs linting most."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.lint import main as lint_main
+
+    argv = []
+    if args.rules:
+        argv += ["--rules", args.rules]
+    if args.json:
+        argv.append("--json")
+    raise SystemExit(lint_main(argv))
+
+
 def cmd_doctor(args):
     """Execution-path preflight: probe the backend, arm EVERY gate
     through its real resolver, and report which arm each one took —
@@ -808,6 +826,17 @@ def main(argv=None):
     s.add_argument("--strict", action="store_true", help="exit 1 when any gate is mis-armed")
     s.set_defaults(fn=cmd_doctor)
 
+    s = sub.add_parser(
+        "lint",
+        help="static invariant checks: knob/gate discipline, stats-ABI drift, "
+        "metric naming, durability, clocks, pyflakes tier — docs/STATIC_ANALYSIS.md",
+    )
+    s.add_argument("--rules", default="", help="comma-separated rule filter")
+    s.add_argument("--json", action="store_true", help="machine-readable findings")
+    # no_jax: lint is the pre-commit path — it must answer in seconds
+    # without importing jax or touching the compilation cache
+    s.set_defaults(fn=cmd_lint, no_jax=True)
+
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")
     s.add_argument("--indir", required=True)
     s.add_argument("--outdir", required=True)
@@ -821,6 +850,9 @@ def main(argv=None):
     s.set_defaults(fn=cmd_batch)
 
     args = ap.parse_args(argv)
+    if getattr(args, "no_jax", False):
+        args.fn(args)
+        return
     from ..utils.jaxcfg import enable_cache
 
     enable_cache()

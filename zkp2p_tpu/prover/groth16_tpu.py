@@ -46,7 +46,7 @@ from ..curve.jcurve import (
     g2_to_affine_arrays,
 )
 from ..field.bn254 import R
-from ..field.jfield import FR, NUM_LIMBS, lazy_segment_sum_mod
+from ..field.jfield import FR, lazy_segment_sum_mod
 from ..ops.msm import (
     default_lanes,
     digit_planes_from_limbs,

@@ -266,6 +266,20 @@ def _use_matvec_seg() -> bool:
     return record_arm("native_matvec_seg", load_config().matvec_seg)
 
 
+def _use_msm_overlap() -> bool:
+    """Stage task-graph gate (ZKP2P_MSM_OVERLAP, default ON): the
+    witness-dependent MSMs run on worker threads overlapping the H
+    ladder; =0 runs the strict sequential schedule — the byte-parity
+    arm.  Fresh-read per prove and record_arm-audited (the one armable
+    knob that historically lacked an arm record: a flip was invisible
+    to the execution digest until zkp2p-lint's gate-arm rule caught
+    it)."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("native_msm_overlap", load_config().msm_overlap)
+
+
 def _ntt_pool_arm() -> bool:
     """NTT stage-pool + fused-ladder gate (ZKP2P_NTT_POOL, default ON).
     The arm itself is resolved IN the C runtime (fresh getenv per
@@ -650,9 +664,7 @@ def prove_native(
     # Results are gathered in the fixed assembly order, so proof bytes
     # are identical to the sequential schedule (pinned by
     # tests/test_msm_native_edge.py parity).
-    from ..utils.config import load_config
-
-    if load_config().msm_overlap and threads > 1:
+    if _use_msm_overlap() and threads > 1:
         from ..utils.trace import adopt_context, adopt_stack, current_context, current_stack
 
         # worker-thread trace records keep this thread's stage prefix
@@ -851,9 +863,7 @@ def prove_native_batch(
 
     b_cols = [np.ascontiguousarray(w[b_sel]) for w in w_cols]
     c_cols = [np.ascontiguousarray(w[c_sel]) for w in w_cols]
-    from ..utils.config import load_config
-
-    if load_config().msm_overlap and threads > 1:
+    if _use_msm_overlap() and threads > 1:
         # Same stage task-graph contract as prove_native, one level up:
         # everything witness-dependent — the three witness-column multi
         # MSMs and the S per-proof G2 MSMs — runs on worker threads

@@ -26,7 +26,6 @@ import numpy as np
 
 from ..curve.host import G1_GENERATOR, G2_GENERATOR, g1_gen_mul, g2_gen_mul
 from ..field.bn254 import R, fr_domain_root, fr_inv
-from ..field.jfield import FR, FQ
 from ..native.lib import g1_fixed_base_batch_mont_limbs, g2_fixed_base_batch_mont_limbs
 from ..snark.groth16 import VerifyingKey, _batch_inv, _seeded_scalars, coset_gen, domain_size_for, qap_rows
 from ..snark.r1cs import ConstraintSystem

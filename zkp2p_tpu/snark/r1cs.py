@@ -21,7 +21,7 @@ Wire layout follows the Groth16/snarkjs convention: wire 0 is the constant
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from ..field.bn254 import R

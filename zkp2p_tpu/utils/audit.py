@@ -11,8 +11,8 @@ took but not WHICH ARM executed — this module closes that blind spot:
      `jaxcfg.on_tpu`, the prover's `_unified`/`_affine`/`_h_bucket`/
      `_glv`, the pallas-vs-XLA field mul and curve kernel, the native
      GLV / batch-affine / IFMA-vs-scalar tiers — reports `(gate, arm)`
-     at its call site into `zkp2p_path_taken{gate,arm}` counters and a
-     process-wide gate→arm map.
+     at its call site into `zkp2p_path_taken_total{gate,arm}` counters
+     and a process-wide gate→arm map.
 
   2. **Execution digest** (`execution_digest`): a stable hash of the
      sorted gate→arm map, stamped into the run manifest, every BENCH
@@ -82,7 +82,7 @@ def record_arm(gate: str, arm):
     key = (gate, s)
     c = _counters.get(key)
     if c is None:
-        c = _counters[key] = REGISTRY.counter("zkp2p_path_taken", {"gate": gate, "arm": s})
+        c = _counters[key] = REGISTRY.counter("zkp2p_path_taken_total", {"gate": gate, "arm": s})
     c.inc()
     return arm
 
@@ -354,12 +354,14 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
         _use_glv,
         _use_matvec_seg,
         _use_msm_multi,
+        _use_msm_overlap,
         _use_msm_precomp,
     )
 
     _use_glv()
     _use_batch_affine()
     _use_msm_multi()
+    _use_msm_overlap()
     _use_msm_precomp()
     _use_matvec_seg()
     _ntt_pool_arm()

@@ -62,7 +62,7 @@ METRIC_HELP: Dict[str, str] = {
     "zkp2p_slo_window_requests": "Requests in the rolling SLO window",
     "zkp2p_slo_objective_s": "Configured p95 latency objective (ZKP2P_SLO_P95_S; 0 = none)",
     "zkp2p_trace_dropped_total": "Trace ring-buffer overflow evictions",
-    "zkp2p_path_taken": "Gate consultations by resolved arm (execution audit)",
+    "zkp2p_path_taken_total": "Gate consultations by resolved arm (execution audit)",
     "zkp2p_compile_events_total": "XLA/jit compiles attributed to the triggering trace stage",
     "zkp2p_compile_seconds_total": "XLA/jit compile seconds attributed to the triggering trace stage",
     "zkp2p_hbm_bytes_in_use": "Live device memory per device",
