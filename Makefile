@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan native-tsan lint test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan native-tsan lint test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -112,6 +112,18 @@ fleet-smoke: native
 # docs/OBSERVABILITY.md §fleet plane; ~15 s on the 2-core box.
 fleet-obs-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_fleet_obs.py -q
+
+# Adaptive-scheduler smoke (tier-1 resident; docs/SCHEDULING.md):
+# deterministic controller units (amortization model, EWMA, SLO-driven
+# sizing monotone-in-load + clamped, expected-deadline-miss shed that
+# never sheds a feasible request, interactive-first lanes, autoscale
+# hysteresis that cannot flap on an oscillating signal), the toy-circuit
+# mini-trace through the REAL service (adaptive sheds/lanes/targets vs
+# the byte-for-byte static off arm, digest-distinguishable), and the
+# 1->2->1 fleet autoscale demo with the PR-7 zero-lost invariant green.
+# ~40 s on the 2-core box (the autoscale demo is most of it).
+sched-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_sched.py -q
 
 # The full fleet acceptance (slow): N=3 supervised workers, seeded
 # faults, worker SIGKILL + worker SIGTERM drain + supervisor

@@ -86,6 +86,14 @@ def test_env_overrides_every_knob():
         "ZKP2P_ALERT_FOR_S": "7",
         "ZKP2P_ALERT_CLEAR_S": "20",
         "ZKP2P_ALERT_HB_GAP_S": "8",
+        "ZKP2P_SCHED": "adaptive",
+        "ZKP2P_SCHED_TARGET_FILL": "0.7",
+        "ZKP2P_SCHED_AMORT": "1:0.9,8:3.0",
+        "ZKP2P_SCHED_PRIORITY_DEFAULT": "interactive",
+        "ZKP2P_WORKERS_MIN": "1",
+        "ZKP2P_WORKERS_MAX": "6",
+        "ZKP2P_SCALE_UP_S": "12",
+        "ZKP2P_SCALE_DOWN_S": "45",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -119,6 +127,11 @@ def test_env_overrides_every_knob():
     assert cfg.alert_burn_rate == 4.0 and cfg.alert_restarts == 5
     assert cfg.alert_for_s == 7.0 and cfg.alert_clear_s == 20.0
     assert cfg.alert_hb_gap_s == 8.0
+    assert cfg.sched == "adaptive" and cfg.sched_target_fill == 0.7
+    assert cfg.sched_amort == "1:0.9,8:3.0"
+    assert cfg.sched_priority_default == "interactive"
+    assert cfg.workers_min == 1 and cfg.workers_max == 6
+    assert cfg.scale_up_s == 12.0 and cfg.scale_down_s == 45.0
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -181,6 +194,21 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_SLO_TARGET": "0.9"}).slo_target == 0.9
     assert load_config(environ={"ZKP2P_TS_SAMPLE_S": "0"}).ts_sample_s == 0.0
     assert load_config(environ={"ZKP2P_TS_SAMPLE_S": "junk"}).ts_sample_s == 10.0
+    # scheduler knobs: the gate stays a raw string (sched_mode fails
+    # CLOSED to "off" on anything but "adaptive"); the headroom
+    # fraction follows the SLO-target grammar (strictly inside (0,1),
+    # malformed keeps 0.8); autoscale bounds are nonneg ints (0 = off)
+    # and the hysteresis windows clamp like their alert siblings
+    assert load_config(environ={}).sched == "off"
+    assert load_config(environ={"ZKP2P_SCHED": "adaptive"}).sched == "adaptive"
+    assert load_config(environ={"ZKP2P_SCHED_TARGET_FILL": "junk"}).sched_target_fill == 0.8
+    assert load_config(environ={"ZKP2P_SCHED_TARGET_FILL": "1.5"}).sched_target_fill == 0.8
+    assert load_config(environ={"ZKP2P_SCHED_TARGET_FILL": "0.5"}).sched_target_fill == 0.5
+    assert load_config(environ={"ZKP2P_WORKERS_MAX": "junk"}).workers_max == 0
+    assert load_config(environ={"ZKP2P_WORKERS_MIN": "-2"}).workers_min == 0
+    assert load_config(environ={"ZKP2P_SCALE_UP_S": "-1"}).scale_up_s == 0.0
+    assert load_config(environ={"ZKP2P_SCALE_DOWN_S": "junk"}).scale_down_s == 30.0
+    assert load_config(environ={}).sched_priority_default == "bulk"
 
 
 def test_armed_flags_whitelist_and_precedence(tmp_path):

@@ -23,8 +23,9 @@ pytestmark = pytest.mark.skipif(native.get_lib() is None, reason="native toolcha
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 STEP_KEYS = {
-    "qps_target", "offered", "done", "errors", "unfinished", "duration_s",
-    "completed_qps", "p50_s", "p95_s", "max_s", "attainment", "burn_rate", "ok",
+    "qps_target", "offered", "done", "errors", "unfinished", "served_under_slo",
+    "duration_s", "completed_qps", "p50_s", "p95_s", "max_s", "attainment",
+    "burn_rate", "ok",
 }
 
 
@@ -119,3 +120,19 @@ def test_loadgen_burst_capacity_status_and_waterfall(tmp_path):
     rep = json.loads(p2.stdout)
     assert rep["timeseries"].get("n", 0) >= 1
     assert "done" in rep["requests"]
+
+
+def test_parse_trace_segments():
+    """--trace grammar: 'RATExSECONDS,...' segments; malformed specs
+    fail LOUDLY before any multi-minute ramp."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "zkp2p_loadgen_for_trace", os.path.join(REPO, "tools", "loadgen.py"))
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+    assert lg.parse_trace("0.2x30,4x20,0.2x30") == [(0.2, 30.0), (4.0, 20.0), (0.2, 30.0)]
+    assert lg.parse_trace("1X5") == [(1.0, 5.0)]  # case-insensitive x
+    for bad in ("", "junk", "0x5", "1x-3", "1:5"):
+        with pytest.raises(ValueError):
+            lg.parse_trace(bad)

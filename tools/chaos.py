@@ -87,7 +87,7 @@ def worker_main(args) -> int:
     # toy prove is µs — saturation and mid-prove kill windows need
     # batches that HOLD claims for a while); fleet.slowed_prover is THE
     # shared model, so fleet and in-process capacity stay comparable
-    prover_fn = slowed_prover(prove_native_batch, args.prove_s)
+    prover_fn = slowed_prover(prove_native_batch, args.prove_s, args.batch_overhead_s)
     svc = ProvingService(
         cs, dpk, vk, witness_fn, public_fn=lambda w: [w[1]],
         batch_size=args.batch,
@@ -566,6 +566,7 @@ def run_fleet_chaos(args) -> dict:
         "--max-seconds", str(args.max_seconds),
         "--poll-s", str(args.poll_s),
         "--prove-s", str(args.prove_s),
+        "--batch-overhead-s", str(args.batch_overhead_s),
     ]
 
     def sup_cmd(fleet_dir: str) -> list:
@@ -691,6 +692,9 @@ def main(argv=None) -> int:
     ap.add_argument("--prove-s", type=float, default=0.0,
                     help="artificial PER-REQUEST prove time, scaled by batch fill "
                          "(fleet kill windows / loadgen saturation)")
+    ap.add_argument("--batch-overhead-s", type=float, default=0.0,
+                    help="artificial PER-BATCH fixed prove cost (the amortization "
+                         "curve's setup term; scheduler A/Bs need a curve to sit on)")
     ap.add_argument("--linger", action="store_true",
                     help="worker: keep sweeping after the spool goes terminal (loadgen fleet workers)")
     ap.add_argument("--fleet", type=int, default=0,

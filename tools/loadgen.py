@@ -53,7 +53,7 @@ import sys
 import threading
 import time
 import traceback
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -122,6 +122,31 @@ def _write_request(spool: str, rid: str, payload: Dict) -> str:
     return path
 
 
+def parse_trace(spec: str) -> List[Tuple[float, float]]:
+    """Parse a piecewise arrival trace "rate x duration" segment list:
+    "0.2x30,4x20,0.2x30" = 0.2 QPS for 30 s, a 4 QPS spike for 20 s,
+    0.2 QPS for 30 s.  The low->spike->drain shape is THE scheduler
+    A/B instrument (docs/SCHEDULING.md): a flat ramp never shows the
+    batch-size controller moving.  Malformed specs raise ValueError
+    BEFORE the multi-minute run."""
+    segments: List[Tuple[float, float]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            rate_s, dur_s = part.lower().split("x")
+            rate, dur = float(rate_s), float(dur_s)
+        except ValueError:
+            raise ValueError(f"bad --trace segment {part!r} (want 'RATExSECONDS,...')") from None
+        if rate <= 0 or dur <= 0:
+            raise ValueError(f"bad --trace segment {part!r}: rate and duration must be > 0")
+        segments.append((rate, dur))
+    if not segments:
+        raise ValueError(f"--trace {spec!r} has no segments")
+    return segments
+
+
 def run_capacity(
     svc,
     spool: str,
@@ -136,18 +161,23 @@ def run_capacity(
     run_service: bool = True,
     circuit: str = "?",
     prove_sleep_s: float = 0.0,
+    batch_overhead_s: float = 0.0,
     fleet_workers: int = 0,
+    segments: Optional[List[Tuple[float, float]]] = None,
     log: Callable[[str], None] = lambda m: print(m, file=sys.stderr, flush=True),
 ) -> Dict:
     """Drive the ramp and score it; returns the capacity report dict.
 
     svc: a ProvingService (swept in-process when run_service) — pass
     None with run_service=False to only generate load for an external
-    worker.  prove_sleep_s: artificial PER-REQUEST service time added
-    around the prover, scaled by batch fill — the same model the
-    --fleet toy workers apply, so in-process and fleet capacity
-    numbers share one service-time definition (smoke-scale
-    saturation)."""
+    worker.  prove_sleep_s / batch_overhead_s: artificial service time
+    added around the prover (per request scaled by batch fill + a
+    per-batch fixed cost) — the same model the --fleet toy workers
+    apply, so in-process and fleet capacity numbers share one
+    service-time definition (smoke-scale saturation).  segments:
+    explicit (rate, duration) pairs (--trace); None = one segment of
+    step_s per entry in rates."""
+    from zkp2p_tpu.pipeline.sched import normalize_sched as _normalize_sched
     from zkp2p_tpu.pipeline.service import TimeseriesSampler
     from zkp2p_tpu.utils.audit import execution_digest
     from zkp2p_tpu.utils.config import load_config
@@ -191,14 +221,15 @@ def run_capacity(
         if payload_fn is None:
             payload_fn = lambda r: {"x": r.randrange(2, 50), "y": r.randrange(2, 50)}  # noqa: E731
 
-        if prove_sleep_s > 0 and svc is not None and svc.prover_fn is not None:
+        if (prove_sleep_s > 0 or batch_overhead_s > 0) and svc is not None and svc.prover_fn is not None:
             # fleet.slowed_prover is THE shared artificial-service-time
-            # model (per request, scaled by fill) — the chaos/fleet toy
-            # workers wrap with the same helper, so the in-process and
-            # --fleet capacity numbers stay comparable by construction
+            # model (per request scaled by fill + per-batch overhead) —
+            # the chaos/fleet toy workers wrap with the same helper, so
+            # the in-process and --fleet capacity numbers stay
+            # comparable by construction
             from zkp2p_tpu.pipeline.fleet import slowed_prover
 
-            svc.prover_fn = slowed_prover(svc.prover_fn, prove_sleep_s)
+            svc.prover_fn = slowed_prover(svc.prover_fn, prove_sleep_s, batch_overhead_s)
 
         stop = threading.Event()
         worker_errors: List[str] = []
@@ -220,12 +251,15 @@ def run_capacity(
             th = threading.Thread(target=worker, daemon=True, name="loadgen-service")
             th.start()
 
-        # ---- ramp: open-loop Poisson arrivals per step
+        # ---- ramp: open-loop Poisson arrivals per segment (a --trace
+        # spec, or one step_s segment per --rates entry)
+        if segments is None:
+            segments = [(r, step_s) for r in rates]
         steps_reqs: List[List[str]] = []
         t_ramp0 = time.time()
-        for si, rate in enumerate(rates):
+        for si, (rate, seg_s) in enumerate(segments):
             reqs: List[str] = []
-            t_end = time.time() + step_s
+            t_end = time.time() + seg_s
             t_next = time.time()
             while t_next < t_end:
                 delay = t_next - time.time()
@@ -236,11 +270,11 @@ def run_capacity(
                 reqs.append(rid)
                 t_next += rng.expovariate(rate)
             steps_reqs.append(reqs)
-            log(f"[loadgen] step {si}: target {rate:g} QPS -> {len(reqs)} requests in {step_s:g}s")
+            log(f"[loadgen] step {si}: target {rate:g} QPS -> {len(reqs)} requests in {seg_s:g}s")
 
         # ---- drain: give in-flight work a bounded window to terminal
         if drain_s is None:
-            drain_s = max(2 * step_s, 10.0)
+            drain_s = max(2 * max(s for _r, s in segments), 10.0)
         t_cutoff = time.time() + drain_s
         while time.time() < t_cutoff:
             open_reqs = [
@@ -258,7 +292,7 @@ def run_capacity(
         # a ramp step is its own window)
         now = time.time()
         steps_out: List[Dict] = []
-        for si, (rate, reqs) in enumerate(zip(rates, steps_reqs)):
+        for si, ((rate, seg_s), reqs) in enumerate(zip(segments, steps_reqs)):
             tracker = SloTracker(objective_s=objective_s, target=target, window_s=0.0)
             done = errors = unfinished = 0
             for rid in reqs:
@@ -285,8 +319,12 @@ def run_capacity(
                 "done": done,
                 "errors": errors,
                 "unfinished": unfinished,
-                "duration_s": round(step_s, 3),
-                "completed_qps": round(done / step_s, 4) if step_s > 0 else 0.0,
+                # served-under-SLO: done AND inside the objective — THE
+                # scheduler-A/B comparison count (a late `done` is not
+                # a served request to an SLO)
+                "served_under_slo": snap["good"],
+                "duration_s": round(seg_s, 3),
+                "completed_qps": round(done / seg_s, 4) if seg_s > 0 else 0.0,
                 "p50_s": snap["p50_s"],
                 "p95_s": snap["p95_s"],
                 "max_s": snap["max_s"],
@@ -296,8 +334,8 @@ def run_capacity(
             })
             log(
                 f"[loadgen] step {si}: {rate:g} QPS offered={len(reqs)} done={done} "
-                f"p95={snap['p95_s']:.2f}s attainment={snap['attainment']:.3f} "
-                f"{'OK' if ok else 'MISS'}"
+                f"under_slo={snap['good']} p95={snap['p95_s']:.2f}s "
+                f"attainment={snap['attainment']:.3f} {'OK' if ok else 'MISS'}"
             )
 
         passing = [s["qps_target"] for s in steps_out if s["ok"]]
@@ -314,12 +352,20 @@ def run_capacity(
             "objective_p95_s": objective_s,
             "target": target,
             "step_s": step_s,
+            "trace": ",".join(f"{r:g}x{s:g}" for r, s in segments),
+            # the scheduler arm that served this run (capacity numbers
+            # at different arms are not comparable without it; ONE
+            # normalization rule, owned by pipeline.sched)
+            "sched": _normalize_sched(load_config().sched),
             "drain_s": round(drain_s, 3),
             "steps": steps_out,
             # THE number: the highest offered rate whose step held the
             # objective.  0.0 = no step held it (rates all above capacity —
             # re-run lower), reported honestly rather than extrapolated.
             "max_sustainable_qps": max(passing) if passing else 0.0,
+            # whole-run served-under-SLO count: the scheduler A/B's
+            # scalar (per-segment splits live in `steps`)
+            "served_under_slo": sum(s["served_under_slo"] for s in steps_out),
         }
         if fleet_workers:
             # the serving side was an N-worker fleet (external processes
@@ -357,6 +403,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rates", default="0.5,1,2",
                     help="comma-separated target QPS per ramp step")
     ap.add_argument("--step-s", type=float, default=20.0, help="seconds per ramp step")
+    ap.add_argument("--trace", default="",
+                    help="piecewise arrival trace 'RATExSECONDS,...' (e.g. "
+                         "'0.2x30,4x20,0.2x30' = low->spike->drain; overrides "
+                         "--rates/--step-s; scored per segment)")
     ap.add_argument("--objective-s", type=float, default=None,
                     help="p95 latency objective in s (default: ZKP2P_SLO_P95_S, else 30)")
     ap.add_argument("--target", type=float, default=None,
@@ -367,6 +417,18 @@ def main(argv=None) -> int:
     ap.add_argument("--prove-s", type=float, default=0.0,
                     help="artificial PER-REQUEST prove time, scaled by batch fill "
                          "(smoke-scale saturation; same model in-process and --fleet)")
+    ap.add_argument("--batch-overhead-s", type=float, default=0.0,
+                    help="artificial PER-BATCH fixed prove cost (models the "
+                         "amortization curve's setup term; same model in-process "
+                         "and --fleet)")
+    ap.add_argument("--sched", choices=["off", "adaptive"], default=None,
+                    help="scheduler arm for the serving side (writes ZKP2P_SCHED; "
+                         "default: inherit the environment)")
+    ap.add_argument("--fleet-min", type=int, default=None,
+                    help="with --fleet: autoscale floor (--workers-min)")
+    ap.add_argument("--fleet-max", type=int, default=None,
+                    help="with --fleet: autoscale ceiling (--workers-max; the "
+                         "autoscale demo arm)")
     ap.add_argument("--drain-s", type=float, default=None,
                     help="max wait for in-flight work after the ramp (default 2*step)")
     ap.add_argument("--no-service", action="store_true",
@@ -387,10 +449,24 @@ def main(argv=None) -> int:
     from zkp2p_tpu.utils.config import load_config
     from zkp2p_tpu.utils.metrics import maybe_start_metrics_server
 
+    # the scheduler arm rides the env (fresh-read per sweep): the flag
+    # covers the in-process service AND the --fleet workers (inherited)
+    if args.sched is not None:
+        os.environ["ZKP2P_SCHED"] = args.sched
+
     cfg = load_config()
     objective_s = args.objective_s if args.objective_s is not None else (cfg.slo_p95_s or 30.0)
     target = args.target if args.target is not None else cfg.slo_target
-    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    segments = None
+    if args.trace:
+        try:
+            segments = parse_trace(args.trace)
+        except ValueError as e:
+            print(f"[loadgen] {e}", file=sys.stderr)
+            return 2
+        rates = [r for r, _s in segments]
+    else:
+        rates = [float(r) for r in args.rates.split(",") if r.strip()]
     if not rates or any(r <= 0 for r in rates):
         print(f"[loadgen] bad --rates {args.rates!r}: need positive QPS values", file=sys.stderr)
         return 2
@@ -430,6 +506,7 @@ def main(argv=None) -> int:
             "--spool", args.spool,
             "--batch", str(args.batch),
             "--prove-s", str(args.prove_s),
+            "--batch-overhead-s", str(args.batch_overhead_s),
             "--max-seconds", "100000",
             "--poll-s", "0.05",
         ]
@@ -452,16 +529,20 @@ def main(argv=None) -> int:
         # in-process arm writes the same env through run_capacity)
         env["ZKP2P_SLO_P95_S"] = f"{objective_s:g}"
         env["ZKP2P_SLO_TARGET"] = f"{target:g}"
-        fleet_proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "zkp2p_tpu", "fleet",
-                "--spool", args.spool,
-                "--workers", str(args.fleet),
-                "--fleet-dir", fleet_dir,
-                "--worker-cmd", json.dumps(worker_argv),
-            ],
-            env=env, cwd=REPO,
-        )
+        fleet_argv = [
+            sys.executable, "-m", "zkp2p_tpu", "fleet",
+            "--spool", args.spool,
+            "--workers", str(args.fleet),
+            "--fleet-dir", fleet_dir,
+            "--worker-cmd", json.dumps(worker_argv),
+        ]
+        if args.fleet_min is not None:
+            fleet_argv += ["--workers-min", str(args.fleet_min)]
+        if args.fleet_max is not None:
+            # the autoscale demo arm: workers grow on the spike, drain
+            # back down after it (pipeline.sched.AutoscalePolicy)
+            fleet_argv += ["--workers-max", str(args.fleet_max)]
+        fleet_proc = subprocess.Popen(fleet_argv, env=env, cwd=REPO)
         # readiness gate: score only once the FLEET /status answers 200
         # — i.e. every live worker is up, scrapable, AND has armed its
         # gates (preflight).  Stronger than the old N-heartbeat-files
@@ -514,7 +595,8 @@ def main(argv=None) -> int:
             svc, args.spool, rates, args.step_s, objective_s, target=target,
             payload_fn=payload_fn, seed=args.seed, drain_s=args.drain_s,
             run_service=not args.no_service and not args.fleet, circuit=circuit,
-            prove_sleep_s=args.prove_s, fleet_workers=args.fleet,
+            prove_sleep_s=args.prove_s, batch_overhead_s=args.batch_overhead_s,
+            fleet_workers=args.fleet, segments=segments,
         )
         if args.fleet and fleet_status_url:
             # the serving fleet's own read of the run, BEFORE teardown:
@@ -524,6 +606,9 @@ def main(argv=None) -> int:
             fs = http_status_json(fleet_status_url, timeout=5)
             if fs:
                 report["fleet_slo"] = fs.get("slo")
+                # autoscale record: band, live count, every scale event
+                # this run took (the demo's acceptance surface)
+                report["fleet_sched"] = fs.get("sched")
                 report["fleet_alerts"] = {
                     "active": fs.get("alerts", []),
                     "fired": {

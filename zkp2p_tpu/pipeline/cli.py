@@ -366,6 +366,10 @@ def cmd_service(args):
         os.environ["ZKP2P_SLO_P95_S"] = str(args.slo_p95_s)
     if getattr(args, "ts_sample_s", None) is not None:
         os.environ["ZKP2P_TS_SAMPLE_S"] = str(args.ts_sample_s)
+    # adaptive scheduler arm (pipeline.sched; fresh-read per sweep, so
+    # the env write is the whole wiring)
+    if getattr(args, "sched_flag", None) is not None:
+        os.environ["ZKP2P_SCHED"] = args.sched_flag
     # fault-tolerance policy (docs/ROBUSTNESS.md): flags override the
     # ZKP2P_DEADLINE_S / ZKP2P_SPOOL_CAP config defaults; None defers
     svc_kw = dict(
@@ -453,6 +457,7 @@ def cmd_fleet(args):
         for flag, v in (
             ("--deadline-s", args.deadline_s), ("--spool-cap", args.spool_cap),
             ("--slo-p95-s", args.slo_p95_s), ("--ts-sample-s", args.ts_sample_s),
+            ("--sched", args.sched_flag),
         ):
             if v is not None:
                 base += [flag, str(v)]
@@ -473,6 +478,10 @@ def cmd_fleet(args):
                 f"--fleet-metrics-port {args.fleet_metrics_port!r}: want a port, 'auto', or 0"
             )
 
+    # a --sched flag on the supervisor reaches workers through the env
+    # (the child env inherits; the knob is fresh-read per sweep)
+    if args.sched_flag is not None:
+        os.environ["ZKP2P_SCHED"] = args.sched_flag
     sup = FleetSupervisor(
         args.spool, worker_cmd,
         workers=args.workers,
@@ -485,6 +494,10 @@ def cmd_fleet(args):
         rss_hard_mb=args.rss_hard_mb,
         liveness_s=args.liveness_s,
         fleet_metrics_port=fleet_metrics_port,
+        workers_min=args.workers_min,
+        workers_max=args.workers_max,
+        scale_up_s=args.scale_up_s,
+        scale_down_s=args.scale_down_s,
         log=lambda m: _log(f"fleet: {m}"),
     )
     # the supervisor's own exposition (fleet gauges/counters) — workers
@@ -735,6 +748,10 @@ def main(argv=None):
     s.add_argument("--ts-sample-s", type=float, default=None,
                    help="time-series sampler interval in s "
                         "(default: ZKP2P_TS_SAMPLE_S; 0 = off)")
+    s.add_argument("--sched", dest="sched_flag", choices=["off", "adaptive"], default=None,
+                   help="batching/admission scheduler: off = static batch_size + "
+                        "newest-first shed; adaptive = SLO-driven sizing, deadline-"
+                        "aware shed, priority lanes (default: ZKP2P_SCHED)")
     s.add_argument("--max-seconds", type=float, default=None,
                    help="exit (rc 2) after this many seconds (tests/fleet smokes)")
     s.add_argument("--exit-when-terminal", action="store_true",
@@ -788,6 +805,19 @@ def main(argv=None):
                    help="fleet observability plane port: aggregated /metrics + /status "
                         "+ /healthz ('auto'/0 = ephemeral, recorded in status.json; "
                         "default: ZKP2P_FLEET_METRICS_PORT; unset = plane off)")
+    s.add_argument("--sched", dest="sched_flag", choices=["off", "adaptive"], default=None,
+                   help="worker batching/admission scheduler arm (default: ZKP2P_SCHED)")
+    s.add_argument("--workers-min", type=int, default=None,
+                   help="autoscale floor (default: ZKP2P_WORKERS_MIN)")
+    s.add_argument("--workers-max", type=int, default=None,
+                   help="autoscale ceiling; 0 = autoscale off "
+                        "(default: ZKP2P_WORKERS_MAX)")
+    s.add_argument("--scale-up-s", type=float, default=None,
+                   help="how long backlog growth / slo burn must hold before +1 worker "
+                        "(default: ZKP2P_SCALE_UP_S)")
+    s.add_argument("--scale-down-s", type=float, default=None,
+                   help="how long an idle backlog must hold before -1 worker "
+                        "(default: ZKP2P_SCALE_DOWN_S)")
     s.set_defaults(fn=cmd_fleet)
 
     s = sub.add_parser("top", help="live fleet view: poll the fleet /status and render it")

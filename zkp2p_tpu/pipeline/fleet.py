@@ -96,17 +96,21 @@ def install_drain_handlers(svc) -> bool:
         return False
 
 
-def slowed_prover(inner, per_request_s: float):
-    """Wrap a batch prover with artificial PER-REQUEST service time,
-    scaled by batch fill — THE one service-time model the toy capacity
-    arms share (loadgen in-process AND the chaos/fleet workers), so
-    their QPS numbers stay comparable by construction.  Keeps the
-    `reads_msm_knobs` marker: the degradation ladder gates on it."""
-    if per_request_s <= 0:
+def slowed_prover(inner, per_request_s: float, batch_overhead_s: float = 0.0):
+    """Wrap a batch prover with artificial service time — THE one
+    service-time model the toy capacity arms share (loadgen in-process
+    AND the chaos/fleet workers), so their QPS numbers stay comparable
+    by construction: `batch_overhead_s + per_request_s * fill` per
+    prover call.  The per-BATCH overhead term models the real
+    amortization curve's fixed cost (base sweep setup, dispatch) so
+    scheduler A/Bs have a curve to sit on; 0 (the default) keeps the
+    PR-8 purely-linear model.  Keeps the `reads_msm_knobs` marker: the
+    degradation ladder gates on it."""
+    if per_request_s <= 0 and batch_overhead_s <= 0:
         return inner
 
     def slowed(dpk, wits):
-        time.sleep(per_request_s * max(1, len(wits)))
+        time.sleep(batch_overhead_s + per_request_s * max(1, len(wits)))
         return inner(dpk, wits)
 
     slowed.reads_msm_knobs = getattr(inner, "reads_msm_knobs", False)
@@ -185,6 +189,12 @@ def _write_heartbeat(svc, fleet_dir: str, state: Optional[str] = None) -> None:
         "rss_mb": _rss_mb(os.getpid()),
         "degraded": bool(getattr(svc, "_fleet_degraded", False)),
     }
+    # the worker's last scheduler decision (pipeline.sched block:
+    # mode, batch target, lane depths) — surfaces in fleet /status
+    # and `zkp2p-tpu top` without another scrape route
+    sched_hb = getattr(svc, "_sched_hb", None)
+    if sched_hb:
+        hb["sched"] = dict(sched_hb)
     # serialized SLO window (capped — the heartbeat is written every
     # ~5 s): the fleet plane's FALLBACK merge source when the worker's
     # /snapshot scrape fails (port not yet bound, worker mid-restart),
@@ -300,6 +310,12 @@ class WorkerSlot:
     soft_signalled: bool = False
     governor_deadline: float = 0.0  # hard-governor drain escalation deadline (0 = none)
     governor_restart: bool = False  # next exit is a governor restart, not a crash
+    # autoscale scale-down: the worker was SIGTERM'd to leave the fleet
+    # (graceful drain — zero lost requests); its exit is final whatever
+    # the rc, and a drain overrunning scale_deadline escalates like the
+    # fleet drain does
+    retiring: bool = False
+    scale_deadline: float = 0.0
 
 
 class FleetSupervisor:
@@ -325,6 +341,10 @@ class FleetSupervisor:
         rss_hard_mb: Optional[int] = None,
         liveness_s: float = 60.0,
         fleet_metrics_port: Optional[int] = None,
+        workers_min: Optional[int] = None,
+        workers_max: Optional[int] = None,
+        scale_up_s: Optional[float] = None,
+        scale_down_s: Optional[float] = None,
         log: Callable[[str], None] = lambda m: print(f"[fleet] {m}", flush=True),
     ):
         from ..utils.audit import record_arm
@@ -365,6 +385,43 @@ class FleetSupervisor:
             fleet_metrics_port if fleet_metrics_port is not None else cfg.fleet_metrics_port
         )
         self.plane = None
+        # fleet autoscaling (pipeline.sched.AutoscalePolicy; ROADMAP
+        # item 2): live workers move inside [workers_min, workers_max]
+        # on the plane's merged backlog trend + burn rate, with
+        # hysteresis windows scale_up_s/scale_down_s.  workers_max == 0
+        # (the default) = off, exactly the PR-10 static fleet.
+        self.workers_min = workers_min if workers_min is not None else cfg.workers_min
+        self.workers_max = workers_max if workers_max is not None else cfg.workers_max
+        self.scale_up_s = scale_up_s if scale_up_s is not None else cfg.scale_up_s
+        self.scale_down_s = scale_down_s if scale_down_s is not None else cfg.scale_down_s
+        self.autoscale = self.workers_max > 0
+        self._autoscaler = None
+        self._scale_events: List[Dict] = []
+        self._next_widx = self.n
+        if self.autoscale:
+            from .sched import AutoscalePolicy
+
+            self.workers_min = max(1, self.workers_min)
+            self.workers_max = max(self.workers_min, self.workers_max)
+            # start inside the band: --workers seeds, the bounds clamp
+            if self.n < self.workers_min or self.n > self.workers_max:
+                was = self.n
+                self.n = min(max(self.n, self.workers_min), self.workers_max)
+                log(f"autoscale: initial workers {was} clamped to {self.n} "
+                    f"(band [{self.workers_min}, {self.workers_max}])")
+                self.slots = {f"w{i}": WorkerSlot(wid=f"w{i}") for i in range(self.n)}
+                self._next_widx = self.n
+            self._autoscaler = AutoscalePolicy(
+                self.workers_min, self.workers_max,
+                scale_up_s=self.scale_up_s, scale_down_s=self.scale_down_s,
+                burn_threshold=cfg.alert_burn_rate,
+            )
+            # the policy consumes the plane's merged signals — without
+            # an endpoint the plane never runs, so autoscale implies an
+            # (ephemeral, if unconfigured) plane port
+            if self.fleet_metrics_port is None:
+                self.fleet_metrics_port = 0
+                log("autoscale needs the fleet plane: enabling an ephemeral fleet metrics port")
         record_arm("service_fleet", f"supervisor:{self.n}")
         governor_arm()
 
@@ -529,6 +586,15 @@ class FleetSupervisor:
                 if self._draining:
                     # during a fleet drain any exit is final
                     slot.state = "done"
+                elif slot.retiring:
+                    # autoscale scale-down: the exit we asked for — the
+                    # worker drained its claims and left; final whatever
+                    # the rc (a SIGKILL-escalated straggler's claims go
+                    # stale and peers take them over — zero lost)
+                    slot.state = "done"
+                    slot.retiring = False
+                    slot.scale_deadline = 0.0
+                    self.log(f"{slot.wid}: scaled down (rc={rc})")
                 elif slot.governor_restart:
                     # governor-requested recycle (hard RSS): immediate,
                     # no breaker penalty — OOM pressure is recoverable,
@@ -552,7 +618,19 @@ class FleetSupervisor:
                 else:
                     self._on_failure(slot, now, f"exited rc={rc}")
                 continue
-            # alive: hard-governor escalation, watchdog, governor
+            # alive: scale-down escalation, hard-governor escalation,
+            # watchdog, governor
+            if slot.retiring:
+                if slot.scale_deadline and now > slot.scale_deadline:
+                    self.log(f"{slot.wid}: scale-down drain timed out — SIGKILL")
+                    self.escalations += 1
+                    REGISTRY.counter("zkp2p_fleet_drain_escalations_total").inc()
+                    try:
+                        slot.proc.kill()
+                    except OSError:
+                        pass
+                    slot.scale_deadline = 0.0
+                continue  # a retiring worker is leaving: no watchdog/governor
             if slot.governor_deadline and now > slot.governor_deadline:
                 self.log(f"{slot.wid}: governor drain timed out — SIGKILL")
                 self.escalations += 1
@@ -585,17 +663,94 @@ class FleetSupervisor:
                     pass
                 continue
             self._governor(slot, now)
+        self._autoscale_tick(now)
         # fleet-level gauges + the status file
         counts: Dict[str, int] = {}
         for slot in self.slots.values():
             counts[slot.state] = counts.get(slot.state, 0) + 1
-        for state in ("up", "backoff", "parked", "done", "starting"):
+        for state in ("up", "backoff", "parked", "done", "starting", "retiring"):
             REGISTRY.gauge("zkp2p_fleet_workers", {"state": state}).set(counts.get(state, 0))
         self._write_status(now)
 
+    # -------------------------------------------------------- autoscale
+
+    def _live_workers(self) -> List[WorkerSlot]:
+        """Slots currently serving (or about to): up/starting/backoff
+        and not leaving — the count the autoscale band governs.
+        Snapshot (list) because scale-up mutates `slots` while the
+        plane's scrape thread and /status handlers also iterate it."""
+        return [
+            s for s in list(self.slots.values())
+            if s.state in ("up", "starting", "backoff") and not s.retiring
+        ]
+
+    def _autoscale_tick(self, now: float) -> None:
+        """One autoscale evaluation: feed the plane's merged signals
+        (backlog trend, burn rates — nothing a single worker can see)
+        through the hysteresis policy; apply at most one step.  Scale
+        up = spawn a FRESH slot (ids never recycle — wN stays unique in
+        records across the run); scale down = graceful drain of the
+        newest live worker (SIGTERM → finishes in-flight claims, exits
+        0; zero lost, zero duplicated — the PR-10 drain contract)."""
+        if self._autoscaler is None or self._draining or self.plane is None:
+            return
+        from ..utils.metrics import REGISTRY
+
+        signals = self.plane.last_signals()
+        if signals is None:
+            return
+        live = self._live_workers()
+        REGISTRY.gauge("zkp2p_fleet_workers_target").set(len(live))
+        decision = self._autoscaler.update(now, len(live), signals)
+        if decision is None:
+            return
+        if decision["direction"] == "up":
+            wid = f"w{self._next_widx}"
+            self._next_widx += 1
+            slot = self.slots[wid] = WorkerSlot(wid=wid)
+            self._spawn(slot)
+            n_after = len(live) + 1
+        else:
+            # newest-first shrink: the highest-index live "up" worker —
+            # the longest-lived keep their warm caches.  The floor
+            # bounds RUNNING workers: slots in backoff/starting count
+            # as live for the policy, but draining the only "up" worker
+            # while its peers wait out a backoff would leave the spool
+            # unserved below workers_min
+            candidates = [s for s in live if s.state == "up" and s.proc is not None
+                          and not s.governor_deadline]
+            if not candidates or len(candidates) - 1 < self.workers_min:
+                return
+            victim = max(candidates, key=lambda s: int(s.wid[1:]) if s.wid[1:].isdigit() else 0)
+            try:
+                victim.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                return
+            victim.state = "retiring"
+            victim.retiring = True
+            victim.scale_deadline = now + (self.drain_timeout_s or 10.0)
+            wid = victim.wid
+            n_after = len(live) - 1
+        REGISTRY.counter(
+            "zkp2p_sched_decisions_total", {"kind": f"scale_{decision['direction']}"}
+        ).inc()
+        REGISTRY.gauge("zkp2p_fleet_workers_target").set(n_after)
+        event = {
+            "ts": round(now, 3), "direction": decision["direction"],
+            "reason": decision["reason"], "worker": wid, "workers": n_after,
+        }
+        self._scale_events.append(event)
+        self.log(
+            f"autoscale: {decision['direction']} ({decision['reason']}) — "
+            f"{wid}, fleet now targets {n_after} worker(s) "
+            f"in [{self.workers_min}, {self.workers_max}]"
+        )
+
     def status(self) -> Dict:
         workers = {}
-        for slot in self.slots.values():
+        # list(): status() runs on plane HTTP-handler and scrape
+        # threads while the autoscaler inserts slots from the tick
+        for slot in list(self.slots.values()):
             hb = self._hb(slot) or {}
             workers[slot.wid] = {
                 "pid": slot.proc.pid if slot.proc is not None else None,
@@ -611,6 +766,25 @@ class FleetSupervisor:
                 "hb_state": hb.get("state"),
                 "degraded": hb.get("degraded", False),
             }
+            # the worker's last scheduler decision (batch target, lane
+            # depths) — rides the heartbeat, rendered by `zkp2p-tpu top`
+            if hb.get("sched"):
+                workers[slot.wid]["sched"] = hb["sched"]
+        sched_block: Dict = {"autoscale": self.autoscale}
+        if self.autoscale:
+            sched_block.update({
+                "workers_min": self.workers_min,
+                "workers_max": self.workers_max,
+                "workers_live": len(self._live_workers()),
+                "scale_events": len(self._scale_events),
+                "last_scale": self._scale_events[-1] if self._scale_events else None,
+                # the full event history (newest 50 — a flapping-free
+                # policy makes more an impossibility, but bound the
+                # status payload anyway): the auditable record of every
+                # grow/shrink this run took, in status.json and the
+                # loadgen capacity JSON
+                "events": list(self._scale_events[-50:]),
+            })
         return {
             "type": "fleet_status",
             "fleet_id": self.fleet_id,
@@ -618,6 +792,7 @@ class FleetSupervisor:
             "pid": os.getpid(),
             "spool": self.spool,
             "workers": workers,
+            "sched": sched_block,
             "drain_timeout_s": self.drain_timeout_s,
             "escalations": self.escalations,
             "watchdog_kills": self.watchdog_kills,
@@ -650,11 +825,15 @@ class FleetSupervisor:
         self._draining = True
         live = [s for s in self.slots.values() if s.proc is not None and s.proc.poll() is None]
         for slot in live:
-            slot.state = "draining"
-            try:
-                slot.proc.send_signal(signal.SIGTERM)
-            except OSError:
-                pass
+            # a retiring worker already got its SIGTERM — a second one
+            # while it drains means "exit NOW" (install_drain_handlers'
+            # stay-killable contract) and would strand its claims
+            if not slot.retiring:
+                slot.state = "draining"
+                try:
+                    slot.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
         self.log(f"draining {len(live)} worker(s), timeout {timeout:g}s")
         deadline = time.time() + max(timeout, 0.0)
         clean = True

@@ -184,9 +184,13 @@ class FleetPlane:
         live = unarmed = unreachable = 0
         degraded = 0
         hb_gap: Optional[float] = None
-        for slot in self.sup.slots.values():
+        # list(): the supervisor's autoscaler inserts slots mid-run,
+        # and iterating the live dict from this (scrape) thread would
+        # RuntimeError exactly at scale events — when the merged
+        # signals matter most
+        for slot in list(self.sup.slots.values()):
             alive = slot.proc is not None and slot.proc.poll() is None
-            if not alive or slot.state not in ("up", "starting", "draining"):
+            if not alive or slot.state not in ("up", "starting", "draining", "retiring"):
                 continue
             live += 1
             hb = self.sup._hb(slot) or {}
@@ -249,7 +253,7 @@ class FleetPlane:
         publish_fleet_slo(merged_slo, registry=REGISTRY)
 
         # alert signals out of the merged view + supervisor state
-        total_restarts = sum(s.restarts for s in self.sup.slots.values())
+        total_restarts = sum(s.restarts for s in list(self.sup.slots.values()))
         self._restart_trend.update(t, total_restarts)
         restarts_recent = self._restart_trend.delta(self._restarts_window_s, t)
         signals = {
@@ -259,7 +263,7 @@ class FleetPlane:
             "backlog": scan["backlog"],
             "backlog_growing": self._trend.growing(self._alert_for_s, t),
             "restarts_recent": restarts_recent,
-            "parked": sum(1 for s in self.sup.slots.values() if s.state == "parked"),
+            "parked": sum(1 for s in list(self.sup.slots.values()) if s.state == "parked"),
             "degraded": degraded,
             "hb_gap_s": hb_gap,
         }
@@ -337,6 +341,13 @@ class FleetPlane:
 
     def alert_log(self) -> List[Dict]:
         return list(self._alert_log)
+
+    def last_signals(self) -> Optional[Dict]:
+        """The newest scrape cycle's alert/autoscale signal map (None
+        before the first completed cycle) — the supervisor's autoscaler
+        consumes this instead of re-deriving its own view."""
+        with self._lock:
+            return self._view.get("signals")
 
     # --------------------------------------------------------- lifecycle
 
@@ -484,6 +495,32 @@ def render_top(body: Dict) -> str:
             f"queue: backlog {sig.get('backlog')}  "
             f"restarts(win) {sig.get('restarts_recent')}  "
             f"parked {sig.get('parked')}  degraded {sig.get('degraded')}"
+        )
+    # scheduler block: per-worker batch targets + lane depths (worker
+    # heartbeats) and the supervisor's autoscale state
+    sched = body.get("sched") or {}
+    wsched = {
+        wid: w["sched"] for wid, w in (body.get("workers") or {}).items() if w.get("sched")
+    }
+    if wsched:
+        lines.append("sched: " + "  ".join(
+            f"{wid}[{s.get('mode', '?')}] tgt={s.get('batch_target')}"
+            + (
+                f" lanes i{s.get('lane_interactive', 0)}/b{s.get('lane_bulk', 0)}"
+                if s.get("mode") == "adaptive" else ""
+            )
+            for wid, s in sorted(wsched.items())
+        ))
+    if sched.get("autoscale"):
+        last = sched.get("last_scale")
+        lines.append(
+            f"autoscale: {sched.get('workers_live')} live in "
+            f"[{sched.get('workers_min')}..{sched.get('workers_max')}]  "
+            f"events {sched.get('scale_events', 0)}"
+            + (
+                f"  last {last['direction']} ({last.get('reason')}) -> {last.get('workers')} @ {last.get('ts')}"
+                if last else "  last none"
+            )
         )
     alerts = body.get("alerts") or []
     if alerts:

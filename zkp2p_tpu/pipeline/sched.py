@@ -1,0 +1,549 @@
+"""The adaptive admission-and-batching scheduler (ROADMAP item 2).
+
+Every scaling knob in the proving service used to be static: batch
+size was a constructor argument, the fleet was `--workers N`, and the
+admission cap shed newest-first.  All the signals needed to close the
+loop already existed — the PR-8 arrival-rate/backlog sampler, the
+PR-12 fleet burn rates, the measured batch amortization curve (batch=4
+proves in 13.3 s vs 4x3.17 s sequential on the 499k circuit) — and
+this module is the controller that sits on them.  It is the same shape
+as continuous-batching schedulers in inference serving (Orca-style
+iteration-level scheduling; zkSpeed in PAPERS.md likewise treats batch
+geometry as a load-dependent dial): at low load small batches minimize
+latency, at high load wide batches maximize throughput, and only a
+controller can hold the right point of that curve as traffic moves.
+
+Three deterministic pieces (docs/SCHEDULING.md has the full model):
+
+  AmortModel        the per-circuit batch cost curve batch_s(S):
+                    measured points with linear interpolation,
+                    calibrated from BENCH/loadgen data via
+                    ZKP2P_SCHED_AMORT ("S:sec,S:sec,...") or the
+                    built-in conservative venmo default.
+  BatchController   per sweep: EWMA arrival rate from spool mtimes,
+                    expected-deadline-miss shedding (a greedy walk in
+                    service order — shed exactly the requests the
+                    model predicts cannot finish, never ones that
+                    still can), priority lanes (interactive requests
+                    batch first at a small lane width while bulk
+                    rides wide), and SLO-driven batch sizing: the
+                    largest S whose predicted completion keeps the
+                    oldest queued request inside its deadline/
+                    objective, clamped to [1, cap] and to the live
+                    backlog.
+  AutoscalePolicy   fleet grow/shrink between workers-min/max from the
+                    fleet plane's merged backlog trend + burn rate,
+                    with alerts-style hysteresis (a condition must
+                    hold scale_up_s/scale_down_s CONTINUOUSLY before
+                    a decision; any flap resets the clock, so a
+                    boundary-oscillating signal never flaps the fleet).
+
+Everything here is pure over (clock, inputs): no registry writes, no
+env reads outside the typed config, injectable clocks — the service
+and the fleet supervisor own the side effects (metrics, records,
+spawns), tests drive synthetic time.
+
+The gate: ZKP2P_SCHED=off|adaptive, fresh-read per sweep, ARMABLE,
+record_arm'd as `service_sched` (sched_arm below, preflight-armed) —
+the PR-2/PR-5 discipline, so adaptive-vs-off A/Bs are
+digest-distinguishable.  `off` (the default) reproduces the static
+path byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# Built-in conservative amortization default: the measured 499k venmo
+# curve (PR-9: single prove 3.17 s, batch=4 13.3 s at threads=2).
+# Nearly linear on the 2-core box — which makes the default CONSERVATIVE
+# for batching: the controller never assumes amortization a host has not
+# measured.  Calibrate per circuit/host via ZKP2P_SCHED_AMORT.
+DEFAULT_AMORT_POINTS: Dict[int, float] = {1: 3.17, 4: 13.3}
+
+# Interactive latency-lane width: interactive batches never exceed this
+# many columns, however wide the bulk target is — the lane exists so an
+# interactive request's service time is bounded by a small batch even
+# when bulk traffic has driven the controller to the cap.
+INTERACTIVE_LANE_CAP = 2
+
+
+class AmortModel:
+    """Piecewise-linear batch cost model: batch_s(S) = predicted wall
+    seconds to prove a batch of S, interpolated between measured points.
+    Below the smallest measured S the cost scales proportionally; above
+    the largest it extends along the last segment's slope (one point =
+    proportional everywhere).  Points must be positive and strictly
+    increasing in both S and seconds — a non-monotone curve would let
+    the controller "prove" a wider batch finishes sooner."""
+
+    def __init__(self, points: Dict[int, float]):
+        items = sorted((int(s), float(t)) for s, t in points.items())
+        if not items:
+            raise ValueError("AmortModel needs at least one (S, seconds) point")
+        last_s, last_t = 0, 0.0
+        for s, t in items:
+            if s <= last_s or t <= last_t:
+                raise ValueError(
+                    f"amortization points must be strictly increasing: ({s}:{t}) after ({last_s}:{last_t})"
+                )
+            last_s, last_t = s, t
+        self.points: List[Tuple[int, float]] = items
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "AmortModel":
+        """Parse a "S:seconds,S:seconds" calibration spec (the
+        ZKP2P_SCHED_AMORT knob); empty = the built-in default.  A
+        malformed spec raises LOUDLY — a silently-defaulted calibration
+        would make every sizing decision wrong without a trace (the
+        utils.faults malformed-spec rule applied here)."""
+        if not spec.strip():
+            return cls(DEFAULT_AMORT_POINTS)
+        pts: Dict[int, float] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                s_raw, t_raw = part.split(":")
+                s, t = int(s_raw), float(t_raw)
+            except ValueError as e:
+                raise ValueError(
+                    f"bad ZKP2P_SCHED_AMORT entry {part!r} (want 'S:seconds,...'): {e}"
+                ) from None
+            if s in pts:
+                raise ValueError(f"duplicate ZKP2P_SCHED_AMORT batch size {s}")
+            pts[s] = t
+        return cls(pts)
+
+    def batch_s(self, s: int) -> float:
+        """Predicted wall seconds for a batch of `s` live requests."""
+        if s <= 0:
+            return 0.0
+        pts = self.points
+        if s <= pts[0][0]:
+            return pts[0][1] * s / pts[0][0]
+        for (s0, t0), (s1, t1) in zip(pts, pts[1:]):
+            if s <= s1:
+                return t0 + (t1 - t0) * (s - s0) / (s1 - s0)
+        if len(pts) >= 2:
+            (s0, t0), (s1, t1) = pts[-2], pts[-1]
+            slope = (t1 - t0) / (s1 - s0)
+        else:
+            slope = pts[0][1] / pts[0][0]
+        return pts[-1][1] + slope * (s - pts[-1][0])
+
+    def per_proof_s(self, s: int) -> float:
+        return self.batch_s(s) / s if s > 0 else float("inf")
+
+    def best_throughput_size(self, cap: int) -> int:
+        """The S in [1, cap] minimizing per-proof seconds (ties break to
+        the SMALLER batch — same throughput, better latency)."""
+        cap = max(1, cap)
+        best_s, best_t = 1, self.per_proof_s(1)
+        for s in range(2, cap + 1):
+            t = self.per_proof_s(s)
+            if t < best_t - 1e-12:
+                best_s, best_t = s, t
+        return best_s
+
+
+@dataclass(frozen=True)
+class SchedRequest:
+    """One queued request as the controller sees it: identity, spool
+    arrival time, absolute deadline (None = no hard deadline), lane."""
+
+    rid: str
+    t_submit: float
+    deadline: Optional[float] = None
+    interactive: bool = False
+
+
+@dataclass
+class SweepPlan:
+    """One sweep's decisions: the batch partition in service order
+    (interactive lane first), the shed verdicts, and the telemetry the
+    service records (gauge values, decision-line fields)."""
+
+    batches: List[List[SchedRequest]] = field(default_factory=list)
+    shed: List[Tuple[SchedRequest, str]] = field(default_factory=list)
+    batch_target: int = 0           # the bulk-lane S (0 = no bulk work)
+    interactive_target: int = 0     # the interactive-lane S (0 = none)
+    batch_reason: str = "idle"      # slo | throughput | backlog | warmup | idle
+    rate_hz: float = 0.0
+    oldest_wait_s: float = 0.0
+    lanes: Dict[str, int] = field(default_factory=dict)
+
+
+class BatchController:
+    """The per-worker admission-and-batching controller.  Stateful only
+    in the arrival-rate EWMA; plan() is otherwise pure over (now, queue)
+    so tests drive synthetic arrival streams with injected clocks."""
+
+    def __init__(
+        self,
+        amort: AmortModel,
+        objective_s: float = 0.0,
+        target_fill: float = 0.8,
+        ewma_tau_s: float = 10.0,
+    ):
+        self.amort = amort
+        self.objective_s = max(0.0, float(objective_s))
+        # headroom fraction of the deadline/objective budget batches are
+        # planned to — 0.8 leaves 20% for queue wait drift, witness
+        # time, and model error between sizing and completion
+        self.target_fill = min(max(float(target_fill), 0.05), 1.0)
+        self.ewma_tau_s = max(0.1, float(ewma_tau_s))
+        self.rate_hz = 0.0
+        self._last_now: Optional[float] = None
+        # online calibration: EWMA of observed-vs-modelled batch cost.
+        # The static curve (or its built-in venmo default) can be
+        # arbitrarily wrong for THIS circuit/host — on a stub-speed
+        # circuit an uncorrected 3.17 s/proof default would predict
+        # every tight-deadline request hopeless and shed the whole
+        # queue.  Until the first real batch is observed, predictive
+        # shedding applies only to requests whose deadline has ALREADY
+        # passed (model-free truth); after that, predictions ride
+        # model_scale toward measured reality.
+        self.model_scale = 1.0
+        self.calibrated = False
+
+    def observe_batch(self, fill: int, seconds: float) -> float:
+        """Fold one completed batch's actual wall cost into the
+        calibration scale (EWMA of actual/modelled, clamped so one
+        outlier — a cold compile, a stolen core — cannot blow up every
+        prediction).  Returns the current scale."""
+        if fill <= 0 or seconds <= 0:
+            return self.model_scale
+        modelled = self.amort.batch_s(fill)
+        if modelled <= 0:
+            return self.model_scale
+        ratio = min(max(seconds / modelled, 0.02), 50.0)
+        if not self.calibrated:
+            self.model_scale = ratio
+            self.calibrated = True
+        else:
+            self.model_scale += 0.3 * (ratio - self.model_scale)
+        return self.model_scale
+
+    def _batch_s(self, s: int) -> float:
+        """The model with the online calibration applied."""
+        return self.model_scale * self.amort.batch_s(s)
+
+    # ------------------------------------------------------------ arrivals
+
+    def observe_arrivals(self, now: float, t_submits: List[float]) -> float:
+        """Update the EWMA arrival rate from the queue's spool mtimes:
+        arrivals since the last observation are the t_submits inside
+        (last_now, now].  First observation seeds the rate from the
+        trailing tau window (a controller born into a storm must not
+        start from zero).  Returns the current rate in Hz."""
+        if self._last_now is None:
+            n = sum(1 for t in t_submits if now - t <= self.ewma_tau_s)
+            self.rate_hz = n / self.ewma_tau_s
+            self._last_now = now
+            return self.rate_hz
+        dt = now - self._last_now
+        if dt <= 0:
+            return self.rate_hz
+        arrivals = sum(1 for t in t_submits if self._last_now < t <= now)
+        inst = arrivals / dt
+        alpha = 1.0 - math.exp(-dt / self.ewma_tau_s)
+        self.rate_hz += alpha * (inst - self.rate_hz)
+        self._last_now = now
+        return self.rate_hz
+
+    # ------------------------------------------------------------- sizing
+
+    def _budget_s(self, req: SchedRequest, now: float) -> Optional[float]:
+        """Remaining latency budget for `req` at `now`: time to its hard
+        deadline, else to the SLO objective (anchored at its arrival).
+        None = no bound at all (no deadline, no objective)."""
+        if req.deadline is not None:
+            return req.deadline - now
+        if self.objective_s > 0:
+            return (req.t_submit + self.objective_s) - now
+        return None
+
+    def _size_for(
+        self, now: float, reqs: List[SchedRequest], cap: int, parallelism: int = 1,
+    ) -> Tuple[int, str]:
+        """SLO-driven sizing over `reqs` (MUST be in service order):
+        pick the S in [1, min(cap, backlog)] that maximizes the number
+        of queued requests predicted to finish inside their deadline/
+        objective when the queue is served in S-wide batches — request
+        at position p completes at now + (p//S + 1) * batch_s(S), and
+        "inside" leaves target_fill headroom for queue drift and model
+        error.  Ties break to the LARGER S (same served count, queue
+        cleared sooner).  With one queued request this reduces to "the
+        largest S whose predicted completion keeps it inside its
+        budget"; with a deep queue it holds throughput at the cap
+        instead of collapsing to tiny batches chasing the oldest
+        stragglers (the classic head-of-line inversion).  No bound on
+        any request = pure throughput (the cap); a queue where even the
+        best S serves nobody in time falls back to the best-throughput
+        size — the shed pass owns hopeless requests, sizing must not
+        thrash on them."""
+        n = len(reqs)
+        if n == 0:
+            return 0, "idle"
+        hi = max(1, min(cap, n))
+        # warm-up: until a real batch has confirmed the model, size like
+        # the static arm (everything available up to the cap) — an
+        # unconfirmed curve steering sizing can serialize a fast queue
+        # into its deadlines (a 3.17 s/proof default on a stub circuit
+        # picks S=1 and starves throughput exactly when it matters)
+        if not self.calibrated:
+            return hi, "warmup"
+        par = max(1, int(parallelism))
+        budgets = [self._budget_s(r, now) for r in reqs]
+        if all(b is None for b in budgets):
+            return hi, "backlog"
+        best_s, best_count = 1, -1
+        for s in range(1, hi + 1):
+            bs = self._batch_s(s)
+            count = 0
+            for p, b in enumerate(budgets):
+                if b is None or (p // (s * par) + 1) * bs <= self.target_fill * b:
+                    count += 1
+            if count >= best_count:
+                best_s, best_count = s, count
+        if best_count <= 0:
+            return min(hi, self.amort.best_throughput_size(hi)), "throughput"
+        return best_s, "slo"
+
+    # -------------------------------------------------------------- plan
+
+    def plan(
+        self,
+        now: float,
+        reqs: List[SchedRequest],
+        cap: int,
+        spool_cap: int = 0,
+        allow_shed: bool = True,
+        parallelism: int = 1,
+    ) -> SweepPlan:
+        """One sweep's full decision: lane-sort, shed, partition.
+
+        1. service order: interactive first, then by (t_submit, rid) —
+           oldest-first within a lane, deterministic throughout.
+        2. expected-deadline-miss shed (allow_shed): walk the order;
+           a request at kept-position p is predicted done at now +
+           best_serve_s(p+1), the OPTIMISTIC best batch partition the
+           model admits (min over S of ceil(n/S) * batch_s(S)) — so a
+           request servable under ANY batch geometry is never shed, and
+           a shed one dropped out of virtual capacity first (the walk
+           never sheds a request the removal of earlier hopeless ones
+           would have saved).  Requests without a hard deadline are
+           never predictively shed — a late proof beats no proof.
+        3. admission cap: still over `spool_cap` after step 2, shed by
+           ascending slack (deadline-or-objective minus predicted
+           completion): the most-hopeless go first, a request that can
+           still finish is shed only when the cap leaves no choice.
+        4. partition: interactive lane first in batches of
+           min(size, INTERACTIVE_LANE_CAP); bulk in batches of the
+           SLO-sized S.
+
+        `parallelism` = live workers sharing this spool (>= 1): on a
+        fleet, N workers sweep ONE queue, so a request at position p is
+        really at position ~p/N — predictions (shed walk, cap slack,
+        sizing counts) divide positions by it.  Optimistic perfect
+        speedup on purpose: a worker must never shed a request its
+        PEERS could still serve (the fleet-wide over-shed bug class).
+        """
+        plan = SweepPlan()
+        plan.rate_hz = round(self.observe_arrivals(now, [r.t_submit for r in reqs]), 6)
+        if not reqs:
+            return plan
+        order = sorted(reqs, key=lambda r: (not r.interactive, r.t_submit, r.rid))
+        plan.oldest_wait_s = round(max(0.0, now - min(r.t_submit for r in reqs)), 6)
+
+        par = max(1, int(parallelism))
+        kept: List[SchedRequest] = []
+        if allow_shed:
+            hi = max(1, min(cap, len(order)))
+            pred_cache: Dict[int, float] = {}
+
+            def best_serve_s(count: int) -> float:
+                # optimistic seconds to serve `count` requests: the
+                # best batch partition within the cap (min over S of
+                # ceil(count/S) * batch_s(S)).  Optimistic on purpose:
+                # shed only what cannot finish under ANY geometry; a
+                # kept-but-late request still hits the claim/assembly
+                # deadline gates.
+                t = pred_cache.get(count)
+                if t is None:
+                    t = min(
+                        -(-count // s) * self._batch_s(s) for s in range(1, hi + 1)
+                    )
+                    pred_cache[count] = t
+                return t
+
+            for r in order:
+                if r.deadline is not None:
+                    pred = now + best_serve_s(-(-(len(kept) + 1) // par))
+                    # warm-up guard: until a real batch has calibrated
+                    # the model, trust only the model-free truth (the
+                    # deadline already passed) — a wrong static curve
+                    # must not shed a whole queue of servable requests
+                    miss = (pred > r.deadline) if self.calibrated else (now >= r.deadline)
+                    if miss:
+                        plan.shed.append((r, f"predicted completion +{pred - now:.2f}s past deadline"))
+                        continue
+                kept.append(r)
+            if spool_cap and len(kept) > spool_cap:
+                # slack = budget at predicted completion; no budget at
+                # all sorts LAST-position-first (mirrors the static
+                # arm's newest-first cap semantics for unbounded work)
+                def slack(item: Tuple[int, SchedRequest]) -> Tuple[float, float, str]:
+                    p, r = item
+                    pred = now + best_serve_s(-(-(p + 1) // par))
+                    b = self._budget_s(r, now)
+                    s = (b - (pred - now)) if b is not None else float("inf")
+                    return (s, -p, r.rid)
+
+                ranked = sorted(enumerate(kept), key=slack)
+                to_shed = {id(r) for _p, r in ranked[: len(kept) - spool_cap]}
+                survivors = []
+                for r in kept:
+                    if id(r) in to_shed:
+                        plan.shed.append((r, f"backlog over admission cap {spool_cap}"))
+                    else:
+                        survivors.append(r)
+                kept = survivors
+        else:
+            kept = order
+
+        interactive = [r for r in kept if r.interactive]
+        bulk = [r for r in kept if not r.interactive]
+        plan.lanes = {"interactive": len(interactive), "bulk": len(bulk)}
+        if interactive:
+            s_int, _ = self._size_for(now, interactive, cap, parallelism=par)
+            s_int = max(1, min(s_int, INTERACTIVE_LANE_CAP))
+            plan.interactive_target = s_int
+            for i in range(0, len(interactive), s_int):
+                plan.batches.append(interactive[i : i + s_int])
+        if bulk:
+            s_bulk, reason = self._size_for(now, bulk, cap, parallelism=par)
+            plan.batch_target = s_bulk
+            plan.batch_reason = reason
+            for i in range(0, len(bulk), s_bulk):
+                plan.batches.append(bulk[i : i + s_bulk])
+        elif interactive:
+            plan.batch_target = plan.interactive_target
+            plan.batch_reason = "interactive"
+        return plan
+
+
+# ---------------------------------------------------------------------------
+# Fleet autoscaling.
+
+
+class AutoscalePolicy:
+    """Grow/shrink decisions between [workers_min, workers_max] with
+    explicit hysteresis (the utils.alerts fire/clear discipline applied
+    to scaling): the scale-up condition (merged backlog trend growing,
+    or both burn rates over the alert threshold) must hold CONTINUOUSLY
+    for scale_up_s before a +1; the scale-down condition (empty backlog,
+    no growth) must hold for scale_down_s before a -1.  Any tick where
+    the condition is false resets its clock; a tick with no data (None
+    signals) HOLDS both clocks — missing data is not evidence either
+    way.  Every decision resets BOTH clocks (the cooldown: a second
+    step needs a full fresh window), so a boundary-oscillating signal
+    produces exactly zero decisions, never a flap."""
+
+    def __init__(
+        self,
+        workers_min: int,
+        workers_max: int,
+        scale_up_s: float = 10.0,
+        scale_down_s: float = 30.0,
+        burn_threshold: float = 2.0,
+    ):
+        self.workers_min = max(1, int(workers_min))
+        self.workers_max = max(self.workers_min, int(workers_max))
+        self.scale_up_s = max(0.0, float(scale_up_s))
+        self.scale_down_s = max(0.0, float(scale_down_s))
+        self.burn_threshold = float(burn_threshold)
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+        self._last_reason = ""
+
+    def _up_cond(self, signals: Dict) -> Optional[bool]:
+        growing = signals.get("backlog_growing")
+        bf, bs = signals.get("burn_fast"), signals.get("burn_slow")
+        burn = None
+        if isinstance(bf, (int, float)) and isinstance(bs, (int, float)):
+            n = signals.get("slo_n")
+            burn = bool(n) and bf >= self.burn_threshold and bs >= self.burn_threshold
+        if growing is None and burn is None:
+            return None
+        if growing is True:
+            self._last_reason = "backlog_growth"
+            return True
+        if burn:
+            self._last_reason = "slo_burn"
+            return True
+        return False
+
+    def _down_cond(self, signals: Dict) -> Optional[bool]:
+        backlog = signals.get("backlog")
+        if not isinstance(backlog, (int, float)):
+            return None
+        return backlog <= 0 and signals.get("backlog_growing") is not True
+
+    def update(self, now: float, live: int, signals: Dict) -> Optional[Dict]:
+        """One evaluation tick; returns {"direction": "up"|"down",
+        "reason": ...} when a sustained condition crosses its window and
+        the bound allows the step, else None."""
+        up = self._up_cond(signals)
+        down = self._down_cond(signals)
+        if up is True:
+            self._down_since = None
+            if self._up_since is None:
+                self._up_since = now
+            if now - self._up_since >= self.scale_up_s and live < self.workers_max:
+                self._up_since = self._down_since = None
+                return {"direction": "up", "reason": self._last_reason}
+        elif up is False:
+            self._up_since = None
+        if down is True and up is not True:
+            if self._down_since is None:
+                self._down_since = now
+            if now - self._down_since >= self.scale_down_s and live > self.workers_min:
+                self._up_since = self._down_since = None
+                return {"direction": "down", "reason": "idle"}
+        elif down is False:
+            self._down_since = None
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The audit gate (PR-2/PR-5 discipline): the scheduler mode is a code
+# path — an adaptive run and a static run must never share an execution
+# digest.  Fresh-read per call (load_config re-reads the env), so one
+# process can A/B both arms; anything but the literal "adaptive" fails
+# CLOSED to the static oracle arm.
+
+
+def normalize_sched(value: str) -> str:
+    """The gate grammar in ONE place: anything but the literal
+    "adaptive" fails CLOSED to the static "off" oracle arm (consumers:
+    sched_mode below, the loadgen capacity report)."""
+    return "adaptive" if value == "adaptive" else "off"
+
+
+def sched_mode() -> str:
+    """Resolve + record the scheduler arm: "adaptive" or "off"."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("service_sched", normalize_sched(load_config().sched))
+
+
+def sched_arm() -> str:
+    """Preflight alias (the *_arm naming every other gate resolver
+    uses); identical to sched_mode()."""
+    return sched_mode()

@@ -246,6 +246,15 @@ class Request:
     # slot in the batch the request was CLAIMED into (records keep the
     # original batch attribution across bisection)
     batch_index: Optional[int] = None
+    # the batch size the scheduler INTENDED when this request's batch
+    # was assembled (off arm: the static batch_size cap; adaptive: the
+    # controller's choice) — batch_n alone cannot distinguish "low
+    # load" from "controller chose small", so records carry both
+    batch_target: Optional[int] = None
+    # priority lane (payload `priority` key, default from the
+    # ZKP2P_SCHED_PRIORITY_DEFAULT knob): "interactive" | "bulk".  The
+    # static arm ignores it; the adaptive arm batches interactive-first.
+    priority: str = "bulk"
     # lifecycle spans THIS sweep (witness/prove attempts/rungs/verify/
     # emit, each {name, t0, ms, ...}) — persisted on every record the
     # sweep emits, terminal or deferred, so the full waterfall survives
@@ -282,6 +291,11 @@ class TimeseriesSampler:
         self.interval_s = interval_s
         self.stale_claim_s = stale_claim_s
         self.batch_fill_last = 0
+        # the scheduler's intended size for the newest batch (static
+        # arm: the batch_size cap) — recorded NEXT to batch_fill_last
+        # so the time-series can separate "low load" (target high,
+        # fill low) from "controller chose small" (target == fill)
+        self.batch_target_last = 0
         self._last_ts: Optional[float] = None
         self._last_native: Dict = {}
         # fleet attribution on every line (same contract as the request
@@ -322,6 +336,7 @@ class TimeseriesSampler:
                 "window_s": round(window_s, 3),
                 "arrival_rate_hz": round(scan["arrivals"] / window_s, 4) if window_s > 0 else 0.0,
                 "batch_fill_last": self.batch_fill_last,
+                "batch_size_target": self.batch_target_last,
                 **scan,
             }
             if self._worker_id:
@@ -467,6 +482,13 @@ class ProvingService:
         # knobs, stamped on every record + time-series line
         self._worker_id = ""
         self._fleet_id = ""
+        # adaptive scheduler (pipeline.sched, ZKP2P_SCHED=adaptive):
+        # controller built lazily on the first adaptive sweep (the gate
+        # is fresh-read per sweep, so one process can A/B both arms),
+        # and the per-sweep decision summary the fleet heartbeat carries
+        # (the `sched` block in fleet /status and `zkp2p-tpu top`)
+        self._sched_ctl = None
+        self._sched_hb: Optional[Dict] = None
 
     def request_drain(self) -> None:
         """Flip the drain flag: stop claiming, finish in-flight work,
@@ -494,7 +516,58 @@ class ProvingService:
         )
         self._worker_id = cfg.worker_id
         self._fleet_id = cfg.fleet_id
+        self._fleet_dir = cfg.fleet_dir
+        self._priority_default = (
+            "interactive" if cfg.sched_priority_default == "interactive" else "bulk"
+        )
         self._resolved = True
+
+    # a heartbeat younger than this marks a LIVE fleet peer (the hb
+    # thread beats every ~5 s; 3 beats of slack before a peer stops
+    # counting toward the scheduler's parallelism)
+    _PEER_HB_FRESH_S = 15.0
+
+    def _live_peers(self) -> int:
+        """Live workers sharing this spool (self included), from fresh
+        heartbeat files in the fleet dir — the scheduler's parallelism:
+        N workers pull ONE queue, so a worker predicting completion
+        times as if it served the whole backlog alone would shed
+        requests its peers could still serve.  Solo service (no fleet
+        dir) = 1; an unreadable dir degrades to 1 (predictions turn
+        conservative, never wrong-side)."""
+        if not getattr(self, "_fleet_dir", ""):
+            return 1
+        n = 0
+        now = time.time()
+        try:
+            for fn in os.listdir(self._fleet_dir):
+                if not fn.endswith(".hb"):
+                    continue
+                try:
+                    if now - os.path.getmtime(os.path.join(self._fleet_dir, fn)) < self._PEER_HB_FRESH_S:
+                        n += 1
+                except OSError:
+                    pass
+        except OSError:
+            return 1
+        return max(1, n)
+
+    def _sched_controller(self):
+        """The lazily-built BatchController (adaptive arm only).  The
+        amortization model and objective are resolved once per process —
+        calibration cannot change under a running service; the GATE
+        stays fresh-read per sweep."""
+        if self._sched_ctl is None:
+            from ..utils.config import load_config
+            from .sched import AmortModel, BatchController
+
+            cfg = load_config()
+            self._sched_ctl = BatchController(
+                AmortModel.from_spec(cfg.sched_amort),
+                objective_s=cfg.slo_p95_s,
+                target_fill=cfg.sched_target_fill,
+            )
+        return self._sched_ctl
 
     # -------------------------------------------------------- observability
     #
@@ -560,6 +633,11 @@ class ProvingService:
                 rec["batch_index"] = batch_index
             if batch_n is not None:
                 rec["batch_n"] = batch_n
+            # the scheduler's INTENDED batch size when this request was
+            # assembled (off arm: the static cap): batch_n alone reads
+            # the same for "low load" and "controller chose small"
+            if req.batch_target is not None:
+                rec["batch_size_target"] = req.batch_target
             # request waterfall: absolute arrival/claim timestamps, the
             # queue-wait they bound, and this sweep's lifecycle spans.
             # queue_wait_s is anchored to the req-file mtime, so across
@@ -998,6 +1076,99 @@ class ProvingService:
             req.done = "done"
             stats["done"] += 1
 
+    # --------------------------------------------------------- scheduler
+
+    def _sched_sweep(self, spool: str, pending: List[Request], knobs: Dict, stats: Dict[str, int]) -> List[List[Request]]:
+        """Adaptive-arm sweep planning (pipeline.sched): update the
+        arrival EWMA, shed by expected deadline miss (+ admission cap by
+        least slack), partition the survivors into lane-sorted batches.
+        Applies the shed verdicts (claim -> error-shed terminal, counted
+        per verdict) and publishes the decision telemetry: the
+        zkp2p_sched_batch_size gauge, zkp2p_sched_decisions_total{kind}
+        counters, one {"type": "sched"} line in the service sink, and
+        the heartbeat `sched` block fleet /status renders."""
+        from .sched import SchedRequest
+
+        ctl = self._sched_controller()
+        now = time.time()
+        by_rid: Dict[str, Request] = {r.rid: r for r in pending}
+        sreqs = [
+            SchedRequest(
+                rid=r.rid, t_submit=r.t_submit, deadline=self._deadline_of(r),
+                interactive=(r.priority == "interactive"),
+            )
+            for r in pending
+        ]
+        peers = self._live_peers()
+        plan = ctl.plan(
+            now, sreqs, cap=max(1, self.batch_size),
+            spool_cap=self._spool_cap or 0,
+            # never shed while draining — same rule as the static arm
+            allow_shed=not self._drain.is_set(),
+            # fleet peers share this queue: predictions must not model
+            # the whole backlog as served by this worker alone
+            parallelism=peers,
+        )
+        backlog = len(pending)
+        for sr, reason in plan.shed:
+            r = by_rid[sr.rid]
+            if not self._try_claim(r.path):
+                continue  # a peer is on it — not ours to shed
+            r.t_claim = time.time()
+            # counter only on a SUCCESSFUL terminal (a failed error-
+            # artifact write defers the request — same rule as the
+            # static cap shed)
+            if self._terminal_error(
+                spool, r, "error-shed",
+                RuntimeError(f"sched: {reason} (backlog {backlog})"),
+                knobs, stats,
+            ):
+                REGISTRY.counter("zkp2p_service_shed_total").inc()
+                REGISTRY.counter("zkp2p_sched_decisions_total", {"kind": "shed"}).inc()
+        REGISTRY.gauge("zkp2p_sched_batch_size").set(plan.batch_target)
+        if plan.batches:
+            REGISTRY.counter("zkp2p_sched_decisions_total", {"kind": "batch"}).inc(len(plan.batches))
+        if plan.lanes.get("interactive"):
+            REGISTRY.counter("zkp2p_sched_decisions_total", {"kind": "lane"}).inc()
+        if self._sampler is not None:
+            self._sampler.batch_target_last = plan.batch_target
+        self._sched_hb = {
+            "mode": "adaptive",
+            "batch_target": plan.batch_target,
+            "interactive_target": plan.interactive_target,
+            "lane_interactive": plan.lanes.get("interactive", 0),
+            "lane_bulk": plan.lanes.get("bulk", 0),
+            "rate_hz": plan.rate_hz,
+            "peers": peers,
+        }
+        if pending:
+            # one decision line per sweep with queue activity: every
+            # sizing/shed choice is auditable offline, next to the
+            # request records it shaped
+            try:
+                rec: Dict = {
+                    "type": "sched", "ts": round(now, 3),
+                    "run_id": run_id(), "pid": os.getpid(),
+                    "backlog": backlog,
+                    "rate_hz": plan.rate_hz,
+                    "oldest_wait_s": plan.oldest_wait_s,
+                    "batch_target": plan.batch_target,
+                    "batch_reason": plan.batch_reason,
+                    "interactive_target": plan.interactive_target,
+                    "lanes": plan.lanes,
+                    "batches": len(plan.batches),
+                    "shed": len(plan.shed),
+                    "peers": peers,
+                }
+                if self._worker_id:
+                    rec["worker"] = self._worker_id
+                if self._fleet_id:
+                    rec["fleet"] = self._fleet_id
+                self._sink(spool).write(rec)
+            except Exception:  # noqa: BLE001 — observation must never stop a sweep
+                pass
+        return [[by_rid[sr.rid] for sr in b] for b in plan.batches]
+
     # ------------------------------------------------------------ one pass
 
     def process_dir(self, spool: str) -> Dict[str, int]:
@@ -1017,6 +1188,14 @@ class ProvingService:
         if self._knobs is None:
             self._knobs = run_manifest()["knobs"]
         knobs = self._knobs
+        # scheduler gate (pipeline.sched): fresh-read per sweep AND
+        # record_arm'd, so adaptive-vs-off A/Bs are digest-
+        # distinguishable and one process can flip arms between sweeps.
+        # "off" keeps every decision below byte-for-byte the static
+        # path (fixed batch_size slicing, newest-first cap shed).
+        from .sched import sched_mode
+
+        adaptive = sched_mode() == "adaptive"
         pending: List[Request] = []
         for fn in sorted(os.listdir(spool)):
             if ".claim.stale." in fn:
@@ -1076,19 +1255,36 @@ class ProvingService:
                 t_submit = os.path.getmtime(fpath)
             except OSError:
                 t_submit = time.time()
+            # priority lane: explicit payload value wins, anything
+            # unrecognized falls to the configured default (bulk) — a
+            # typo'd priority must not mint a third lane
+            prio = payload.get("priority") if isinstance(payload, dict) else None
+            if prio not in ("interactive", "bulk"):
+                prio = self._priority_default
             pending.append(
-                Request(path=os.path.join(spool, base), payload=payload, rid=base, t_submit=t_submit)
+                Request(
+                    path=os.path.join(spool, base), payload=payload, rid=base,
+                    t_submit=t_submit, priority=prio,
+                )
             )
 
-        # Admission control: a backlog beyond the cap is SHED — newest
-        # arrivals first (the oldest are closest to their deadlines and
-        # already aged in the spool), each with a visible error-shed
-        # terminal + counter, instead of silently aging until every
-        # deadline in the queue is dead on arrival.
+        # Admission control.  Adaptive arm: the controller plans the
+        # whole sweep — expected-deadline-miss shedding (shed exactly
+        # what the amortization model predicts cannot finish, never
+        # what still can), lane-sorted batch partition, SLO-sized
+        # batches (pipeline.sched; docs/SCHEDULING.md).  Static arm:
+        # a backlog beyond the cap is SHED newest-first (the oldest
+        # are closest to their deadlines and already aged in the
+        # spool), each with a visible error-shed terminal + counter,
+        # instead of silently aging until every deadline in the queue
+        # is dead on arrival.
         # (never shed while draining: this worker is leaving — terminal-
         # erroring backlog a surviving peer could serve would turn a
         # routine restart into dropped requests)
-        if self._spool_cap and len(pending) > self._spool_cap and not self._drain.is_set():
+        batch_plan: Optional[List[List[Request]]] = None
+        if adaptive:
+            batch_plan = self._sched_sweep(spool, pending, knobs, stats)
+        elif self._spool_cap and len(pending) > self._spool_cap and not self._drain.is_set():
             backlog = len(pending)
             pending.sort(key=lambda r: (r.t_submit, r.rid))
             keep, shed = pending[: self._spool_cap], pending[self._spool_cap:]
@@ -1106,6 +1302,14 @@ class ProvingService:
                 ):
                     REGISTRY.counter("zkp2p_service_shed_total").inc()
             pending = sorted(keep, key=lambda r: r.rid)
+
+        if not adaptive:
+            # static-arm telemetry: the target IS the cap — recorded so
+            # the time-series and fleet `sched` view stay comparable
+            # across arms (fill < target reads as low load here)
+            if self._sampler is not None:
+                self._sampler.batch_target_last = self.batch_size
+            self._sched_hb = {"mode": "off", "batch_target": self.batch_size}
 
         # Pipeline overlap (SURVEY.md §2.7 "witness ∥ prove"): witness
         # generation is host CPU, proving is device compute — a producer
@@ -1210,7 +1414,17 @@ class ProvingService:
 
         def produce():
             try:
-                for i in range(0, len(pending), self.batch_size):
+                # adaptive: the controller's lane-sorted partition;
+                # static: fixed batch_size slices of the scan order —
+                # the exact pre-scheduler behavior
+                if batch_plan is not None:
+                    slices = batch_plan
+                else:
+                    slices = [
+                        pending[i : i + self.batch_size]
+                        for i in range(0, len(pending), self.batch_size)
+                    ]
+                for chunk in slices:
                     # Drain gate: once the flag is up, claim NOTHING
                     # more.  Checked per batch, before any claim — the
                     # batches already claimed (proving now, or queued in
@@ -1220,15 +1434,20 @@ class ProvingService:
                     # zero proofs (docs/ROBUSTNESS.md §fleet).
                     if self._drain.is_set():
                         break
+                    # the INTENDED size for this batch: the static cap,
+                    # or the controller's planned chunk (records carry
+                    # it as batch_size_target)
+                    target = len(chunk) if batch_plan is not None else self.batch_size
                     # Claim at DEQUEUE, not at scan: a long sweep must
                     # not hold scan-time claims that go stale while
                     # earlier batches prove (peer takeover would then
                     # duplicate in-progress work).
                     cand = []
-                    for r in pending[i : i + self.batch_size]:
+                    for r in chunk:
                         if not self._try_claim(r.path):
                             continue
                         r.t_claim = time.time()
+                        r.batch_target = target
                         with hb_lock:
                             hb_reqs.append(r)  # heartbeat from claim to terminal
                         # deadline gate #1, at claim: a request that
@@ -1316,8 +1535,16 @@ class ProvingService:
             ).observe(len(live))
             if self._sampler is not None:
                 self._sampler.batch_fill_last = len(live)
+            t_batch0 = time.perf_counter()
             try:
                 self._prove_isolating(spool, live, knobs, stats, batch_n=len(live))
+                # online amortization calibration (adaptive arm): feed
+                # the batch's ACTUAL wall cost back into the controller
+                # — the static curve can be arbitrarily wrong for this
+                # circuit/host, and until the first observation lands
+                # the controller sheds only already-expired requests
+                if self._sched_ctl is not None:
+                    self._sched_ctl.observe_batch(len(live), time.perf_counter() - t_batch0)
             except Exception as e:  # noqa: BLE001 — safety net
                 # _prove_isolating terminals every request itself; an
                 # exception escaping it is a bug in the rescue path —

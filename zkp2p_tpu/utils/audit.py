@@ -400,6 +400,13 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     fleet_member_arm()
     governor_arm()
 
+    # scheduler gate (pipeline.sched): the adaptive batching/shedding
+    # controller vs the static oracle arm — an adaptive run must never
+    # share a digest with a static one
+    from ..pipeline.sched import sched_arm
+
+    sched_arm()
+
     if workload and backend != "unavailable":
         # one tiny jitted op: proves the backend executes and ticks the
         # compile listener.  Deliberately NOT a gated field mul — a

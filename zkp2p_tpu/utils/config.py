@@ -307,6 +307,30 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "fleet_metrics_port": ("ZKP2P_FLEET_METRICS_PORT", _opt_port, None),
     "fleet_scrape_s": ("ZKP2P_FLEET_SCRAPE_S", _nonneg_float(2.0), 2.0),
     "slo_fast_window_s": ("ZKP2P_SLO_FAST_WINDOW_S", _nonneg_float(60.0), 60.0),
+    # adaptive scheduler (pipeline.sched; docs/SCHEDULING.md): the
+    # controller gate ("off" = the static batch_size/newest-first-shed
+    # oracle arm, byte-for-byte today's behavior; "adaptive" = SLO-
+    # driven batch sizing + expected-deadline-miss shedding + priority
+    # lanes; anything else fails CLOSED to off), the headroom fraction
+    # of the deadline/objective budget batches are planned to, the
+    # amortization-curve calibration ("S:sec,S:sec,..."; "" = the
+    # built-in conservative venmo curve; malformed raises LOUDLY at
+    # controller creation), and the default priority lane for requests
+    # whose payload carries none ("interactive" | anything-else=bulk).
+    "sched": ("ZKP2P_SCHED", str, "off"),
+    "sched_target_fill": ("ZKP2P_SCHED_TARGET_FILL", _fraction(0.8), 0.8),
+    "sched_amort": ("ZKP2P_SCHED_AMORT", str, ""),
+    "sched_priority_default": ("ZKP2P_SCHED_PRIORITY_DEFAULT", str, "bulk"),
+    # fleet autoscaling (pipeline.sched.AutoscalePolicy, driven by the
+    # FleetSupervisor off the fleet plane's merged signals): live-worker
+    # bounds (workers_max 0 = autoscale off; min clamps to >= 1 when
+    # on) and the hysteresis windows — how long the scale-up condition
+    # (backlog growth / slo burn) and the scale-down condition (idle)
+    # must hold CONTINUOUSLY before a step.
+    "workers_min": ("ZKP2P_WORKERS_MIN", _nonneg_int(0), 0),
+    "workers_max": ("ZKP2P_WORKERS_MAX", _nonneg_int(0), 0),
+    "scale_up_s": ("ZKP2P_SCALE_UP_S", _nonneg_float(10.0), 10.0),
+    "scale_down_s": ("ZKP2P_SCALE_DOWN_S", _nonneg_float(30.0), 30.0),
     # alert-engine thresholds (utils.alerts; the rule table lives in
     # docs/OBSERVABILITY.md): burn-rate multiple that pages when BOTH
     # the fast and slow merged windows exceed it, supervisor restarts
@@ -325,7 +349,7 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 # whitelist, promoted here so there is a single list).
 ARMABLE = (
     "msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap",
-    "msm_multi", "msm_precomp", "matvec_seg", "ntt_pool",
+    "msm_multi", "msm_precomp", "matvec_seg", "ntt_pool", "sched",
 )
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
@@ -383,6 +407,14 @@ class ProverConfig:
     fleet_metrics_port: Optional[int] = None
     fleet_scrape_s: float = 2.0
     slo_fast_window_s: float = 60.0
+    sched: str = "off"
+    sched_target_fill: float = 0.8
+    sched_amort: str = ""
+    sched_priority_default: str = "bulk"
+    workers_min: int = 0
+    workers_max: int = 0
+    scale_up_s: float = 10.0
+    scale_down_s: float = 30.0
     alert_burn_rate: float = 2.0
     alert_restarts: int = 3
     alert_for_s: float = 5.0
