@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan native-tsan lint test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -19,6 +19,19 @@ native:
 # finding.  This is the pre-commit gate: run it before every push.
 lint:
 	python -m tools.lint
+
+# Circuit soundness audit — the registry admission gate (tier-1 resident
+# via tests/test_circuit_audit.py; docs/STATIC_ANALYSIS.md §circuit
+# audit): build every registered circuit and run the static R1CS
+# auditor — unconstrained wires, the determinism fixpoint, bool/width
+# demands, dead/duplicate rows, hook coverage, public-layout parity.
+# Jax-free like `make lint` (gadgets/models need only numpy); reports
+# cached under .bench_cache keyed by structural circuit digest, so an
+# unchanged tree re-audits in seconds.  The 4.9M-wire flagship audit
+# runs under the slow tier (ZKP2P_RUN_SLOW=1 pytest
+# tests/test_circuit_audit.py -k flagship).
+circuit-audit:
+	env -u PALLAS_AXON_POOL_IPS python -m tools.lint --circuits
 
 # Sanitizer smoke: build the ASan+UBSan library and run the MSM parity
 # check against it (tests/test_native_asan.py LD_PRELOADs libasan into a

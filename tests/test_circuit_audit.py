@@ -1,0 +1,398 @@
+"""The circuit soundness auditor (snark.analysis) + registry admission
+gate — tier-1 resident.
+
+Same discipline as tests/test_lint.py (PR 13): one seeded-violation
+fixture per rule proving the rule CAN fire, then the clean half — zero
+unwaived findings on every registered circuit — which the fixtures keep
+honest.  Plus the determinism-fixpoint oracle: on a hand-built
+under-constrained toy we exhibit TWO satisfying witnesses that agree on
+the inputs and disagree on the flagged wire, so the analyzer's claim is
+checked against ground truth, not against itself.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from zkp2p_tpu.field.bn254 import R  # noqa: E402
+from zkp2p_tpu.snark.analysis import (  # noqa: E402
+    CircuitAuditError,
+    audit_circuit,
+    circuit_digest,
+    label_class,
+    require_clean,
+)
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem  # noqa: E402
+
+
+def rules_of(report):
+    return {f["rule"] for f in report["findings"]}
+
+
+def audit(cs, **kw):
+    kw.setdefault("use_cache", False)
+    return audit_circuit(cs, **kw)
+
+
+# ---------------------------------------------------------------------------
+# 1. seeded violations — every rule proven able to fire
+
+
+def test_unconstrained_wire_fires():
+    cs = ConstraintSystem("fx")
+    ghost = cs.new_wire("ghost")
+    cs.compute(ghost, lambda: 7, [])  # hook-assigned, constraint-free
+    rep = audit(cs)
+    assert "unconstrained-wire" in rules_of(rep), rep["findings"]
+    (f,) = [f for f in rep["findings"] if f["rule"] == "unconstrained-wire"]
+    assert "witness hook" in f["example"]  # names the assigning hook kind
+    assert "no constraint" in f["msg"]
+
+
+def test_determinism_fires_with_two_witness_oracle():
+    # x*x = out: x is NOT determined by the public output — and we PROVE
+    # it by exhibiting two satisfying witnesses that agree on the public
+    # and disagree on x (the fixpoint's claim checked against ground
+    # truth, not against itself).
+    cs = ConstraintSystem("fx")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    cs.enforce(LC.of(x), LC.of(x), LC.of(out), "sq")
+    cs.compute(x, lambda: 2, [])
+    rep = audit(cs, declared_n_public=1)
+    assert [f["where"] for f in rep["findings"] if f["rule"] == "determinism"] == ["x"]
+    for w_x in (2, R - 2):  # both roots satisfy with the same public
+        w = [1, 4, w_x]
+        for con in cs.constraints:
+            a = sum(v * w[i] for i, v in con.a.items()) % R
+            b = sum(v * w[i] for i, v in con.b.items()) % R
+            c = sum(v * w[i] for i, v in con.c.items()) % R
+            assert a * b % R == c, (con, w_x)
+
+
+def test_determinism_quiet_on_determined_toy():
+    cs = ConstraintSystem("fx")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    cs.mark_input(x)
+    cs.enforce_eq(LC.of(x, 5), LC.of(out), "mul5")
+    rep = audit(cs, declared_n_public=1)
+    assert "determinism" not in rules_of(rep), rep["findings"]
+
+
+def test_determinism_rank_closure_solves_vandermonde():
+    # the BigMultNoCarry shape: k unknowns pinned only by k point
+    # evaluations — no single constraint determines any one wire, the
+    # linear-system rank closure must see the full-rank cluster
+    cs = ConstraintSystem("fx")
+    xs = [cs.new_wire(f"conv.c[{i}]") for i in range(3)]
+    ins = [cs.new_wire(f"in[{i}]") for i in range(2)]
+    cs.mark_input(ins)
+    for t in range(3):
+        lhs = LC.of(ins[0]) + LC.of(ins[1], t)
+        rhs = LC()
+        for i, x in enumerate(xs):
+            rhs = rhs + LC.of(x, pow(t, i, R))
+        cs.enforce(lhs, LC.const(1), rhs, f"pt{t}")
+    cs.compute(xs, lambda a, b: [a, b, 0], ins)
+    rep = audit(cs)
+    assert "determinism" not in rules_of(rep), rep["findings"]
+
+
+def test_bool_width_fires_and_bound_satisfies():
+    cs = ConstraintSystem("fx")
+    a, b = cs.new_wire("a"), cs.new_wire("b")
+    cs.mark_input([a, b])
+    o = cs.new_wire("o")
+    cs.enforce(LC.of(a), LC.of(b), LC.of(o), "and")
+    cs.compute(o, lambda x, y: x * y % R, [a, b])
+    cs.require_width(a, 1, "and_gate.a")
+    rep = audit(cs)
+    assert "bool-width" in rules_of(rep)
+    # a recorded bound satisfies the demand (set_width's contract makes
+    # the caller responsible for its constraint backing; a lying bound
+    # fails closed at proof time via the width-classed MSM)
+    cs.set_width(a, 1)
+    rep = audit(cs)
+    assert "bool-width" not in rules_of(rep), rep["findings"]
+
+
+def test_dead_and_duplicate_fire():
+    cs = ConstraintSystem("fx")
+    x = cs.new_wire("x")
+    cs.mark_input(x)
+    cs.enforce(LC(), LC.of(x), LC(), "deadrow")  # 0 * x = 0
+    cs.enforce_eq(LC.of(x), LC.const(2), "pin")
+    cs.enforce_eq(LC.of(x), LC.const(2), "pin")  # byte-identical
+    rep = audit(cs)
+    assert {"dead-constraint", "duplicate-constraint"} <= rules_of(rep)
+
+
+def test_dead_fires_on_unsatisfiable_constant():
+    cs = ConstraintSystem("fx")
+    x = cs.new_wire("x")
+    cs.mark_input(x)
+    cs.enforce_eq(LC.of(x), LC.of(x), "ok")  # keep x constrained... (dup-free)
+    cs.enforce(LC.const(2), LC.const(3), LC.const(7), "broken")
+    rep = audit(cs)
+    dead = [f for f in rep["findings"] if f["rule"] == "dead-constraint"]
+    assert dead and "NEVER satisfiable" in dead[0]["msg"]
+
+
+def test_hook_coverage_fires_both_ways():
+    cs = ConstraintSystem("fx")
+    x = cs.new_wire("nohook")
+    cs.enforce_eq(LC.of(x), LC.const(1), "pin")
+    y = cs.new_wire("twohooks")
+    cs.enforce_eq(LC.of(y), LC.const(1), "piny")
+    cs.compute(y, lambda: 1, [])
+    cs.compute(y, lambda: 1, [])
+    rep = audit(cs)
+    fs = {f["where"]: f for f in rep["findings"] if f["rule"] == "hook-coverage"}
+    assert "nohook" in fs and "witness() would fail" in fs["nohook"]["msg"]
+    assert "twohooks" in fs and "2 hooks" in fs["twohooks"]["example"]
+    assert "multiple hooks" in fs["twohooks"]["msg"]
+
+
+def test_hook_coverage_fires_on_hooked_public():
+    # publics are seeded from public_inputs BEFORE hooks run — a hook on
+    # a public wire silently overwrites the verifier-supplied value
+    cs = ConstraintSystem("fx")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    cs.mark_input(x)
+    cs.enforce_eq(LC.of(x), LC.of(out), "eq")
+    cs.compute(out, lambda v: v, [x])
+    rep = audit(cs, declared_n_public=1)
+    fs = [f for f in rep["findings"] if f["rule"] == "hook-coverage"]
+    assert fs and "verifier-supplied" in fs[0]["msg"], rep["findings"]
+
+
+def test_public_layout_fires_on_declared_and_vk():
+    cs = ConstraintSystem("fx")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    cs.mark_input(x)
+    cs.enforce_eq(LC.of(x), LC.of(out), "eq")
+    rep = audit(cs, declared_n_public=26)
+    assert "public-layout" in rules_of(rep)
+
+    class FakeVK:  # IC length must be n_public + 1
+        ic = [(0, 0)] * 5
+
+    rep = audit(cs, declared_n_public=1, vk=FakeVK())
+    assert "public-layout" in rules_of(rep)
+    assert "IC" in " ".join(f["msg"] for f in rep["findings"])
+
+
+def test_waiver_suppresses_and_requires_argument():
+    cs = ConstraintSystem("fx")
+    out = cs.new_public("out")
+    x = cs.new_wire("free.x")
+    cs.enforce(LC.of(x), LC.of(x), LC.of(out), "sq")
+    cs.compute(x, lambda: 2, [])
+    with pytest.raises(ValueError, match="soundness argument"):
+        cs.waive("determinism", "free.*", "")
+    cs.waive("determinism", "free.*", "fixture: x feeds nothing else")
+    rep = audit(cs, declared_n_public=1)
+    assert rep["unwaived"] == 0
+    (w,) = rep["waivers_used"]
+    assert w["count"] == 1 and w["why"].startswith("fixture:")
+
+
+# ---------------------------------------------------------------------------
+# 2. the clean half: every registered circuit audits with ZERO unwaived
+# findings — this is what `make circuit-audit` enforces
+
+
+def test_all_registered_circuits_clean():
+    from zkp2p_tpu.models import registry
+
+    for name in registry.circuit_ids():
+        cs, rep = registry.audited(name)
+        assert rep["unwaived"] == 0, (name, rep["findings"][:5])
+        assert rep["n_public"] == registry.SPECS[name].n_public
+        # every waiver that fired carries its written soundness argument
+        for w in rep["waivers_used"]:
+            assert w["why"].strip(), (name, w)
+
+
+def test_admission_gate_refuses_unsound_circuit():
+    from zkp2p_tpu.models import registry
+
+    cs = ConstraintSystem("evil")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    cs.enforce(LC.of(x), LC.of(x), LC.of(out), "sq")
+    cs.compute(x, lambda: 2, [])
+    registry.SPECS["_evil"] = registry.CircuitSpec(
+        "_evil", lambda: cs, 1, "fixture: under-constrained"
+    )
+    try:
+        with pytest.raises(CircuitAuditError, match="REFUSED admission") as ei:
+            registry.audited("_evil", use_cache=False)
+        # machine consumers (lint --circuits --json) keep the evidence
+        assert ei.value.report["unwaived"] == 1
+    finally:
+        del registry.SPECS["_evil"]
+
+
+def test_minted_regex_circuit_witnesses_and_verifies():
+    # the L0 minting path end to end: regexc -> circuit -> audit ->
+    # witness -> check_witness, publics = packed reveal
+    from zkp2p_tpu.inputs.email import pack_bytes_le
+    from zkp2p_tpu.regexc.compiler import VENMO_ACTOR_ID, reveal_circuit
+
+    cs, lay = reveal_circuit(VENMO_ACTOR_ID, n_bytes=48, reveal_len=14, name="rx_t")
+    rep = require_clean(audit(cs, declared_n_public=2))
+    assert rep["unwaived"] == 0
+    data = b"xx actor_id=3D4499332177 yy"
+    data = data + b"\x00" * (48 - len(data))
+    digits = b"4499332177"
+    # the accept-state mask reveals exactly the matched digits (the
+    # trailing [0-9]+), zero elsewhere — anchor the window on the first
+    # digit, everything past the match reads 0
+    start = data.find(digits)
+    seed = {w: v for w, v in zip(lay["data"], data)}
+    seed[lay["idx"]] = start
+    pubs = pack_bytes_le(digits + b"\x00" * (14 - len(digits)), 7)
+    w = cs.witness(pubs, seed)
+    cs.check_witness(w)
+
+
+def test_public_layout_closes_evm_loop_with_real_vk():
+    # a REAL dev setup: the exported verifier's IC length must equal
+    # n_public+1 (docs/EVM_PARITY.md) — checked through the audit's vk arm
+    from zkp2p_tpu.models.amount_demo import dryrun_circuit
+    from zkp2p_tpu.snark.groth16 import setup
+
+    cs, pubs, seed = dryrun_circuit()
+    _, vk = setup(cs, seed="audit-parity-t")
+    rep = audit(cs, declared_n_public=1, vk=vk)
+    assert "public-layout" not in rules_of(rep)
+    assert len(vk.ic) == cs.num_public + 1
+
+
+# ---------------------------------------------------------------------------
+# 3. cache round-trip + digest semantics
+
+
+def test_report_cache_roundtrip_and_digest_mismatch_rebuild(tmp_path):
+    cs = ConstraintSystem("cachet")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    cs.mark_input(x)
+    cs.enforce_eq(LC.of(x, 3), LC.of(out), "m3")
+    d = str(tmp_path)
+    r1 = audit_circuit(cs, name="cachet", declared_n_public=1, cache_dir=d)
+    assert r1["source"] == "fresh"
+    files = [f for f in os.listdir(d) if f.startswith("circuit_audit_cachet_")]
+    assert len(files) == 1 and r1["digest"] in files[0]
+    with open(os.path.join(d, files[0])) as f:
+        assert json.load(f)["digest"] == r1["digest"]
+    r2 = audit_circuit(cs, name="cachet", declared_n_public=1, cache_dir=d)
+    assert r2["source"] == "cache"
+    assert {k: v for k, v in r2.items() if k != "source"} == {
+        k: v for k, v in r1.items() if k != "source"
+    }
+    # structural change -> new digest -> rebuild, old report inert
+    cs.enforce_eq(LC.of(x), LC.of(x), "extra")
+    assert circuit_digest(cs) != r1["digest"]
+    r3 = audit_circuit(cs, name="cachet", declared_n_public=1, cache_dir=d)
+    assert r3["source"] == "fresh" and r3["digest"] != r1["digest"]
+
+
+def test_digest_sensitive_to_waivers_and_widths():
+    def base():
+        cs = ConstraintSystem("d")
+        o = cs.new_public("o")
+        x = cs.new_wire("x")
+        cs.mark_input(x)
+        cs.enforce_eq(LC.of(x), LC.of(o), "eq")
+        return cs
+
+    d0 = circuit_digest(base())
+    cs = base()
+    cs.set_width(cs.num_wires - 1, 8)
+    assert circuit_digest(cs) != d0
+    cs = base()
+    cs.waive("determinism", "x", "digest-sensitivity fixture")
+    assert circuit_digest(cs) != d0
+    # labels and tags are waiver-matching keys: a label-only rename or a
+    # tag edit MUST rebuild — a stale cached "clean" would otherwise
+    # admit a circuit whose waivers no longer match anything
+    cs = base()
+    cs.labels[cs.num_wires - 1] = "renamed"
+    assert circuit_digest(cs) != d0
+    cs = base()
+    cs.constraints[0].tag = "retagged"
+    assert circuit_digest(cs) != d0
+    assert circuit_digest(base()) == d0  # and stable
+
+
+# ---------------------------------------------------------------------------
+# 4. satellites: witness error naming, manifest surfacing, label classes
+
+
+def test_witness_error_names_label_and_site():
+    cs = ConstraintSystem("err")
+    x = cs.new_wire("rsa.sq3.q[7]")
+    cs.enforce_eq(LC.of(x), LC.const(1), "pin")
+    with pytest.raises(RuntimeError) as ei:
+        cs.witness([])
+    msg = str(ei.value)
+    assert "rsa.sq3.q[7]" in msg and "rsa.sq#.q[#]" in msg
+    assert "hook-coverage" in msg  # points at the static rule that catches it
+
+
+def test_label_class():
+    assert label_class("rsa.sq3.qb.2.b[7]") == "rsa.sq#.qb.#.b[#]"
+    assert label_class("") == "?"
+
+
+def test_audits_surface_in_run_manifest():
+    from zkp2p_tpu.models import registry
+    from zkp2p_tpu.utils.metrics import run_manifest
+
+    registry.audited("dryrun_vid")
+    man = run_manifest()
+    assert "circuit_audits" in man
+    entry = man["circuit_audits"]["dryrun_vid"]
+    assert entry["unwaived"] == 0 and "digest" in entry
+
+
+def test_lint_circuits_cli(tmp_path):
+    # the CLI surface: `python -m tools.lint --circuits dryrun_vid --json`
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--circuits", "dryrun_vid", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    (rep,) = json.loads(out.stdout)
+    assert rep["circuit"] == "dryrun_vid" and rep["unwaived"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 5. the flagship (slow tier): the 4.9M-wire production shape audits
+# inside the stated budget, runtime recorded in the cached report
+
+
+@pytest.mark.slow
+def test_flagship_audit_within_budget():
+    from zkp2p_tpu.models import registry
+
+    cs, rep = registry.audited("venmo-full")
+    assert rep["unwaived"] == 0, rep["findings"][:5]
+    assert rep["n_constraints"] > 4_000_000
+    if rep["source"] == "fresh":
+        # stated budget (docs/STATIC_ANALYSIS.md): the audit itself —
+        # digest + extraction + fixpoint — inside 10 CI minutes
+        assert rep["audit_s"] < 600, rep["audit_s"]
+    assert rep["audit_s"] > 0  # runtime recorded in the report JSON

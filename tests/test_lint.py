@@ -304,6 +304,35 @@ def test_syntax_error_is_a_finding(tmp_path):
     assert "syntax" in rules_of(fs), fs
 
 
+def test_constraint_tag_fires(tmp_path):
+    # an untagged enforce in the circuit-building surface makes audit
+    # findings and check_witness failures unattributable (ISSUE 15)
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/gadgets/bad.py": (
+            "def g(cs, a, b, o):\n"
+            "    cs.enforce(a, b, o)\n"
+            '    cs.enforce_eq(a, b, "")\n'
+            "    cs.enforce_zero(a)\n"
+        ),
+    })
+    tagged = [f for f in fs if f.rule == "constraint-tag"]
+    assert len(tagged) == 3, fs
+
+
+def test_constraint_tag_quiet_on_tagged_and_outside_surface(tmp_path):
+    fs = mini_tree(tmp_path, {
+        "zkp2p_tpu/gadgets/ok.py": (
+            "def g(cs, a, b, o, tag):\n"
+            '    cs.enforce(a, b, o, f"{tag}/mul")\n'
+            '    cs.enforce_eq(a, b, tag)\n'
+            '    cs.enforce_zero(a, tag="z")\n'
+        ),
+        # tests/fixtures outside gadgets/models/regexc are exempt
+        "zkp2p_tpu/pipeline/fixture.py": "def g(cs, a, b, o):\n    cs.enforce(a, b, o)\n",
+    })
+    assert "constraint-tag" not in rules_of(fs), fs
+
+
 def test_inline_waiver_suppresses(tmp_path):
     fs = mini_tree(tmp_path, {
         "zkp2p_tpu/t.py": (

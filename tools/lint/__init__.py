@@ -33,7 +33,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--root", default=REPO, help="tree to lint (default: this repo)")
     ap.add_argument("--rules", default="", help="comma-separated rule filter")
     ap.add_argument("--json", action="store_true", help="machine-readable findings")
+    ap.add_argument(
+        "--circuits", nargs="?", const="all", default=None, metavar="IDS",
+        help="run the R1CS soundness audit on registered circuits instead "
+        "of the source rules (comma-separated ids, default all tier-1 "
+        "circuits) — the registry admission gate, docs/STATIC_ANALYSIS.md",
+    )
+    ap.add_argument("--flagship", action="store_true",
+                    help="with --circuits: include the 4.9M-wire flagship")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="with --circuits: ignore cached audit reports")
     args = ap.parse_args(argv)
+
+    if args.circuits is not None:
+        if args.rules or args.root != REPO:
+            ap.error("--circuits is a separate tier: --rules/--root do not apply")
+        from .circuit import run_circuit_audit
+
+        names = None if args.circuits == "all" else [
+            n.strip() for n in args.circuits.split(",") if n.strip()
+        ]
+        return run_circuit_audit(
+            names=names,
+            include_flagship=args.flagship,
+            use_cache=not args.no_cache,
+            as_json=args.json,
+        )
 
     t0 = time.perf_counter()
     rules = [r.strip() for r in args.rules.split(",") if r.strip()] or None

@@ -1,0 +1,123 @@
+"""Circuit tier of zkp2p-lint: the constraint-tag source rule and the
+R1CS soundness-audit runner.
+
+Two halves, matching the two ways circuit bugs enter the tree:
+
+  * ``check(tree)`` — pure-AST rule over the circuit-building surface
+    (gadgets/, models/, regexc/): every ``enforce`` / ``enforce_eq`` /
+    ``enforce_zero`` call site must pass a non-empty ``tag``.  Audit
+    findings and check_witness failures are attributed BY TAG — an
+    untagged constraint makes them anonymous, which is how the round-2
+    bh= bug hid inside a wall of unattributed rows.
+  * ``run_circuit_audit()`` — builds every registered circuit
+    (zkp2p_tpu.models.registry) and runs the static soundness audit
+    (zkp2p_tpu.snark.analysis): unconstrained wires, the determinism
+    fixpoint, bool/width demands, dead/duplicate constraints, hook
+    coverage, public-layout parity.  This half IMPORTS the package (it
+    must build real circuits), so it is a separate tier from `make
+    lint`: ``zkp2p-tpu lint --circuits`` / ``make circuit-audit`` —
+    still jax-free (gadgets/models need only numpy), still the
+    registry's admission gate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import Finding, Tree, call_name
+
+# The circuit-building surface: constraints emitted anywhere else (tests
+# build throwaway fixtures) are not part of a shipped circuit.
+_TAGGED_ROOTS = (
+    "zkp2p_tpu/gadgets/",
+    "zkp2p_tpu/models/",
+    "zkp2p_tpu/regexc/",
+)
+
+# method -> 1-based positional index of the tag parameter
+_TAG_POS = {"enforce": 4, "enforce_eq": 3, "enforce_zero": 2}
+
+
+def check(tree: Tree) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in tree.py_files():
+        if sf.tree is None or not sf.relpath.startswith(_TAGGED_ROOTS):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node).rsplit(".", 1)[-1]
+            pos = _TAG_POS.get(name)
+            if pos is None or not isinstance(node.func, ast.Attribute):
+                continue
+            tag = node.args[pos - 1] if len(node.args) >= pos else None
+            for kw in node.keywords:
+                if kw.arg == "tag":
+                    tag = kw.value
+            empty = tag is None or (
+                isinstance(tag, ast.Constant) and tag.value in ("", None)
+            )
+            if empty:
+                out.append(
+                    Finding(
+                        "constraint-tag",
+                        sf.relpath,
+                        node.lineno,
+                        f"{name}() without a tag: audit findings and "
+                        "check_witness failures on this constraint are "
+                        "unattributable",
+                    )
+                )
+    return out
+
+
+def run_circuit_audit(
+    names: Optional[List[str]] = None,
+    include_flagship: bool = False,
+    use_cache: bool = True,
+    as_json: bool = False,
+) -> int:
+    """Audit registered circuits; print one line per circuit (or a JSON
+    report list).  Exit code is a bitmask so mixed failures survive:
+    bit 0 = some circuit was REFUSED, bit 1 = unknown circuit id."""
+    import json
+    import sys
+
+    from zkp2p_tpu.models import registry
+    from zkp2p_tpu.snark.analysis import CircuitAuditError
+
+    ids = names or registry.circuit_ids(include_flagship=include_flagship)
+    reports = []
+    rc = 0
+    for name in ids:
+        if name not in registry.SPECS:
+            # checked HERE so a KeyError from inside a circuit builder
+            # is a real crash, not misreported as a bad id
+            print(
+                f"circuit-audit: unknown circuit {name!r}; registered: "
+                f"{', '.join(sorted(registry.SPECS))}",
+                file=sys.stderr,
+            )
+            rc |= 2
+            continue
+        try:
+            _, rep = registry.audited(name, use_cache=use_cache)
+        except CircuitAuditError as e:
+            print(e, file=sys.stderr)
+            rep = getattr(e, "report", None)
+            if rep is not None:
+                reports.append(rep)  # --json consumers get the refusal too
+            rc |= 1
+            continue
+        reports.append(rep)
+        if not as_json:
+            print(
+                f"circuit-audit {name}: clean — 0 unwaived / "
+                f"{rep['waived']} waived findings, "
+                f"{rep['n_constraints']} constraints / {rep['n_wires']} wires, "
+                f"{rep['audit_s']}s ({rep['source']}, digest {rep['digest']})"
+            )
+    if as_json:
+        print(json.dumps(reports, indent=1))
+    return rc

@@ -187,7 +187,7 @@ def parse_config_registry(tree_obj: "Tree") -> Tuple[Dict[str, str], Tuple[str, 
 
 
 def run_checkers(tree: Tree, rules: Optional[Iterable[str]] = None) -> List[Finding]:
-    from . import abi, clocks, durability, gates, knobs, metric_names, pyflakes_lite
+    from . import abi, circuit, clocks, durability, gates, knobs, metric_names, pyflakes_lite
 
     checkers = [
         knobs.check,
@@ -196,6 +196,7 @@ def run_checkers(tree: Tree, rules: Optional[Iterable[str]] = None) -> List[Find
         metric_names.check,
         durability.check,
         clocks.check,
+        circuit.check,
         pyflakes_lite.check,
     ]
     findings: List[Finding] = []

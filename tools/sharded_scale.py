@@ -43,11 +43,9 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")
     from jax.sharding import Mesh
 
-    from zkp2p_tpu.gadgets import core, sha256 as g_sha256
     from zkp2p_tpu.prover.groth16_tpu import device_pk, prove_tpu_sharded
     from zkp2p_tpu.prover.native_prove import prove_native
     from zkp2p_tpu.snark.groth16 import setup, verify
-    from zkp2p_tpu.snark.r1cs import ConstraintSystem
 
     devs = jax.devices()
     assert len(devs) >= 8 and devs[0].platform == "cpu", devs
@@ -69,11 +67,13 @@ def main() -> None:
         return bytes(padded) + b"\x00" * (max_len - len(padded))
 
     padded = sha_pad(msg, 128)
-    cs = ConstraintSystem("sharded-scale-sha2b")
-    wires = cs.new_wires(128, "msg")
-    bits = core.assert_bytes(cs, wires)
+    # the registry's sha2b shape (ONE definition; its audit gate covers
+    # this run's circuit too — zkp2p-tpu lint --circuits)
+    from zkp2p_tpu.models.registry import build_sha2b
+
+    cs, out = build_sha2b()
+    wires = sorted(cs.input_wires)
     seed = {wr: padded[i] for i, wr in enumerate(wires)}
-    out = g_sha256.sha256_blocks(cs, bits, None)
     stage(f"circuit: {cs.num_constraints} constraints, {cs.num_wires} wires")
     assert cs.num_constraints >= 27_000, "scale target not met"
 

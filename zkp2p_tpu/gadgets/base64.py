@@ -22,6 +22,7 @@ from .regex import CharClassCache
 def base64_lookup(cs: ConstraintSystem, c: int, cache: CharClassCache, tag: str = "b64") -> Tuple[int, List[int]]:
     """char wire -> (6-bit value wire, its bits).  Valid alphabet enforced
     (A-Z a-z 0-9 + / and '=' padding -> 0)."""
+    cs.require_width(c, 8, f"{tag}/b64.char")  # raw c feeds the value LC
     ind_AZ = cache.in_range(c, 65, 90)
     ind_az = cache.in_range(c, 97, 122)
     ind_09 = cache.in_range(c, 48, 57)

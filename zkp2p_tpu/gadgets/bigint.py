@@ -127,6 +127,25 @@ def big_mult_mod(
     k = len(a)
     q = cs.new_wires(k, f"{tag}.q")
     r = cs.new_wires(k, f"{tag}.r")
+    # The modmul interior is deliberately NOT witness-unique (the
+    # bigint.circom / zk-email FpMul design): r is range-checked to k·n
+    # bits, not r < p, so (q, r) admits shifted solutions (q-j, r+j·p)
+    # — and through them the conv limbs, carries and range-check bits.
+    # Soundness is a congruence argument instead: the integer identity
+    # a·b = q·p + r (enforced by CheckCarryToZero over range-checked
+    # limbs) preserves a·b ≡ r (mod p) for EVERY admissible (q, r), and
+    # the chain's final residue is equated limb-wise against a value
+    # < p (rsa_verify's PKCS#1 padded digest), which pins the class to
+    # its unique representative.  Callers that do not pin the final
+    # residue must not rely on intermediate uniqueness.
+    _why = (
+        "FpMul residue-class freedom: (q, r) -> (q-j, r+j*p) all satisfy; "
+        "a*b === r (mod p) is preserved and the final residue is pinned "
+        "< p by the consumer (see the comment at big_mult_mod)"
+    )
+    for g in (f"{tag}.q[*", f"{tag}.r[*", f"{tag}.qb.*", f"{tag}.rb.*",
+              f"{tag}.ab.c[*", f"{tag}.qp.c[*", f"{tag}.ccz.*"):
+        cs.waive("determinism", g, _why)
 
     def divide(*vals):
         av = limbs_to_int_host(vals[:k], n)

@@ -101,6 +101,16 @@ def is_zero(cs: ConstraintSystem, x: int, tag: str = "iszero") -> int:
     out = cs.new_wire(f"{tag}.out")
     cs.enforce(LC.of(x), LC.of(inv), LC.const(1) - LC.of(out), f"{tag}/inv")
     cs.enforce(LC.of(x), LC.of(out), LC(), f"{tag}/zero")
+    # out is bool for EVERY satisfying witness by case analysis (x=0
+    # forces out=1 via the inv row; x!=0 forces out=0 via the zero row)
+    cs.set_width(out, 1)
+    cs.waive(
+        "determinism", f"{tag}.inv",
+        "IsZero inverse: unconstrained exactly when x == 0 (then "
+        "x*inv = 0 = 1-out holds for every inv); out is still forced "
+        "by the case pair, and inv occurs in no other constraint, so "
+        "its freedom reaches no other wire",
+    )
     cs.compute(inv, lambda v: pow(v, R - 2, R) if v else 0, [x])
     cs.compute(out, lambda v: 0 if v else 1, [x])
     return out
@@ -119,6 +129,12 @@ def is_equal_const(cs: ConstraintSystem, x: int, k: int, tag: str = "iseqc") -> 
     out = cs.new_wire(f"{tag}.out")
     cs.enforce(LC.of(x) - k, LC.of(inv), LC.const(1) - LC.of(out), f"{tag}/inv")
     cs.enforce(LC.of(x) - k, LC.of(out), LC(), f"{tag}/zero")
+    cs.set_width(out, 1)  # bool by the IsZero case pair
+    cs.waive(
+        "determinism", f"{tag}.inv",
+        "IsZero inverse (x==k case): free only when the difference is "
+        "zero, where out is already forced; occurs in no other constraint",
+    )
     cs.compute(inv, lambda v: pow((v - k) % R, R - 2, R) if (v - k) % R else 0, [x])
     cs.compute(out, lambda v: 1 if v == k % R else 0, [x])
     return out
@@ -127,12 +143,18 @@ def is_equal_const(cs: ConstraintSystem, x: int, k: int, tag: str = "iseqc") -> 
 def less_than(cs: ConstraintSystem, n: int, a: int, b: int, tag: str = "lt") -> int:
     """a < b for a, b < 2^n (circomlib LessThan: top bit of a - b + 2^n)."""
     assert n < 252
+    # soundness REQUIRES a, b < 2^n: an unbounded operand wraps the
+    # shifted difference and flips the verdict — the classic circom
+    # comparator forgery.  The static auditor checks the demand.
+    cs.require_width(a, n, f"{tag}/less_than.a")
+    cs.require_width(b, n, f"{tag}/less_than.b")
     shifted = cs.new_wire(f"{tag}.shift")
     cs.enforce_eq(LC.of(a) - LC.of(b) + (1 << n), LC.of(shifted), f"{tag}/shift")
     cs.compute(shifted, lambda x, y: (x - y + (1 << n)) % R, [a, b])
     bits = num2bits(cs, shifted, n + 1, f"{tag}.bits")
     out = cs.new_wire(f"{tag}.out")
     cs.enforce_eq(LC.const(1) - LC.of(bits[n]), LC.of(out), f"{tag}/out")
+    cs.set_width(out, 1)  # 1 - (bool bit)
     cs.compute(out, lambda top: 1 - top, [bits[n]])
     return out
 
@@ -141,26 +163,34 @@ def less_than(cs: ConstraintSystem, n: int, a: int, b: int, tag: str = "lt") -> 
 
 
 def and_gate(cs: ConstraintSystem, a: int, b: int, tag: str = "and") -> int:
+    cs.require_width(a, 1, f"{tag}/and.a")  # product == AND only for bools
+    cs.require_width(b, 1, f"{tag}/and.b")
     out = cs.new_wire(f"{tag}.out")
     cs.enforce(LC.of(a), LC.of(b), LC.of(out), tag)
+    cs.set_width(out, 1)
     cs.compute(out, lambda x, y: x * y % R, [a, b])
     return out
 
 
 def multi_or(cs: ConstraintSystem, bits: Sequence[int], tag: str = "or") -> int:
     """OR of boolean wires as NOT(sum == 0) (regex_helpers MultiOR:34-47)."""
+    for i, w in enumerate(bits):
+        cs.require_width(w, 1, f"{tag}/or.in{i}")  # field sum of bools
     total = cs.new_wire(f"{tag}.sum")
     cs.enforce_eq(lc_sum(bits), LC.of(total), f"{tag}/sum")
+    cs.set_width(total, max(1, len(list(bits)).bit_length()))
     cs.compute(total, lambda *bs: sum(bs) % R, list(bits))
     z = is_zero(cs, total, f"{tag}.z")
     out = cs.new_wire(f"{tag}.out")
     cs.enforce_eq(LC.const(1) - LC.of(z), LC.of(out), f"{tag}/not")
+    cs.set_width(out, 1)
     cs.compute(out, lambda v: 1 - v, [z])
     return out
 
 
 def mux2(cs: ConstraintSystem, sel: int, a: int, b: int, tag: str = "mux") -> int:
     """sel ? b : a  (sel boolean)."""
+    cs.require_width(sel, 1, f"{tag}/mux.sel")  # sel=2 would leak a-2b+2out
     out = cs.new_wire(f"{tag}.out")
     cs.enforce(LC.of(sel), LC.of(b) - LC.of(a), LC.of(out) - LC.of(a), tag)
     # branch-free (x + s*(y-x)): columnar-safe for the batch witness tier
@@ -193,6 +223,13 @@ def one_hot(cs: ConstraintSystem, idx: int, n: int, tag: str = "onehot") -> List
         # ind*(idx-i)=0 with sum(ind)=1 and sum(i*ind)=idx makes each
         # lane 0/1 for satisfying witnesses (invs stay full-width)
         cs.set_width(out, 1)
+    cs.waive(
+        "determinism", f"{tag}.*.inv",
+        "one-hot lane inverse: unconstrained exactly on the selected "
+        "lane (idx == i), where the lane output is forced by the case "
+        "pair and the two closing sums; each inv occurs in no other "
+        "constraint, so its freedom reaches no other wire",
+    )
     cs.enforce_eq(lc_sum(inds), LC.const(1), f"{tag}/onehot")
     cs.enforce_eq(lc_sum(inds, list(range(n))), LC.of(idx), f"{tag}/index")
     cs.set_width(idx, max(1, (n - 1).bit_length()))
@@ -223,15 +260,23 @@ def one_hot(cs: ConstraintSystem, idx: int, n: int, tag: str = "onehot") -> List
 
 
 def quin_selector(cs: ConstraintSystem, idx: int, options: Sequence[int], tag: str = "quin") -> int:
-    """out = options[idx] (utils.circom QuinSelector:20-47): one-hot dot."""
+    """out = options[idx] (utils.circom QuinSelector:20-47): one-hot dot.
+
+    The select products are emitted directly rather than through
+    and_gate: options are arbitrary field values, and and_gate's bool
+    demand on both operands (correct for AND) was the first bool-width
+    finding of the circuit auditor — a select is a mul, not an AND."""
     inds = one_hot(cs, idx, len(options), tag)
     out = cs.new_wire(f"{tag}.out")
-    terms = LC()
     prods = []
     for i, (ind, opt) in enumerate(zip(inds, options)):
-        p = and_gate(cs, ind, opt, f"{tag}.p{i}")
+        p = cs.new_wire(f"{tag}.p{i}.out")
+        cs.enforce(LC.of(ind), LC.of(opt), LC.of(p), f"{tag}.p{i}")
+        cs.set_width(p, cs.wire_width.get(opt, 254))  # bool lane x option
+        cs.compute(p, lambda s, v: s * v % R, [ind, opt])
         prods.append(p)
     cs.enforce_eq(lc_sum(prods), LC.of(out), f"{tag}/sum")
+    cs.set_width(out, max((cs.wire_width.get(p, 254) for p in prods), default=254))
     cs.compute(out, lambda *ps: sum(ps) % R, prods)
     return out
 
@@ -242,7 +287,11 @@ def quin_selector(cs: ConstraintSystem, idx: int, options: Sequence[int], tag: s
 def pack_bytes(cs: ConstraintSystem, byte_wires: Sequence[int], n_per: int = 7, tag: str = "pack") -> List[int]:
     """Pack byte wires into little-endian n_per-byte field words
     (utils.circom Bytes2Packed:120-172; 7 bytes/signal keeps values < 2^56).
-    Bytes must already be range-checked to 8 bits by the producer."""
+    Bytes must already be range-checked to 8 bits by the producer (the
+    static auditor enforces the demand: an unbounded byte forges the
+    packed word)."""
+    for i, w in enumerate(byte_wires):
+        cs.require_width(w, 8, f"{tag}/pack.byte{i}")
     out = []
     for chunk_i in range(0, len(byte_wires), n_per):
         chunk = byte_wires[chunk_i : chunk_i + n_per]

@@ -350,6 +350,8 @@ def match_count(cs: ConstraintSystem, states: List[List[int]], accept: FrozenSet
 
     out = cs.new_wire(tag)
     acc_wires = [states[t][a] for t in range(1, len(states)) for a in accept]
+    for w in acc_wires:  # count-by-sum assumes 0/1 lanes
+        cs.require_width(w, 1, f"{tag}/match_count.lane")
     cs.enforce_eq(lc_sum(acc_wires), LC.of(out), tag)
     cs.set_width(out, max(1, len(acc_wires).bit_length()))
     cs.compute_block([out], lambda m: m.sum(axis=0, keepdims=True), acc_wires)
@@ -374,6 +376,8 @@ def reveal_bytes(
     block_outs: List[int] = []
     for i, byte in enumerate(byte_wires):
         mask_wires = [states[i + 1][s] for s in reveal_states]
+        for w in mask_wires:  # mask-by-sum assumes disjoint 0/1 lanes
+            cs.require_width(w, 1, f"{tag}/reveal.lane")
         if len(mask_wires) == 1:
             mask = mask_wires[0]
         else:

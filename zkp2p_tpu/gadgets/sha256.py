@@ -278,6 +278,16 @@ def sha256_blocks(
     words in h0..h7 order)."""
     assert len(padded_byte_bits) % 64 == 0
     max_blocks = len(padded_byte_bits) // 64
+    # the whole compression pipeline (xor chains, ch/maj muxes, mod-2^32
+    # sums) assumes boolean message bits; a wide "bit" forges the digest
+    for bb in padded_byte_bits:
+        for w in bb:
+            cs.require_width(w, 1, f"{tag}/sha.msg_bit")
+    if init_state is not None:
+        for word in init_state:
+            for w in word:
+                if w is not None:
+                    cs.require_width(w, 1, f"{tag}/sha.midstate_bit")
     state = init_state if init_state is not None else state_words_from_const(cs, H0, f"{tag}.h0")
     per_block_out: List[List[Word]] = []
     for blk in range(max_blocks):

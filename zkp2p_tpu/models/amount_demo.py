@@ -31,6 +31,7 @@ def amount_circuit():
     amount_words = [cs.new_public(f"amount[{i}]") for i in range(n_words)]
     subject = cs.new_wires(subj_len, "subject")
     amount_idx = cs.new_wire("amount_idx")
+    cs.mark_input(subject + [amount_idx])  # the witness seed keys below
     bits = core.assert_bytes(cs, subject, "subj")
     cache = CharClassCache(cs)
     for w, b in zip(subject, bits):
@@ -80,6 +81,7 @@ def dryrun_circuit():
     cs = ConstraintSystem("graft_dryrun_vid")
     out = cs.new_public("hashed_id")
     wires = cs.new_wires(len(raw), "id")
+    cs.mark_input(wires)  # the witness seed keys below
     core.assert_bytes(cs, wires, "id")
     words = core.pack_bytes(cs, wires, 7, "id.pack")
     h = poseidon(cs, words, "id.pos")

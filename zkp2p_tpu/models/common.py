@@ -36,6 +36,8 @@ def shift_window(
     out = []
     L = len(data)
     n_one = len(idx_onehot)
+    for ind in idx_onehot:  # window soundness: exactly-one-hot 0/1 lanes
+        cs.require_width(ind, 1, f"{tag}/shift.lane")
     block_outs: List[int] = []
     rows: List[tuple] = []  # (j, i) per product, in creation order
     for j in range(width):

@@ -70,6 +70,14 @@ def build_email_verify(p: EmailVerifyParams):
     if p.body_regex:
         lay.reveal_idx = cs.new_wire("reveal_idx")
 
+    # prover-seeded inputs (inputs.email email_verify seed keys): the
+    # audit's determinism sources + hook-coverage exemptions
+    cs.mark_input(
+        lay.header + [lay.header_blocks] + lay.signature + lay.body
+        + [lay.body_blocks] + lay.midstate_bits + [lay.body_hash_idx]
+        + ([lay.reveal_idx] if p.body_regex else [])
+    )
+
     header_bits = core.assert_bytes(cs, lay.header, "hdr")
     body_bits = core.assert_bytes(cs, lay.body, "body")
     for w in lay.midstate_bits:

@@ -51,6 +51,7 @@ def _build_circuit(name: str, header: int, body: int):
 
         cs = ConstraintSystem("sha256")
         msg = cs.new_wires(header, "msg")
+        cs.mark_input(msg)
         bits = core.assert_bytes(cs, msg)
         sha256.sha256_blocks(cs, bits, None)
         return cs, (None, msg)
@@ -64,6 +65,7 @@ def _build_circuit(name: str, header: int, body: int):
         x = cs.new_wire("x")
         y = cs.new_wire("y")
         z = cs.new_wire("z")
+        cs.mark_input([x, y])
         cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
         cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
         cs.compute(z, lambda a, b: a * b % R, [x, y])
@@ -82,6 +84,24 @@ def cmd_setup(args):
     _log(f"building circuit {args.circuit} ...")
     cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
     _log(f"constraints={cs.num_constraints} wires={cs.num_wires} ({time.perf_counter()-t0:.0f}s)")
+    if not args.skip_audit:
+        # the registry admission gate (docs/STATIC_ANALYSIS.md §circuit
+        # audit): no key material is cut for a circuit with unwaived
+        # soundness findings.  Registered names carry their declared
+        # on-chain public layout into the public-layout rule.
+        from ..models.registry import SPECS
+        from ..snark.analysis import audit_circuit, require_clean
+
+        spec = SPECS.get(args.circuit)
+        rep = require_clean(audit_circuit(
+            cs,
+            name=f"{args.circuit}_{args.max_header}_{args.max_body}",
+            declared_n_public=spec.n_public if spec else None,
+        ))
+        _log(
+            f"soundness audit clean: 0 unwaived / {rep['waived']} waived "
+            f"findings in {rep['audit_s']}s ({rep['source']}, digest {rep['digest']})"
+        )
     _log("running development setup (production: import a ceremony zkey instead)")
     pk, vk = setup(cs, seed=args.seed)
     zkey_path = os.path.join(args.build_dir, "circuit_final.zkey")
@@ -624,6 +644,12 @@ def cmd_lint(args):
         argv += ["--rules", args.rules]
     if args.json:
         argv.append("--json")
+    if args.circuits is not None:
+        argv += ["--circuits", args.circuits] if args.circuits != "all" else ["--circuits"]
+        if args.flagship:
+            argv.append("--flagship")
+        if args.no_cache:
+            argv.append("--no-cache")
     raise SystemExit(lint_main(argv))
 
 
@@ -698,6 +724,8 @@ def main(argv=None):
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     s = sub.add_parser("setup", help="build circuit + dev zkey + vkey + verifier.sol")
+    s.add_argument("--skip-audit", action="store_true",
+                   help="bypass the circuit soundness audit (admission gate)")
     s.add_argument("--seed", default="zkp2p-tpu-dev")
     s.add_argument("--chunks", type=int, default=0, help="also split the zkey into N chunks (b..)")
     s.add_argument("--publish", help="artifact-store dir: upload gzip zkey chunks + manifest")
@@ -863,8 +891,18 @@ def main(argv=None):
     )
     s.add_argument("--rules", default="", help="comma-separated rule filter")
     s.add_argument("--json", action="store_true", help="machine-readable findings")
+    s.add_argument(
+        "--circuits", nargs="?", const="all", default=None, metavar="IDS",
+        help="run the R1CS soundness audit on registered circuits "
+        "(the registry admission gate) instead of the source rules",
+    )
+    s.add_argument("--flagship", action="store_true",
+                   help="with --circuits: include the 4.9M-wire flagship")
+    s.add_argument("--no-cache", action="store_true",
+                   help="with --circuits: ignore cached audit reports")
     # no_jax: lint is the pre-commit path — it must answer in seconds
-    # without importing jax or touching the compilation cache
+    # without importing jax or touching the compilation cache (the
+    # circuit tier builds real circuits but still needs only numpy)
     s.set_defaults(fn=cmd_lint, no_jax=True)
 
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")

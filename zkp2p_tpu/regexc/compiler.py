@@ -361,3 +361,48 @@ def search_dfa(pattern: str) -> DFA:
     match of `pattern` ends (the counting semantics the generated circuits
     rely on, e.g. `out === 2` for two to/from headers)."""
     return compile_regex(ANY_STAR + pattern)
+
+
+def reveal_circuit(pattern: str, n_bytes: int, reveal_len: int, name: str = "regex_reveal"):
+    """Mint a payment-extraction circuit from a bare regex — the
+    reference's regex_to_circom L0 path (gen.py:64-217), but straight to
+    R1CS: scan `pattern` over `n_bytes` private data bytes, reveal the
+    regex-masked match bytes, one-hot shift them to a fixed
+    `reveal_len` window anchored on a real revealed char (the venmo
+    vid/nonzero trick: an all-zero mask cannot forge the window), and
+    pack them into 7-byte public words.
+
+    This is how the registry (models.registry) mints new payment
+    circuits; the static soundness audit (snark.analysis) is their
+    admission gate, so a minted circuit never reaches the prover
+    unaudited.  Returns (cs, layout dict)."""
+    # lazy imports: gadgets.regex imports this module (cycle-free at call time)
+    from ..field.bn254 import R
+    from ..gadgets import core
+    from ..gadgets.regex import CharClassCache, dfa_scan, reveal_bytes
+    from ..models import common
+    from ..snark.r1cs import LC, ConstraintSystem
+
+    assert 0 < reveal_len < n_bytes
+    n_words = (reveal_len + 6) // 7
+    cs = ConstraintSystem(name)
+    word_pubs = [cs.new_public(f"reveal[{i}]") for i in range(n_words)]
+    data = cs.new_wires(n_bytes, "data")
+    idx = cs.new_wire("reveal_idx")
+    cs.mark_input(data + [idx])
+    bits = core.assert_bytes(cs, data, "data")
+    cache = CharClassCache(cs)
+    for w, b in zip(data, bits):
+        cache.register_bits(w, b)
+    dfa = search_dfa(pattern)
+    states = dfa_scan(cs, list(data), dfa, cache, "rx")
+    reveal = reveal_bytes(cs, data, states, sorted(dfa.accept), "rx.rev")
+    onehot = core.one_hot(cs, idx, n_bytes - reveal_len, "rx.idx")
+    chars = common.shift_window(cs, reveal, onehot, reveal_len, "rx.shift")
+    inv = cs.new_wire("rx.first_inv")
+    cs.compute(inv, lambda v: pow(v, R - 2, R) if v else 0, [chars[0]])
+    cs.enforce(LC.of(chars[0]), LC.of(inv), LC.const(1), "rx/nonzero")
+    words = core.pack_bytes(cs, chars, 7, "rx.pack")
+    for w, pub in zip(words, word_pubs):
+        cs.enforce_eq(LC.of(w), LC.of(pub), "rx/out")
+    return cs, {"data": data, "idx": idx, "publics": word_pubs, "dfa": dfa}

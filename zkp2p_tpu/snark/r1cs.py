@@ -152,6 +152,23 @@ class ConstraintSystem:
         # (SHA/DFA), so this is the structured-scalar analog of
         # rapidsnark's bit-concentrated-digit fast path.  Absent = 254.
         self.wire_width: Dict[int, int] = {0: 1}
+        # Demand-side width metadata (snark.analysis bool/width rule):
+        # gadgets whose soundness ASSUMES an input bound — comparators,
+        # boolean gates, packers — record (wire, bits, site) here and the
+        # static auditor checks every demand against a constraint-backed
+        # wire_width bound.  An unbounded comparator input is the classic
+        # circom forgery (e.g. LessThan on an unconstrained signal).
+        self.width_demands: List[tuple] = []
+        # Prover-seeded input wires (witness() private_inputs keys),
+        # declared by the circuit builder via mark_input: the soundness
+        # analysis treats them — with wire 0 and the publics — as the
+        # "given" wires every other wire must be determined from.
+        self.input_wires: set = set()
+        # Audit waivers: (rule, label-glob) -> written soundness argument.
+        # Declared INLINE at the gadget/model site that creates the waived
+        # structure (the PR-13 discipline: every exception greppable,
+        # justified where it lives).  An empty argument raises.
+        self.audit_waivers: Dict[tuple, str] = {}
 
     # ---------------------------------------------------------- allocation
 
@@ -208,6 +225,34 @@ class ConstraintSystem:
         if bits < cur:
             self.wire_width[w] = bits
 
+    def require_width(self, w: int, bits: int, site: str) -> None:
+        """Record that a gadget's soundness ASSUMES wire `w` < 2^bits
+        (bits=1: boolean).  Checked statically by snark.analysis: every
+        demand must be dominated by a constraint-backed set_width /
+        enforce_bool / num2bits bound, or the audit reports bool-width."""
+        self.width_demands.append((w, bits, site))
+
+    def mark_input(self, wires) -> None:
+        """Declare prover-seeded input wires (the witness()
+        private_inputs keys).  The soundness auditor propagates
+        determinism from wire 0 + publics + these; the hook-coverage
+        rule exempts them from needing a ComputeHook."""
+        if isinstance(wires, int):
+            wires = [wires]
+        self.input_wires.update(wires)
+
+    def waive(self, rule: str, label_glob: str, why: str) -> None:
+        """Waive an audit rule for wires whose label matches `label_glob`
+        (constraint rules match the tag instead).  `why` is a REQUIRED
+        written soundness argument — it lands verbatim in the audit
+        report, and an empty one is refused loudly."""
+        if not why or not why.strip():
+            raise ValueError(
+                f"audit waiver for ({rule}, {label_glob}) needs a written "
+                "soundness argument — an unjustified waiver is a review failure"
+            )
+        self.audit_waivers[(rule, label_glob)] = why
+
     # ---------------------------------------------------------- witness gen
 
     def compute(self, outs, fn, ins) -> None:
@@ -221,6 +266,19 @@ class ConstraintSystem:
         """Register a BlockHook: all of `outs` from one numpy program
         over `ins` (see BlockHook for the vfn contract)."""
         self.hooks.append(BlockHook(list(outs), vfn, list(ins), int64))
+
+    def wire_desc(self, i: int) -> str:
+        """Human description of a wire: index, label, and allocation site
+        (the gadget family = the auditor's label class, so witness-time
+        errors and static audit findings name wires the same way)."""
+        label = self.labels.get(i)
+        if not label:
+            return f"wire {i} (unlabelled)"
+        from .analysis import label_class
+
+        cls = label_class(label)
+        site = f", allocated by '{cls}'" if cls != label else ""
+        return f"wire {i} ('{label}'{site})"
 
     def witness(self, public_inputs: Sequence[int], private_inputs: Dict[int, int] | None = None) -> List[int]:
         """Run the witness program.  `public_inputs` fills wires 1..n_pub;
@@ -247,7 +305,7 @@ class ConstraintSystem:
                 for j, i in enumerate(hook.ins):
                     if w[i] is None:
                         raise RuntimeError(
-                            f"witness block reads unassigned wire {i} ({self.labels.get(i)})"
+                            f"witness block reads unassigned {self.wire_desc(i)}"
                         )
                     mat[j, 0] = w[i]
                 res = np.asarray(hook.vfn(mat))
@@ -262,7 +320,7 @@ class ConstraintSystem:
             for i in hook.ins:
                 if w[i] is None:
                     raise RuntimeError(
-                        f"witness hook reads unassigned wire {i} ({self.labels.get(i)})"
+                        f"witness hook reads unassigned {self.wire_desc(i)}"
                     )
                 args.append(w[i])
             vals = hook.fn(*args)
@@ -277,8 +335,10 @@ class ConstraintSystem:
         missing = [i for i, v in enumerate(w) if v is None]
         if missing:
             raise RuntimeError(
-                f"{len(missing)} unassigned wires, first: "
-                f"{[(i, self.labels.get(i)) for i in missing[:5]]}"
+                f"{len(missing)} unassigned wires (no hook or input seed "
+                "assigns them; `zkp2p-tpu lint --circuits` reports this "
+                "statically as hook-coverage), first: "
+                + "; ".join(self.wire_desc(i) for i in missing[:5])
             )
         return w  # type: ignore[return-value]
 
@@ -366,7 +426,7 @@ class ConstraintSystem:
             if not assigned[ins_idx].all():
                 bad = int(ins_idx[~assigned[ins_idx]][0])
                 raise RuntimeError(
-                    f"witness {kind} reads unassigned wire {bad} ({self.labels.get(bad)})"
+                    f"witness {kind} reads unassigned {self.wire_desc(bad)}"
                 )
 
         # The hook program is static per circuit: index arrays are cached
@@ -451,8 +511,10 @@ class ConstraintSystem:
         if not assigned.all():
             missing = np.flatnonzero(~assigned)
             raise RuntimeError(
-                f"{len(missing)} unassigned wires, first: "
-                f"{[(int(i), self.labels.get(int(i))) for i in missing[:5]]}"
+                f"{len(missing)} unassigned wires (no hook or input seed "
+                "assigns them; `zkp2p-tpu lint --circuits` reports this "
+                "statically as hook-coverage), first: "
+                + "; ".join(self.wire_desc(int(i)) for i in missing[:5])
             )
         if stats is not None:
             stats["vectorized_hooks"] = n_vec

@@ -423,6 +423,17 @@ def run_manifest() -> Dict:
             man["precomp"] = pm
     except Exception:  # noqa: BLE001 — attribution must not break a dump
         pass
+    # circuit soundness audits performed in this process (snark.analysis
+    # — the registry admission gate): digest + finding counts per
+    # circuit, so every artifact records WHICH audited circuit it served
+    try:
+        from ..snark.analysis import audit_manifest
+
+        am = audit_manifest()
+        if am:
+            man["circuit_audits"] = am
+    except Exception:  # noqa: BLE001 — attribution must not break a dump
+        pass
     return man
 
 
