@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -137,6 +137,16 @@ fleet-obs-smoke: native
 # ~40 s on the 2-core box (the autoscale demo is most of it).
 sched-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_sched.py -q
+
+# Host-profile + `zkp2p-tpu tune` smoke (fast; tier-1 resident;
+# docs/TUNING.md §host profiles): atomic profile round-trip, tampered /
+# foreign-fingerprint rejection to the fallback arm, byte-exact
+# geometry fallback parity (no profile = the hand-picked c16/q2/L8
+# oracle), profile-seeded AmortModel exiting warm-up with zero observed
+# batches, tuned-vs-fallback digest distinguishability, and a real
+# tiny-shape budgeted sweep end to end.  ~5 s on the 1-core box.
+tune-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_tune.py -q
 
 # The full fleet acceptance (slow): N=3 supervised workers, seeded
 # faults, worker SIGKILL + worker SIGTERM drain + supervisor

@@ -235,6 +235,13 @@ def _native_fallback_bench(plat: str) -> bool:
         from zkp2p_tpu.utils.audit import preflight
 
         preflight(probe=False, workload=False, log=log, cfg=cfg)
+        # host profile provenance: preflight armed the host_profile gate
+        # above; one explicit line here so a tuned-vs-fallback run pair
+        # is distinguishable from the log alone (zkp2p-tpu tune writes
+        # the profile, the geometry/thread resolvers consume it)
+        from zkp2p_tpu.utils.hostprof import profile_arm
+
+        log(f"host profile: {profile_arm()}")
         inputs = make_input(0)
         with trace("witness_gen"):
             w = cs.witness(inputs.public_signals, inputs.seed)
@@ -679,6 +686,10 @@ def main():
     from zkp2p_tpu.utils.audit import preflight
 
     preflight(probe=False, workload=False, log=log, cfg=cfg)
+    # host profile provenance (same line the native tier prints)
+    from zkp2p_tpu.utils.hostprof import profile_arm
+
+    log(f"host profile: {profile_arm()}")
     from zkp2p_tpu.prover.groth16_tpu import prove_tpu_batch
     from zkp2p_tpu.snark.groth16 import verify
     from zkp2p_tpu.utils.trace import dump_trace, trace

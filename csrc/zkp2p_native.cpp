@@ -25,6 +25,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <thread>
+#include <unistd.h>
 #include <vector>
 
 // ---------------------------------------------------------------------------
@@ -3590,6 +3591,39 @@ int zkp2p_batch_affine_enabled(void) { return batch_affine_enabled() ? 1 : 0; }
 // are active (ZKP2P_NTT_POOL unset / not leading-'0').  Fresh-read for
 // the same reason.
 int zkp2p_ntt_pool_enabled(void) { return ntt_pool_enabled() ? 1 : 0; }
+
+// Host cache capacity in bytes for the tune subsystem's cache-conscious
+// MSM schedule picking: level 1 = L1d, 2 = L2, 3 = L3 (LLC on most
+// parts).  sysconf is the portable glibc surface over cpuid/sysfs; a
+// kernel or libc that doesn't expose the level reports 0 = unknown and
+// the Python side falls back to sysfs, then to documented constants.
+long zkp2p_cache_size(int level) {
+  long v = -1;
+  switch (level) {
+#ifdef _SC_LEVEL1_DCACHE_SIZE
+    case 1: v = sysconf(_SC_LEVEL1_DCACHE_SIZE); break;
+#endif
+#ifdef _SC_LEVEL2_CACHE_SIZE
+    case 2: v = sysconf(_SC_LEVEL2_CACHE_SIZE); break;
+#endif
+#ifdef _SC_LEVEL3_CACHE_SIZE
+    case 3: v = sysconf(_SC_LEVEL3_CACHE_SIZE); break;
+#endif
+    default: break;
+  }
+  return v > 0 ? v : 0;
+}
+
+// Online logical CPU count as the runtime sees it (the same figure the
+// WorkPool sizes from when ZKP2P_NATIVE_THREADS is unset); 0 = unknown.
+long zkp2p_cpu_count(void) {
+#ifdef _SC_NPROCESSORS_ONLN
+  long v = sysconf(_SC_NPROCESSORS_ONLN);
+  return v > 0 ? v : 0;
+#else
+  return 0;
+#endif
+}
 
 // Differential-test hook for the 8-wide kernel: c[i] = a[i]*b[i] mod r,
 // standard form in/out, driven through pack -> mont260 vector multiply

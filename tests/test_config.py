@@ -94,6 +94,10 @@ def test_env_overrides_every_knob():
         "ZKP2P_WORKERS_MAX": "6",
         "ZKP2P_SCALE_UP_S": "12",
         "ZKP2P_SCALE_DOWN_S": "45",
+        "ZKP2P_PROFILE": "0",
+        "ZKP2P_PROFILE_PATH": "/tmp/prof.json",
+        "ZKP2P_TUNE_BUDGET_S": "45",
+        "ZKP2P_TUNE_ARMS": "geometry,columns",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -132,6 +136,8 @@ def test_env_overrides_every_knob():
     assert cfg.sched_priority_default == "interactive"
     assert cfg.workers_min == 1 and cfg.workers_max == 6
     assert cfg.scale_up_s == 12.0 and cfg.scale_down_s == 45.0
+    assert cfg.profile is False and cfg.profile_path == "/tmp/prof.json"
+    assert cfg.tune_budget_s == 45.0 and cfg.tune_arms == "geometry,columns"
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -165,6 +171,15 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_ALERT_RESTARTS": "0"}).alert_restarts == 1
     assert load_config(environ={"ZKP2P_ALERT_FOR_S": "-3"}).alert_for_s == 0.0
     assert load_config(environ={"ZKP2P_FLEET_SCRAPE_S": "junk"}).fleet_scrape_s == 2.0
+    # host-profile gate follows the C runtime's not-zero rule (off only
+    # on a leading '0'); the tune budget is a seconds knob (0 =
+    # unbudgeted, malformed keeps the committed default)
+    assert load_config(environ={"ZKP2P_PROFILE": "0"}).profile is False
+    assert load_config(environ={"ZKP2P_PROFILE": "true"}).profile is True
+    assert load_config(environ={}).profile is True  # default: profiles load
+    assert load_config(environ={"ZKP2P_TUNE_BUDGET_S": "0"}).tune_budget_s == 0.0
+    assert load_config(environ={"ZKP2P_TUNE_BUDGET_S": "junk"}).tune_budget_s == 120.0
+    assert load_config(environ={"ZKP2P_TUNE_BUDGET_S": "-5"}).tune_budget_s == 0.0
     # fleet knobs: breaker/backoff clamp like their service siblings
     assert load_config(environ={"ZKP2P_FLEET_WORKERS": "0"}).fleet_workers == 1
     assert load_config(environ={"ZKP2P_FLEET_WORKERS": "junk"}).fleet_workers == 2

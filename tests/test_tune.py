@@ -1,0 +1,357 @@
+"""Host profiles + `zkp2p-tpu tune` (utils.hostprof / pipeline.tune),
+tier-1 (`make tune-smoke`):
+
+  * persistence — schema round-trip through the atomic writer (no tmp
+    residue), the fingerprint stamp, the load-gate arm;
+  * fingerprint policy — a tampered profile (body edited after signing)
+    and a foreign profile (self-consistent, wrong hardware) are BOTH
+    rejected to the fallback arm; ZKP2P_PROFILE=0 is the "off" arm;
+  * geometry resolver — no profile keeps the byte-exact hand-picked
+    constants ((16, 2, 8) at sweep scale, the pinned fallback oracle);
+    a tuned profile swaps the window per family, a profile q may only
+    widen the hot loop, and small keys never consult the profile;
+  * scheduler seeding — build_controller with a tuned profile exits
+    warm-up with ZERO observed batches (calibrated, first plan sized by
+    the seeded curve, not "warmup"); an explicit ZKP2P_SCHED_AMORT spec
+    beats the profile and stays uncalibrated; no profile keeps the
+    built-in warm-up behavior;
+  * audit — tuned vs fallback runs never share an execution digest
+    (the host_profile gate);
+  * the tune sweep itself — a tiny-shape end-to-end run on the native
+    lib: budget respected, profile loadable, accessors live.
+"""
+
+import json
+import os
+
+import pytest
+
+from zkp2p_tpu.pipeline.sched import AmortModel, SchedRequest, build_controller
+from zkp2p_tpu.pipeline.tune import ARMS, parse_arms
+from zkp2p_tpu.utils import audit, hostprof
+from zkp2p_tpu.utils.config import load_config
+
+
+@pytest.fixture
+def prof_env(tmp_path, monkeypatch):
+    """Hermetic profile environment: the profile path points into
+    tmp_path (a repo-level .bench_cache profile must never leak into a
+    test), gate env is clean, memos + gate map reset around the test."""
+    path = str(tmp_path / "prof.json")
+    monkeypatch.setenv("ZKP2P_PROFILE_PATH", path)
+    for var in ("ZKP2P_PROFILE", "ZKP2P_SCHED_AMORT"):
+        monkeypatch.delenv(var, raising=False)
+    hostprof.reset()
+    audit.reset()
+    yield path
+    hostprof.reset()
+    audit.reset()
+
+
+def _save(path, **body):
+    out = hostprof.save_profile(dict(body), path)
+    assert out == path
+    return out
+
+
+SCHED_BODY = {"amort_points": {"1": 3.17, "2": 4.5, "4": 7.9}}
+FIXED_BODY = {"min_bl": 15, "default": {"c": 15, "q": 3}}
+
+
+# ------------------------------------------------------- persistence
+
+
+def test_round_trip_atomic_and_arm(prof_env, tmp_path):
+    _save(prof_env, created_ts=1.0, threads={"native_default": 3},
+          msm_fixed=FIXED_BODY, sched=SCHED_BODY)
+    # atomic writer: rename only, no torn tmp files left behind
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    prof = hostprof.load_profile()
+    assert prof is not None
+    assert prof["schema"] == hostprof.SCHEMA_VERSION
+    assert prof["fingerprint_key"] == hostprof.fingerprint_key()
+    assert prof["threads"]["native_default"] == 3
+    assert audit.gate_arms()["host_profile"] == "tuned"
+    assert hostprof.tuned_threads() == 3
+    assert hostprof.amort_points() == {1: 3.17, 2: 4.5, 4: 7.9}
+
+
+def test_missing_profile_is_fallback_arm(prof_env):
+    assert hostprof.load_profile() is None
+    assert audit.gate_arms()["host_profile"] == "fallback"
+    assert hostprof.tuned_threads() is None
+    assert hostprof.amort_points() is None
+    assert hostprof.geometry_for("h", 1 << 19) is None
+
+
+def test_gate_off(prof_env, monkeypatch):
+    _save(prof_env, created_ts=1.0, sched=SCHED_BODY)
+    monkeypatch.setenv("ZKP2P_PROFILE", "0")
+    hostprof.reset()
+    assert hostprof.load_profile() is None
+    assert audit.gate_arms()["host_profile"] == "off"
+    assert hostprof.amort_points() is None
+
+
+def test_default_path_is_fingerprint_keyed(tmp_path, monkeypatch):
+    monkeypatch.setenv("ZKP2P_MSM_PRECOMP_CACHE", str(tmp_path))
+    p = hostprof.default_profile_path()
+    assert p is not None
+    assert os.path.basename(p) == (
+        hostprof.PROFILE_PREFIX + hostprof.fingerprint_key() + ".json"
+    )
+
+
+# ------------------------------------------------- fingerprint policy
+
+
+def test_tampered_profile_rejected(prof_env):
+    """Body edited after signing (fingerprint no longer matches the
+    embedded key) -> distrust everything, fallback arm."""
+    _save(prof_env, created_ts=1.0, sched=SCHED_BODY)
+    with open(prof_env) as f:
+        prof = json.load(f)
+    prof["fingerprint"]["l2_bytes"] = int(prof["fingerprint"]["l2_bytes"]) + 1
+    with open(prof_env, "w") as f:
+        json.dump(prof, f)
+    hostprof.reset()
+    assert hostprof.load_profile() is None
+    assert audit.gate_arms()["host_profile"] == "fallback"
+
+
+def test_foreign_profile_rejected(prof_env):
+    """Self-consistent profile from DIFFERENT hardware (the copied-
+    .bench_cache case) -> rebuild, never mis-tune."""
+    _save(prof_env, created_ts=1.0, sched=SCHED_BODY)
+    with open(prof_env) as f:
+        prof = json.load(f)
+    prof["fingerprint"]["l2_bytes"] = int(prof["fingerprint"]["l2_bytes"]) + 1
+    prof["fingerprint_key"] = hostprof.fingerprint_key(prof["fingerprint"])
+    with open(prof_env, "w") as f:
+        json.dump(prof, f)
+    hostprof.reset()
+    assert hostprof.load_profile() is None
+    assert audit.gate_arms()["host_profile"] == "fallback"
+
+
+def test_schema_drift_rejected(prof_env):
+    _save(prof_env, created_ts=1.0)
+    with open(prof_env) as f:
+        prof = json.load(f)
+    prof["schema"] = hostprof.SCHEMA_VERSION + 1
+    with open(prof_env, "w") as f:
+        json.dump(prof, f)
+    hostprof.reset()
+    assert hostprof.load_profile() is None
+
+
+# ------------------------------------------------- geometry resolver
+
+
+def test_geometry_fallback_is_pinned_constants(prof_env):
+    """No profile -> the documented hand-picked geometry, byte-exact:
+    c16/q2/L8 at sweep scale (the same oracle test_msm_precomp pins)."""
+    from zkp2p_tpu.prover.precomp import _resolve_geometry, _resolve_geometry_prof
+
+    assert _resolve_geometry(1 << 19, 8, 1 << 62) == (16, 2, 8)
+    assert _resolve_geometry_prof(1 << 19, 8, 1 << 62, "h") == (16, 2, 8, "fallback")
+
+
+def test_geometry_profile_applies_at_scale(prof_env):
+    from zkp2p_tpu.prover.precomp import _resolve_geometry, _resolve_geometry_prof
+
+    _save(prof_env, created_ts=1.0, msm_fixed=FIXED_BODY)
+    # c=15 -> W=17, depth 8 -> q=ceil(17/8)=3 == tuned q, levels=6
+    assert _resolve_geometry_prof(1 << 19, 8, 1 << 62, "h") == (15, 3, 6, "profile")
+    # the no-profile oracle is untouched by a loaded profile
+    assert _resolve_geometry(1 << 19, 8, 1 << 62) == (16, 2, 8)
+    # small keys never consult the profile (min_bl floor)
+    assert hostprof.geometry_for("h", 1 << 10) is None
+    g = _resolve_geometry_prof(1 << 10, 8, 1 << 62, "h")
+    assert g is not None and g[3] == "fallback"
+
+
+def test_geometry_profile_q_only_widens(prof_env):
+    """A profile q below the depth-derived floor must not deepen the
+    table past the depth cap: q=1 at c=16 still resolves q=2."""
+    from zkp2p_tpu.prover.precomp import _resolve_geometry_prof
+
+    _save(prof_env, created_ts=1.0,
+          msm_fixed={"min_bl": 15, "default": {"c": 16, "q": 1}})
+    assert _resolve_geometry_prof(1 << 19, 8, 1 << 62, "h") == (16, 2, 8, "profile")
+
+
+def test_geometry_corrupt_window_rejected(prof_env):
+    _save(prof_env, created_ts=1.0,
+          msm_fixed={"min_bl": 15, "default": {"c": 40}})
+    assert hostprof.geometry_for("h", 1 << 19) is None
+
+
+def test_geometry_per_family_beats_default(prof_env):
+    _save(prof_env, created_ts=1.0,
+          msm_fixed={"min_bl": 15, "default": {"c": 16},
+                     "families": {"h": {"c": 15}}})
+    assert hostprof.geometry_for("h", 1 << 19) == {"c": 15}
+    assert hostprof.geometry_for("a", 1 << 19) == {"c": 16}
+
+
+# ----------------------------------------------- amort-point hygiene
+
+
+def test_amort_points_validation(prof_env):
+    _save(prof_env, created_ts=1.0,
+          sched={"amort_points": {"1": 3.0, "4": 2.0}})  # not increasing
+    assert hostprof.amort_points() is None
+    _save(prof_env, created_ts=1.0, sched={"amort_points": {"1": "x"}})
+    assert hostprof.amort_points() is None
+    _save(prof_env, created_ts=1.0, sched={"amort_points": {}})
+    assert hostprof.amort_points() is None
+
+
+# ------------------------------------------------- scheduler seeding
+
+
+def test_controller_seeded_from_profile(prof_env):
+    """The acceptance pin: a fresh host's scheduler exits warm-up with
+    ZERO observed batches — the profile's measured points ARE the
+    calibration, and the first plan is sized by them, not 'warmup'."""
+    _save(prof_env, created_ts=1.0, sched=SCHED_BODY)
+    ctl = build_controller(load_config())
+    assert ctl.calibrated is True
+    assert ctl.amort.batch_s(2) == pytest.approx(4.5)
+    plan = ctl.plan(
+        now=100.0,
+        reqs=[SchedRequest(rid=f"r{i}", t_submit=90.0, deadline=1e9)
+              for i in range(4)],
+        cap=4,
+    )
+    assert plan.batch_reason != "warmup"
+
+
+def test_controller_warmup_without_profile(prof_env):
+    ctl = build_controller(load_config())
+    assert ctl.calibrated is False
+    plan = ctl.plan(
+        now=100.0,
+        reqs=[SchedRequest(rid="r0", t_submit=90.0, deadline=1e9)],
+        cap=4,
+    )
+    assert plan.batch_reason == "warmup"
+
+
+def test_env_spec_beats_profile(prof_env, monkeypatch):
+    """Operator calibration (ZKP2P_SCHED_AMORT) wins over the profile
+    and starts uncalibrated, exactly as before this PR."""
+    _save(prof_env, created_ts=1.0, sched=SCHED_BODY)
+    monkeypatch.setenv("ZKP2P_SCHED_AMORT", "1:0.5,4:1.0")
+    hostprof.reset()
+    ctl = build_controller(load_config())
+    assert ctl.calibrated is False
+    assert ctl.amort.batch_s(1) == pytest.approx(0.5)
+
+
+def test_seed_calibration_keeps_ewma_correction():
+    """A seeded controller still folds real observations: the first
+    observe_batch lands in the EWMA branch (calibrated stays True) and
+    moves the scale, so micro-arm seeding cannot pin a wrong curve."""
+    from zkp2p_tpu.pipeline.sched import BatchController
+
+    ctl = BatchController(AmortModel({1: 1.0, 4: 2.0}))
+    ctl.seed_calibration()
+    assert ctl.calibrated and ctl.model_scale == pytest.approx(1.0)
+    ctl.observe_batch(4, 4.0)  # reality is 2x the seeded curve
+    assert ctl.calibrated
+    assert ctl.model_scale > 1.0
+
+
+# --------------------------------------------------------- audit
+
+
+def test_tuned_vs_fallback_digests_differ(prof_env):
+    from zkp2p_tpu.utils.audit import execution_digest
+
+    _save(prof_env, created_ts=1.0, sched=SCHED_BODY)
+    hostprof.load_profile()
+    tuned = execution_digest()
+    audit.reset()
+    hostprof.reset()
+    os.remove(prof_env)
+    hostprof.load_profile()
+    assert audit.gate_arms()["host_profile"] == "fallback"
+    assert execution_digest() != tuned
+
+
+def test_run_manifest_has_profile_block(prof_env):
+    from zkp2p_tpu.utils.metrics import run_manifest
+
+    _save(prof_env, created_ts=7.0, sched=SCHED_BODY)
+    man = run_manifest()
+    blk = man["host_profile"]
+    assert blk["arm"] == "tuned"
+    assert blk["path"] == prof_env
+    assert blk["host_fingerprint"] == hostprof.fingerprint_key()
+    assert blk["created_ts"] == 7.0
+
+
+# --------------------------------------------------------- the sweep
+
+
+def test_parse_arms():
+    assert parse_arms("") == list(ARMS)
+    assert parse_arms("geometry, threads") == ["threads", "geometry"]  # ARMS order
+    assert parse_arms("nonsense") == []
+
+
+def test_tune_smoke(prof_env, tmp_path, monkeypatch):
+    """End-to-end tiny-shape sweep on the native lib: runs inside the
+    budget, writes a profile THIS host loads, accessors live."""
+    from zkp2p_tpu.prover.native_prove import _lib
+
+    if _lib() is None:
+        pytest.skip("native library unavailable")
+    monkeypatch.setenv("ZKP2P_MSM_PRECOMP_CACHE", str(tmp_path / "cache"))
+    from zkp2p_tpu.pipeline.tune import run_tune
+
+    logs = []
+    prof = run_tune(n=1 << 10, reps=1, budget_s=120.0, out_path=prof_env,
+                    arms_spec="threads,geometry,columns", log=logs.append)
+    assert prof is not None
+    assert prof["tune"]["arms_run"] == ["threads", "geometry", "columns"]
+    assert prof["tune"]["spent_s"] < 120.0
+    assert prof["threads"]["native_default"] >= 1
+    assert 4 <= prof["msm_fixed"]["default"]["c"] <= 20
+    hostprof.reset()
+    audit.reset()
+    loaded = hostprof.load_profile()
+    assert loaded is not None
+    assert audit.gate_arms()["host_profile"] == "tuned"
+    assert hostprof.geometry_for("h", 1 << 19) is not None
+    # columns arm measured -> seeded amort curve anchored at the
+    # committed single-prove point
+    pts = hostprof.amort_points()
+    if pts is not None:
+        from zkp2p_tpu.pipeline.sched import DEFAULT_AMORT_POINTS
+
+        assert pts[1] == pytest.approx(DEFAULT_AMORT_POINTS[1])
+
+
+def test_tune_budget_truncation(prof_env, monkeypatch):
+    """A budget too small for any arm still persists a loadable profile
+    whose un-measured dimensions keep the committed fallbacks."""
+    from zkp2p_tpu.prover.native_prove import _lib
+
+    if _lib() is None:
+        pytest.skip("native library unavailable")
+    from zkp2p_tpu.pipeline.tune import run_tune
+
+    prof = run_tune(n=1 << 10, reps=1, budget_s=1e-9, out_path=prof_env,
+                    log=lambda m: None)
+    assert prof is not None
+    assert prof["tune"]["arms_run"] == []
+    assert "msm_fixed" not in prof and "sched" not in prof
+    hostprof.reset()
+    audit.reset()
+    assert hostprof.load_profile() is not None  # loads fine...
+    assert hostprof.geometry_for("h", 1 << 19) is None  # ...falls back
+    assert hostprof.amort_points() is None
+    assert hostprof.tuned_threads() >= 1  # topology default, measured or not

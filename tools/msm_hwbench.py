@@ -45,12 +45,24 @@ JAX side; one clean env per arm on the native side).
 """
 
 import argparse
+import json
 import os
 import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+
+# --json accumulator: every bench arm appends one record (arm, shape,
+# min-of-reps seconds, parity hash where the arm has an oracle), and
+# main() emits ONE JSON document after all text output.  The text lines
+# above it stay byte-stable — existing docs/scripts scrape them; the
+# tune pass (zkp2p_tpu.pipeline.tune) consumes the records.
+_RESULTS = []
+
+
+def _rec(**kw):
+    _RESULTS.append(kw)
 
 
 def _native_bench(args):
@@ -139,6 +151,13 @@ def _native_bench(args):
         f"(all: {' '.join(f'{t*1e3:.0f}' for t in times)}) -> {n/best/1e6:.3f} M pts/s "
         f"result_x={x % (1 << 64):#x}",
         flush=True,
+    )
+    import hashlib
+
+    _rec(
+        arm="native_msm", tag="glv" if args.glv else "plain", n=n, c=c,
+        threads=threads, reps=reps, min_s=best, times_s=times,
+        result_hash=hashlib.sha256(out.tobytes()).hexdigest()[:16],
     )
 
 
@@ -244,6 +263,11 @@ def _native_precomp_bench(args, lib, bm, sc, threads):
         f"parity={parity} result_hash={h}",
         flush=True,
     )
+    _rec(
+        arm="native_msm_precomp", tag=tag, n=n, S=S, c=cf, q=q, levels=levels,
+        threads=threads, reps=reps, build_s=t_build, min_s=bf,
+        oracle_min_s=br, oracle_c=c_ref, parity=parity, result_hash=h,
+    )
     assert parity == "OK", "precomp result diverged from the variable-base oracle"
 
 
@@ -331,6 +355,11 @@ def _native_multi_bench(args, lib, bm, threads):
         f"parity={parity} result_hash={h}",
         flush=True,
     )
+    _rec(
+        arm="native_msm_multi", tag=tag, n=n, S=S, c=c, threads=threads,
+        reps=reps, min_s=bm_multi, seq_min_s=bm_seq, parity=parity,
+        result_hash=h,
+    )
     assert parity == "OK", "multi-column result diverged from the sequential oracle"
 
 
@@ -413,6 +442,10 @@ def _ladder_bench(args):
         f"-> {mo/ms:.2f}x parity=OK",
         flush=True,
     )
+    _rec(
+        arm="ladder_matvec", m=m, nnz=nnz, threads=threads, reps=args.reps,
+        min_s=ms, oracle_min_s=mo, parity="OK",
+    )
 
     # ---- H ladder: pool-fused arm vs the 3-wide unfused arm
     wroot = np.ascontiguousarray(
@@ -442,6 +475,10 @@ def _ladder_bench(args):
         f"h_ladder m=2^{log_m}: unfused min={lu*1e3:.0f} ms pool-fused min={lp*1e3:.0f} ms "
         f"-> {lu/lp:.2f}x parity=OK",
         flush=True,
+    )
+    _rec(
+        arm="ladder_h", m=m, threads=threads, reps=args.reps,
+        min_s=lp, unfused_min_s=lu, parity="OK",
     )
 
 
@@ -511,6 +548,13 @@ def main():
         "--no-batch-affine", action="store_true",
         help="native tier: plain mixed-Jacobian bucket fill (the A/B baseline)",
     )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="after all text output, emit ONE JSON document of structured "
+        "per-arm records (arm, shape, min-of-reps seconds, parity hash) — "
+        "the machine-readable surface the tune pass consumes; the text "
+        "lines above it are unchanged",
+    )
     args = ap.parse_args()
     if args.glv:
         args.signed = True
@@ -521,6 +565,14 @@ def main():
     elif args.no_batch_affine:
         os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "0"
 
+    try:
+        _dispatch(args)
+    finally:
+        if args.json:
+            print(json.dumps({"schema": 1, "records": _RESULTS}, sort_keys=True), flush=True)
+
+
+def _dispatch(args):
     if args.ladder:
         _ladder_bench(args)
         return
@@ -595,6 +647,7 @@ def main():
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / iters
         print(f"add_mixed: B={B} {dt*1e3:.1f} ms -> {B/dt/1e6:.2f} M adds/s", flush=True)
+        _rec(arm="jax_add_mixed", n=B, min_s=dt, reps=iters)
 
     if args.skip_msm:
         return
@@ -631,6 +684,10 @@ def main():
     jax.block_until_ready(r)
     dt = time.perf_counter() - t0
     print(f"msm_windowed: {tag} {dt:.2f} s -> {n/dt/1e6:.3f} M pts/s", flush=True)
+    _rec(
+        arm="jax_msm_windowed", n=n, window=args.window, min_s=dt, reps=1,
+        compile_s=compile_and_first,
+    )
 
 
 if __name__ == "__main__":

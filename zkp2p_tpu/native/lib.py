@@ -150,6 +150,35 @@ def ifma_available() -> bool:
         return False
 
 
+def cache_sizes() -> Optional[dict]:
+    """Detected data-cache capacities in bytes via the C runtime's
+    sysconf probe: {"l1d": int, "l2": int, "l3": int}, 0 = that level is
+    unknown to the kernel/libc.  None when the native lib is unavailable
+    or predates the probe (stale .so — degrade, never AttributeError;
+    the host-profile layer falls back to sysfs, then to documented
+    constants)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "zkp2p_cache_size"):
+        return None
+    lib.zkp2p_cache_size.argtypes = [ctypes.c_int]
+    lib.zkp2p_cache_size.restype = ctypes.c_long
+    return {
+        "l1d": int(lib.zkp2p_cache_size(1)),
+        "l2": int(lib.zkp2p_cache_size(2)),
+        "l3": int(lib.zkp2p_cache_size(3)),
+    }
+
+
+def native_cpu_count() -> Optional[int]:
+    """Online logical CPU count as the C runtime's WorkPool sees it;
+    None when the lib is unavailable/stale, 0 when the libc cannot say."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "zkp2p_cpu_count"):
+        return None
+    lib.zkp2p_cpu_count.restype = ctypes.c_long
+    return int(lib.zkp2p_cpu_count())
+
+
 def stats_reset() -> bool:
     """Zero the native counter block; False if the lib is unavailable
     (or predates the stats block — see stats_snapshot)."""

@@ -715,6 +715,28 @@ def cmd_doctor(args):
         sys.exit(1)
 
 
+def cmd_tune(args):
+    """Budgeted host micro-sweep → fingerprint-keyed profile beside
+    .bench_cache (pipeline.tune).  Every resolver that today falls back
+    to a hand-picked constant (fixed-tier MSM geometry, native thread
+    default, the scheduler's amortization curve) loads the profile at
+    startup; `--out` writes elsewhere (set ZKP2P_PROFILE_PATH to load
+    it), `--arms` filters the sweep, `--budget-s` caps wall clock."""
+    from .tune import run_tune
+
+    prof = run_tune(
+        n=args.n,
+        reps=args.reps,
+        budget_s=args.budget_s,
+        out_path=args.out or None,
+        arms_spec=args.arms,
+        log=_log,
+    )
+    if prof is None:
+        _log("tune: nothing tuned (native library unavailable)")
+        sys.exit(1)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("zkp2p-tpu", description=__doc__)
     ap.add_argument("--build-dir", default=os.environ.get("BUILD_DIR", "build"))
@@ -876,6 +898,21 @@ def main(argv=None):
     s.add_argument("--beacon-hash", default="", help="public beacon value, hex (beacon)")
     s.add_argument("--iter-exp", type=int, default=10, help="beacon hash iterations = 2^n (beacon)")
     s.set_defaults(fn=cmd_ceremony)
+
+    s = sub.add_parser(
+        "tune",
+        help="budgeted host micro-sweep -> fingerprint-keyed profile (geometry/threads/amortization)",
+    )
+    s.add_argument("--n", type=int, default=1 << 15,
+                   help="MSM shape per micro-arm (default 32768; bigger = more faithful, slower)")
+    s.add_argument("--reps", type=int, default=3, help="min-of-reps per measurement")
+    s.add_argument("--budget-s", type=float, default=None,
+                   help="wall-clock budget (default: ZKP2P_TUNE_BUDGET_S)")
+    s.add_argument("--out", default=None,
+                   help="profile path (default: .bench_cache/host_profile_<fp>.json)")
+    s.add_argument("--arms", default=None,
+                   help="comma list of arms (threads,window,geometry,columns,ladder); default: ZKP2P_TUNE_ARMS or all")
+    s.set_defaults(fn=cmd_tune)
 
     s = sub.add_parser("doctor", help="execution-path preflight: arm every gate, report arms + digest")
     s.add_argument("--json", action="store_true", help="machine-readable report on stdout")

@@ -424,6 +424,12 @@ class FleetSupervisor:
                 log("autoscale needs the fleet plane: enabling an ephemeral fleet metrics port")
         record_arm("service_fleet", f"supervisor:{self.n}")
         governor_arm()
+        # host profile: arm the gate once at supervisor startup and say
+        # which way it went — workers inherit the same .bench_cache, so
+        # one line here covers the whole fleet's tuning provenance
+        from ..utils.hostprof import profile_arm
+
+        log(f"host profile: {profile_arm()}")
 
     # ------------------------------------------------------------ spawn
 

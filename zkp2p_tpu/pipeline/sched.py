@@ -228,6 +228,20 @@ class BatchController:
             self.model_scale += 0.3 * (ratio - self.model_scale)
         return self.model_scale
 
+    def seed_calibration(self) -> None:
+        """Mark the controller calibrated WITHOUT an observed batch —
+        for amortization curves that are themselves measurements of
+        this host (the tune-produced profile points), so a fresh worker
+        sizes and sheds from its first decision instead of spending its
+        warm-up window at the cap with deadline-only shedding.  The
+        scale stays 1.0: the seed points ARE the model; the first real
+        observe_batch still folds measured-vs-seeded error in through
+        the normal EWMA path (calibrated stays True, so one outlier
+        cannot overwrite the seed wholesale the way the cold-start
+        first-observation assignment would)."""
+        self.model_scale = 1.0
+        self.calibrated = True
+
     def _batch_s(self, s: int) -> float:
         """The model with the online calibration applied."""
         return self.model_scale * self.amort.batch_s(s)
@@ -547,3 +561,42 @@ def sched_arm() -> str:
     """Preflight alias (the *_arm naming every other gate resolver
     uses); identical to sched_mode()."""
     return sched_mode()
+
+
+def build_controller(cfg) -> BatchController:
+    """THE BatchController factory (service + tests share it): the
+    amortization curve resolves explicit spec -> tuned host profile ->
+    built-in venmo default, in operator-intent order.
+
+      1. ZKP2P_SCHED_AMORT set: the operator's calibration wins and the
+         controller starts UNCALIBRATED as before (the spec may describe
+         a different circuit than the traffic).
+      2. spec empty + a tuned profile loaded with measured batch-cost
+         points: the profile seeds the model AND the calibration
+         (seed_calibration) — a fresh host's scheduler exits warm-up
+         with zero observed batches, because the points were measured
+         on THIS hardware by `zkp2p-tpu tune`.
+      3. neither: the built-in conservative curve, warm-up as before.
+
+    Resolving through hostprof records the "host_profile" gate, so a
+    seeded and an unseeded run never share an execution digest."""
+    from ..utils.hostprof import amort_points
+
+    seeded = False
+    if cfg.sched_amort.strip():
+        amort = AmortModel.from_spec(cfg.sched_amort)
+    else:
+        pts = amort_points()
+        if pts is not None:
+            amort = AmortModel(pts)
+            seeded = True
+        else:
+            amort = AmortModel(DEFAULT_AMORT_POINTS)
+    ctl = BatchController(
+        amort,
+        objective_s=cfg.slo_p95_s,
+        target_fill=cfg.sched_target_fill,
+    )
+    if seeded:
+        ctl.seed_calibration()
+    return ctl

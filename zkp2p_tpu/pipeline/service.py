@@ -556,17 +556,15 @@ class ProvingService:
         """The lazily-built BatchController (adaptive arm only).  The
         amortization model and objective are resolved once per process —
         calibration cannot change under a running service; the GATE
-        stays fresh-read per sweep."""
+        stays fresh-read per sweep.  Resolution (sched.build_controller):
+        explicit ZKP2P_SCHED_AMORT -> tuned host-profile points (the
+        controller starts CALIBRATED — the points were measured on this
+        hardware) -> built-in venmo curve with warm-up."""
         if self._sched_ctl is None:
             from ..utils.config import load_config
-            from .sched import AmortModel, BatchController
+            from .sched import build_controller
 
-            cfg = load_config()
-            self._sched_ctl = BatchController(
-                AmortModel.from_spec(cfg.sched_amort),
-                objective_s=cfg.slo_p95_s,
-                target_fill=cfg.sched_target_fill,
-            )
+            self._sched_ctl = build_controller(load_config())
         return self._sched_ctl
 
     # -------------------------------------------------------- observability

@@ -1,0 +1,513 @@
+"""`zkp2p-tpu tune`: the budgeted host micro-sweep behind host profiles.
+
+Every committed constant was hand-picked on one 2-core IFMA box; this
+module re-derives the host-dependent ones HERE, on THIS hardware, by
+running the same micro-arms `tools/msm_hwbench.py` measures (variable-
+base Pippenger, the fixed precomputed-table tier, multi-column batch
+MSMs, the segmented matvec + pooled H ladder) and persisting the
+winners as a fingerprint-keyed profile (utils.hostprof) that the
+geometry/thread/scheduler resolvers load at startup.
+
+Sweep arms (ZKP2P_TUNE_ARMS filters; all by default, run in DECISION
+order — arms that pin schedules first, evidence arms last, so a budget
+truncation costs rows, never winners):
+
+  threads   variable-base MSM wall vs worker count over the detected
+            topology candidates {1, physical cores, logical CPUs} — the
+            profile's ZKP2P_NATIVE_THREADS default.  Physical-vs-SMT
+            aware: when the arm cannot run, the default falls back to
+            the PHYSICAL core count, never the SMT-inflated logical one
+            (two hyperthreads share one FMA pipe; the MSM inner loop
+            gains nothing from the second).
+  geometry  the fixed precomputed-table tier, cache-consciously
+            (SZKP-style): candidate windows are ranked by bucket-set
+            bytes (2^(c-1) x 80 B batch-affine block per in-flight
+            window) against the detected LLC before any is measured,
+            tables are built per candidate, and the best measured c
+            (with its depth-derived q, widened to >= the thread count
+            so the window-parallel axis stays covered) becomes the
+            per-G1-family schedule.  Run this arm at bench scale
+            (--n >= 2^17): bucket occupancy shifts with shape, and a
+            schedule tuned on a toy MSM extrapolates upward badly —
+            the hysteresis rule additionally keeps the committed
+            geometry unless a candidate beats it beyond jitter.
+  columns   the multi-column fixed-tier kernel at S in {1, 2, 4} — the
+            batch amortization curve.  The profile stores the measured
+            RATIOS scaled onto the committed single-prove anchor
+            (DEFAULT_AMORT_POINTS[1]), because a micro-arm MSM second
+            is not a whole-prove second; the basis is recorded in the
+            profile and the controller's observe_batch EWMA folds
+            residual absolute error in after the first real batch.
+  window    variable-base window sweep around the committed curves
+            (plain + GLV) — recorded as evidence only; the hand curves
+            stay authoritative for the variable-base tiers.
+  ladder    the non-MSM floor (segmented matvec + pooled H ladder) at
+            the resolved pool width — evidence for the NTT/matvec pool
+            split (the C pool re-reads its width from the env, so the
+            per-thread sweep rides the threads arm's MSM numbers).
+
+The sweep is WALL-CLOCK BUDGETED (ZKP2P_TUNE_BUDGET_S / --budget-s):
+the deadline is checked before every measured candidate, a truncated
+sweep persists whatever it measured (with `tune.truncated` set), and
+every un-measured dimension simply keeps the committed fallback — a
+tune pass can only ever pin measured winners, never guess.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# execution order = decision arms first (threads feeds geometry's
+# worker count; geometry feeds columns its table), evidence arms last —
+# so a budget truncation drops evidence rows, never a tuned schedule
+ARMS = ("threads", "geometry", "columns", "window", "ladder")
+
+# fixed-tier candidate windows, widest-first trimmed by the cache model;
+# the committed fallback (c=16) is always a candidate so the tune pass
+# can only ever match-or-beat it on this host's own measurements
+_GEOMETRY_CANDIDATES = (14, 15, 16, 17)
+_COLUMN_CANDIDATES = (1, 2, 4)
+_BUCKET_ROW_BYTES = 80  # one batch-affine Aff52 bucket row (csrc)
+# a candidate must beat the COMMITTED geometry by more than this to
+# replace it — a sub-jitter micro-arm win is noise, and switching the
+# production schedule on noise is how a tune pass regresses a prove
+_GEOMETRY_HYSTERESIS = 0.03
+
+
+def parse_arms(spec: str) -> List[str]:
+    """The ZKP2P_TUNE_ARMS grammar: comma-separated arm names, unknown
+    names ignored with a warning by the caller, "" = all."""
+    if not spec.strip():
+        return list(ARMS)
+    want = {p.strip() for p in spec.split(",") if p.strip()}
+    return [a for a in ARMS if a in want]
+
+
+def _bucket_set_bytes(c: int, threads: int) -> int:
+    """Resident bucket working set for one in-flight batch-affine
+    window per worker — the SZKP-style cache-pressure model the
+    geometry candidates are ranked against."""
+    return (1 << (c - 1)) * _BUCKET_ROW_BYTES * max(1, threads)
+
+
+def _tiled_bases(lib, n: int):
+    """(n, 8) Montgomery affine bases: 64 distinct k*G tiled to n — the
+    msm_hwbench base-set idiom (distinct enough to defeat trivial
+    bucket collisions, cheap enough to build inside the budget)."""
+    from ..curve.host import G1_GENERATOR, g1_mul
+    from ..native.lib import _pack_affine
+    from ..prover.native_prove import _p
+
+    rng = np.random.default_rng(7)
+    host_pts = [g1_mul(G1_GENERATOR, int(k)) for k in rng.integers(1, 1 << 30, 64)]
+    bases = _pack_affine(host_pts)
+    bm64 = np.zeros_like(bases)
+    lib.fp_to_mont(_p(bases), _p(bm64), 2 * 64)
+    return np.ascontiguousarray(np.tile(bm64, ((n + 63) // 64, 1))[:n])
+
+
+def _scalar_cols(n: int, S: int) -> np.ndarray:
+    """(S, n, 4) full-width random Fr scalars (the ladder-shape worst
+    case — witness columns are narrower and only faster)."""
+    import random
+
+    from ..field.bn254 import R
+    from ..native.lib import _scalars_to_u64
+
+    py_rng = random.Random(13)
+    cols = [[py_rng.randrange(R) for _ in range(n)] for _ in range(S)]
+    return np.ascontiguousarray(np.stack([_scalars_to_u64(col) for col in cols]))
+
+
+def _min_of(fn, reps: int) -> float:
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def run_tune(
+    n: int = 1 << 15,
+    reps: int = 3,
+    budget_s: Optional[float] = None,
+    out_path: Optional[str] = None,
+    arms_spec: Optional[str] = None,
+    log=print,
+) -> Optional[Dict]:
+    """Run the budgeted sweep and persist the profile; returns the
+    profile dict (None when the native library is unavailable — there
+    is nothing host-specific to tune on the pure-Python path)."""
+    from ..native.lib import ifma_available
+    from ..prover.native_prove import _lib, _p
+    from ..utils.config import load_config
+    from ..utils.hostprof import (
+        GEOMETRY_MIN_BL,
+        cache_hierarchy,
+        fingerprint_key,
+        host_fingerprint,
+        save_profile,
+    )
+    from .sched import DEFAULT_AMORT_POINTS
+
+    lib = _lib()
+    if lib is None:
+        log("tune: native library unavailable — nothing to tune")
+        return None
+    cfg = load_config()
+    if budget_s is None:
+        budget_s = cfg.tune_budget_s
+    arms = parse_arms(cfg.tune_arms if arms_spec is None else arms_spec)
+    t_start = time.perf_counter()
+    deadline = t_start + budget_s if budget_s > 0 else None
+
+    def left() -> float:
+        return float("inf") if deadline is None else deadline - time.perf_counter()
+
+    fp = host_fingerprint()
+    caches = cache_hierarchy()
+    llc = caches["l3"] or caches["l2"] or (1 << 25)
+    physical = int(fp["physical_cores"])
+    logical = int(fp["cpu_count"])
+    log(
+        f"tune: host {fingerprint_key()} ({fp['cpu_model']}, "
+        f"{physical} core(s) / {logical} cpu(s), "
+        f"L2 {caches['l2']:,} B, LLC {llc:,} B, "
+        f"ifma {'on' if fp['ifma'] else 'off'}) "
+        f"shape n={n} reps={reps} budget {budget_s:.0f}s arms {','.join(arms)}"
+    )
+
+    bm = _tiled_bases(lib, n)
+    sweep: Dict = {}
+    arms_run: List[str] = []
+    truncated = False
+
+    # ---------------------------------------------------------- threads
+    # candidates from the detected topology; the variable-base plain MSM
+    # is the probe (explicit thread arg — the C pool width itself is an
+    # env read the sweep must not fight)
+    thread_cands = sorted({1, physical, logical})
+    best_threads = physical  # the topology-aware no-measurement default
+    if "threads" in arms and left() > 0:
+        arms_run.append("threads")
+        sc1 = _scalar_cols(n, 1)[0]
+        out = np.zeros(8, dtype=np.uint64)
+        times: Dict[int, float] = {}
+        from ..prover.native_prove import _pick_window
+
+        for t in thread_cands:
+            if left() <= 0:
+                truncated = True
+                break
+            c = _pick_window(n, threads=t)
+            times[t] = _min_of(
+                lambda: lib.g1_msm_pippenger_mt(_p(bm), _p(sc1), n, c, t, _p(out)),
+                reps,
+            )
+            log(f"tune: threads={t} c={c} min={times[t]*1e3:.0f} ms")
+        if times:
+            # argmin, ties to FEWER threads (same wall, cooler box)
+            best_threads = min(sorted(times), key=lambda t: (times[t], t))
+        sweep["threads"] = {str(t): v for t, v in times.items()}
+
+    # --------------------------------------------------------- geometry
+    # the fixed tier, cache-consciously: rank candidates by bucket-set
+    # bytes against the LLC, then measure the survivors
+    geometry: Optional[Dict] = None
+    best_table = None  # (table, p52/table52, c, q, levels) for the columns arm
+    if "geometry" in arms and left() > 0:
+        arms_run.append("geometry")
+        from ..prover.precomp import _resolve_geometry, fixed_nwin
+
+        depth = int(cfg.precomp_depth)
+        cands = [
+            c for c in _GEOMETRY_CANDIDATES
+            if _bucket_set_bytes(c, best_threads) <= llc // 2
+        ]
+        dropped = [c for c in _GEOMETRY_CANDIDATES if c not in cands]
+        if dropped:
+            log(
+                f"tune: geometry candidates {dropped} dropped — bucket set "
+                f"exceeds LLC/2 ({llc // 2:,} B) at threads={best_threads}"
+            )
+        sc1 = _scalar_cols(n, 1)
+        out = np.zeros((1, 8), dtype=np.uint64)
+        rows: Dict[str, Dict] = {}
+        tables: Dict[int, tuple] = {}
+        for c in cands:
+            if left() <= 0:
+                truncated = True
+                break
+            W = fixed_nwin(c)
+            levels = max(1, min(depth, W))
+            q = (W + levels - 1) // levels
+            # q >= threads keeps the window-parallel axis at least as
+            # wide as the worker pool (the csrc fixed driver splits on
+            # the q hot-loop windows)
+            q = max(q, best_threads)
+            levels = (W + q - 1) // q
+            t0 = time.perf_counter()
+            table = np.zeros((levels * n, 8), dtype=np.uint64)
+            lib.g1_precomp_build(_p(bm), n, c, q, levels, best_threads, _p(table))
+            build_s = time.perf_counter() - t0
+            table52 = np.zeros((levels * n, 10), dtype=np.uint64)
+            p52 = _p(table52) if lib.g1_precomp_to52(_p(table), levels * n, _p(table52)) else None
+            min_s = _min_of(
+                lambda: lib.g1_msm_pippenger_fixed(
+                    _p(table), p52, _p(sc1), n, n, levels, c, q, best_threads, _p(out)
+                ),
+                reps,
+            )
+            rows[str(c)] = {
+                "q": q, "levels": levels, "min_s": min_s, "build_s": build_s,
+                "bucket_set_bytes": _bucket_set_bytes(c, best_threads),
+            }
+            log(f"tune: geometry c={c} q={q} L={levels} min={min_s*1e3:.0f} ms")
+            tables[c] = (table, table52 if p52 is not None else None, q, levels)
+        sweep["geometry"] = rows
+        if rows:
+            # hysteresis: the committed geometry stays unless a
+            # candidate beats it by more than the jitter floor
+            fb = _resolve_geometry(n, depth, 1 << 62)
+            chosen = int(min(rows, key=lambda k: rows[k]["min_s"]))
+            if fb is not None and str(fb[0]) in rows and chosen != fb[0]:
+                win = 1.0 - rows[str(chosen)]["min_s"] / rows[str(fb[0])]["min_s"]
+                if win < _GEOMETRY_HYSTERESIS:
+                    log(
+                        f"tune: geometry keeping committed c={fb[0]} — "
+                        f"c={chosen} wins by {win:.1%} "
+                        f"(< {_GEOMETRY_HYSTERESIS:.0%} hysteresis)"
+                    )
+                    chosen = fb[0]
+            r = rows[str(chosen)]
+            geometry = {"c": chosen, "q": int(r["q"])}
+            tb = tables.pop(chosen)
+            best_table = (tb[0], tb[1], chosen, tb[2], tb[3])
+            tables.clear()  # free the losing candidates' tables
+
+    # ---------------------------------------------------------- columns
+    # the multi-column fixed kernel at the chosen geometry — the batch
+    # amortization curve (ratios, anchored; see module docstring)
+    amort: Optional[Dict[str, float]] = None
+    batch_columns: Optional[int] = None
+    if "columns" in arms and best_table is not None and left() > 0:
+        arms_run.append("columns")
+        table, table52, gc, gq, glev = best_table
+        p52 = _p(table52) if table52 is not None else None
+        col_times: Dict[int, float] = {}
+        for S in _COLUMN_CANDIDATES:
+            if left() <= 0:
+                truncated = True
+                break
+            scm = _scalar_cols(n, S)
+            outm = np.zeros((S, 8), dtype=np.uint64)
+            if S == 1:
+                col_times[S] = _min_of(
+                    lambda: lib.g1_msm_pippenger_fixed(
+                        _p(table), p52, _p(scm), n, n, glev, gc, gq,
+                        best_threads, _p(outm),
+                    ),
+                    reps,
+                )
+            else:
+                col_times[S] = _min_of(
+                    lambda: lib.g1_msm_pippenger_fixed_multi(
+                        _p(table), p52, _p(scm), n, n, S, glev, gc, gq,
+                        best_threads, _p(outm),
+                    ),
+                    reps,
+                )
+            log(f"tune: columns S={S} min={col_times[S]*1e3:.0f} ms")
+        sweep["columns"] = {str(s): v for s, v in col_times.items()}
+        if 1 in col_times and len(col_times) >= 2:
+            t1 = col_times[1]
+            anchor = DEFAULT_AMORT_POINTS[1]
+            pts = {s: anchor * t / t1 for s, t in sorted(col_times.items())}
+            # strictly increasing in both axes or the curve is unusable
+            vals = [pts[s] for s in sorted(pts)]
+            if all(b > a for a, b in zip(vals, vals[1:])):
+                amort = {str(s): round(v, 4) for s, v in pts.items()}
+            # best column efficiency = min per-column seconds
+            batch_columns = min(col_times, key=lambda s: col_times[s] / s)
+
+    # ----------------------------------------------------------- window
+    # variable-base evidence sweep around the committed curves — both
+    # tags, one step each side; recorded, not applied (the hand curves
+    # stay authoritative for the variable-base tiers)
+    if "window" in arms and left() > 0:
+        arms_run.append("window")
+        from ..field.bn254 import GLV_MAX_BITS
+        from ..prover.native_prove import (
+            _glv_consts,
+            _pick_window,
+            _pick_window_glv,
+        )
+
+        sc1 = _scalar_cols(n, 1)[0]
+        out = np.zeros(8, dtype=np.uint64)
+        win: Dict[str, Dict[str, float]] = {}
+        phi = np.zeros_like(bm)
+        lib.g1_glv_phi_bases(_p(bm), n, _p(_glv_consts()), _p(phi))
+        b2 = np.ascontiguousarray(np.concatenate([bm, phi]))
+        for tag, c0 in (
+            ("plain", _pick_window(n, threads=best_threads)),
+            ("glv", _pick_window_glv(n, threads=best_threads)),
+        ):
+            rows: Dict[str, float] = {}
+            for c in (c0 - 1, c0, c0 + 1):
+                if c < 4 or left() <= 0:
+                    truncated = truncated or left() <= 0
+                    continue
+                if tag == "glv":
+                    rows[str(c)] = _min_of(
+                        lambda: lib.g1_msm_pippenger_glv_mt(
+                            _p(b2), _p(sc1), n, n, c, best_threads,
+                            _p(_glv_consts()), GLV_MAX_BITS, _p(out),
+                        ),
+                        reps,
+                    )
+                else:
+                    rows[str(c)] = _min_of(
+                        lambda: lib.g1_msm_pippenger_mt(
+                            _p(bm), _p(sc1), n, c, best_threads, _p(out)
+                        ),
+                        reps,
+                    )
+                log(f"tune: window[{tag}] c={c} min={rows[str(c)]*1e3:.0f} ms")
+            win[tag] = rows
+        sweep["window"] = win
+
+    # ----------------------------------------------------------- ladder
+    # non-MSM floor at the resolved pool width — evidence rows only
+    if "ladder" in arms and left() > 0:
+        arms_run.append("ladder")
+        try:
+            sweep["ladder"] = _ladder_probe(lib, min(n, 1 << 14), reps, left)
+        except Exception as e:  # noqa: BLE001 — evidence, not a gate
+            log(f"tune: ladder probe failed ({e}); fallback rows kept")
+        if left() <= 0:
+            truncated = True
+
+    spent = time.perf_counter() - t_start
+    profile: Dict = {
+        "created_ts": round(time.time(), 3),
+        "topology": {
+            "logical_cpus": logical,
+            "physical_cores": physical,
+            "smt_per_core": int(fp["smt_per_core"]),
+        },
+        "cache": caches,
+        "threads": {
+            "native_default": int(best_threads),
+            "basis": "measured" if sweep.get("threads") else "physical_cores",
+        },
+        "tune": {
+            "budget_s": float(budget_s),
+            "spent_s": round(spent, 3),
+            "shape_n": int(n),
+            "reps": int(reps),
+            "arms_run": arms_run,
+            "truncated": truncated,
+            "ifma": 1 if ifma_available() else 0,
+            "sweep": sweep,
+        },
+    }
+    if geometry is not None:
+        from ..prover.precomp import G1_FAMILIES
+
+        profile["msm_fixed"] = {
+            "min_bl": GEOMETRY_MIN_BL,
+            "default": dict(geometry),
+            "families": {f: dict(geometry) for f in G1_FAMILIES},
+        }
+    if amort is not None:
+        profile["sched"] = {
+            "amort_points": amort,
+            "amort_basis": (
+                "msm-multi micro-arm ratios x the committed venmo "
+                f"single-prove anchor ({DEFAULT_AMORT_POINTS[1]} s); "
+                "observe_batch EWMA corrects absolute error online"
+            ),
+        }
+        if batch_columns is not None:
+            profile["sched"]["batch_columns"] = int(batch_columns)
+
+    path = save_profile(profile, out_path)
+    if path is None:
+        log("tune: profile persistence disabled (no cache dir) — not saved")
+    else:
+        log(
+            f"tune: profile saved to {path} "
+            f"({spent:.1f}s of {budget_s:.0f}s budget, "
+            f"{'TRUNCATED, ' if truncated else ''}arms: {','.join(arms_run)})"
+        )
+    return profile
+
+
+def _ladder_probe(lib, m: int, reps: int, left) -> Dict:
+    """One segmented-matvec + pooled-H-ladder measurement at domain m
+    (the msm_hwbench --ladder arms, budget-aware) — the profile's
+    non-MSM evidence rows."""
+    import ctypes
+
+    from ..field.bn254 import fr_domain_root
+    from ..prover import matvec_plan
+    from ..prover.native_prove import _n_threads, _p
+    from ..snark.groth16 import coset_gen
+
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i64p = ctypes.POINTER(ctypes.c_longlong)
+    log_m = m.bit_length() - 1
+    m = 1 << log_m
+    threads = _n_threads()
+    g = np.random.default_rng(17)
+
+    def rand_fr(k):
+        a = g.integers(0, 1 << 64, size=(k, 4), dtype=np.uint64)
+        a[:, 3] &= np.uint64((1 << 60) - 1)
+        return np.ascontiguousarray(a)
+
+    def mont(std):
+        out = np.zeros_like(std)
+        lib.fr_to_mont_batch(_p(std), _p(out), std.shape[0])
+        return out
+
+    out: Dict = {"m": m, "threads": threads}
+    nnz = 4 * m
+    coeff = mont(rand_fr(nnz))
+    wire = g.integers(0, m, size=nnz, dtype=np.uint32)
+    row = g.integers(0, m, size=nnz, dtype=np.uint32)
+    w_mont = mont(rand_fr(m))
+    cp, wp, _perm, seg_starts, seg_rows = matvec_plan._build(coeff, wire, row)
+    c52 = matvec_plan._pack52(lib, cp)
+    mv = np.zeros((m, 4), dtype=np.uint64)
+    if left() > 0:
+        out["matvec_seg_s"] = _min_of(
+            lambda: lib.fr_matvec_seg(
+                _p(c52) if c52 is not None else None, _p(cp),
+                wp.ctypes.data_as(u32p), seg_starts.ctypes.data_as(i64p),
+                seg_rows.ctypes.data_as(u32p), seg_rows.shape[0],
+                _p(w_mont), m, threads, _p(mv),
+            ),
+            reps,
+        )
+    if left() > 0:
+        wroot = np.ascontiguousarray(
+            np.frombuffer(int(fr_domain_root(log_m)).to_bytes(32, "little"), dtype="<u8")
+        )
+        gcos = np.ascontiguousarray(
+            np.frombuffer(int(coset_gen(log_m)).to_bytes(32, "little"), dtype="<u8")
+        )
+        base = mont(rand_fr(3 * m)).reshape(3, m, 4)
+        d = np.zeros((m, 4), dtype=np.uint64)
+
+        def run_ladder():
+            abc = [np.ascontiguousarray(base[i].copy()) for i in range(3)]
+            lib.fr_h_ladder(_p(abc[0]), _p(abc[1]), _p(abc[2]), m, _p(wroot), _p(gcos), _p(d))
+
+        out["h_ladder_s"] = _min_of(run_ladder, reps)
+    return out

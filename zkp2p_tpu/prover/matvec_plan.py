@@ -97,11 +97,27 @@ _PLAN_CACHE_CAP = 4
 _plan_lock = threading.Lock()
 _build_lock = threading.Lock()
 
+# live manifest of the newest resolution (the precomp._last_manifest
+# pattern) — the run-manifest hook reads this without touching the cache
+_last_manifest: Optional[Dict] = None
+
+
+def matvec_plan_manifest() -> Optional[Dict]:
+    """Manifest of the most recently resolved plan set (None until a
+    seg-armed prove ran): per-matrix shape + build-vs-cache provenance,
+    plus the worker-pool width the segment partition fanned out over at
+    resolve time (profile-aware via native_prove._n_threads) — so plan
+    cache hits and the tuned thread width are attributable in every
+    trace/bench artifact."""
+    return _last_manifest
+
 
 def reset() -> None:
-    """Drop memoized plans (tests)."""
+    """Drop memoized plans + manifest (tests)."""
+    global _last_manifest
     with _plan_lock:
         _plan_cache.clear()
+    _last_manifest = None
 
 
 def _source_arrays(dpk, matrix: str):
@@ -347,4 +363,22 @@ def plans_for(dpk) -> Optional[Dict[str, MatvecPlan]]:
             if len(_plan_cache) >= _PLAN_CACHE_CAP:
                 _plan_cache.pop(next(iter(_plan_cache)))
             _plan_cache[key] = (dpk, plans)
+        from .native_prove import _n_threads
+
+        global _last_manifest
+        _last_manifest = {
+            "matrices": {
+                m: {
+                    "nnz": int(p.coeff.shape[0]),
+                    "nseg": p.nseg,
+                    "ifma52": p.coeff52 is not None,
+                    "source": p.source,
+                    "key_hash": p.key_hash,
+                }
+                for m, p in plans.items()
+            },
+            # the pool width fr_matvec_seg partitions segments over —
+            # profile-aware (tuned physical-core default) via _n_threads
+            "threads": _n_threads(),
+        }
         return plans

@@ -477,15 +477,22 @@ def _pick_window_multi(n: int, S: int, threads: int, glv: bool) -> int:
 
 def _n_threads() -> int:
     """MSM worker threads: the typed config's native_threads
-    (ZKP2P_NATIVE_THREADS), else the core count — the parallel axis is
+    (ZKP2P_NATIVE_THREADS) always wins; unset, the tuned host profile's
+    topology-aware default applies when one is loaded (physical cores,
+    not SMT siblings — the measured-best width from `zkp2p-tpu tune`);
+    else the logical core count as before — the parallel axis is
     per-window (rapidsnark's split); on the 1-core build host this
     resolves to 1 and the code path stays sequential."""
     import os
 
     from ..utils.config import load_config
+    from ..utils.hostprof import tuned_threads
 
     v = load_config().native_threads
-    return v if v else max(1, os.cpu_count() or 1)
+    if v:
+        return v
+    t = tuned_threads()  # records the host_profile gate
+    return t if t else max(1, os.cpu_count() or 1)
 
 
 def _run_matvecs(lib, dpk, w_mont: np.ndarray, m: int, threads: int, a_ev, b_ev, plans):

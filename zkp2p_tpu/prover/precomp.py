@@ -94,27 +94,57 @@ def _pick_window_fixed(n: int, threads: int = 1) -> int:
 def _resolve_geometry(
     n: int, depth: int, budget_bytes: int
 ) -> Optional[Tuple[int, int, int]]:
-    """(c, q, levels) for a family of n points under the RAM budget, or
-    None when even a one-level table does not fit.  Depth caps levels;
-    q = ceil(W / levels) keeps levels * q >= W (the csrc cover bound).
-    Resident cost per row: mont256 64 B, plus the Aff52 80 B only where
-    the IFMA tier will actually keep a 52-limb form — charging 144 B on
-    a scalar-tier host would shallow or skip families at 2.25x their
-    real footprint."""
+    """(c, q, levels) for a family of n points under the RAM budget
+    from the HAND-PICKED constants only — the documented fallback arm
+    (c=16 at sweep scale, q from depth, i.e. c16/q2/L8 at the default
+    depth 8) and the pinned oracle the parity tests compare against.
+    Never profile-driven; the prove path resolves through
+    `_resolve_geometry_prof` instead."""
+    g = _resolve_geometry_prof(n, depth, budget_bytes, family="", use_profile=False)
+    return None if g is None else g[:3]
+
+
+def _resolve_geometry_prof(
+    n: int, depth: int, budget_bytes: int, family: str, use_profile: bool = True
+) -> Optional[Tuple[int, int, int, str]]:
+    """(c, q, levels, source) for a family of n points under the RAM
+    budget, or None when even a one-level table does not fit.  The
+    window c (and optionally the hot-loop stride q) come from the tuned
+    host profile when one is loaded for THIS hardware (source
+    "profile"); otherwise the hand-picked constants apply (source
+    "fallback").  Depth caps levels; q = ceil(W / levels) keeps
+    levels * q >= W (the csrc cover bound), and a profile q may only
+    widen the hot loop (shallower table), never deepen past the depth
+    cap.  Resident cost per row: mont256 64 B, plus the Aff52 80 B only
+    where the IFMA tier will actually keep a 52-limb form — charging
+    144 B on a scalar-tier host would shallow or skip families at
+    2.25x their real footprint."""
     from ..native.lib import ifma_available
 
     row_bytes = 144 if ifma_available() else 64
+    source = "fallback"
     c = _pick_window_fixed(n)
+    tuned_q: Optional[int] = None
+    if use_profile:
+        from ..utils.hostprof import geometry_for
+
+        tuned = geometry_for(family, n)
+        if tuned is not None:
+            source = "profile"
+            c = int(tuned["c"])
+            tuned_q = tuned.get("q")
     W = fixed_nwin(c)
     levels = max(1, min(depth, W))
     q = (W + levels - 1) // levels
+    if tuned_q is not None:
+        q = max(q, int(tuned_q))
     levels = (W + q - 1) // q
     while levels > 1 and (levels * n) * row_bytes > budget_bytes:
         q += 1
         levels = (W + q - 1) // q
     if (levels * n) * row_bytes > budget_bytes:
         return None
-    return c, q, levels
+    return c, q, levels, source
 
 
 @dataclass
@@ -130,6 +160,7 @@ class FamilyTable:
     q: int
     source: str  # "built" | "cache"
     key_hash: str
+    geometry_source: str = "fallback"  # "profile" | "fallback"
 
     @property
     def nbytes(self) -> int:
@@ -165,6 +196,7 @@ class PrecomputedKey:
                     "bytes": f.nbytes,
                     "ifma52": f.table52 is not None,
                     "source": f.source,
+                    "geometry_source": f.geometry_source,
                     "key_hash": f.key_hash,
                 }
                 for name, f in self.families.items()
@@ -346,7 +378,9 @@ def _persist_table(path: str, table: np.ndarray) -> None:
             pass
 
 
-def _build_family(lib, dpk, family: str, geom, cache_dir, threads: int) -> FamilyTable:
+def _build_family(
+    lib, dpk, family: str, geom, cache_dir, threads: int, geometry_source: str = "fallback"
+) -> FamilyTable:
     from ..utils.trace import trace
 
     c, q, levels = geom
@@ -400,7 +434,7 @@ def _build_family(lib, dpk, family: str, geom, cache_dir, threads: int) -> Famil
         table52 = None
     return FamilyTable(
         family=family, table=table, table52=table52, n=n, levels=levels,
-        c=c, q=q, source=source, key_hash=kh,
+        c=c, q=q, source=source, key_hash=kh, geometry_source=geometry_source,
     )
 
 
@@ -458,11 +492,13 @@ def _resolve(lib, dpk, key: int) -> PrecomputedKey:
         if n == 0:
             skipped[family] = "empty"
             continue
-        geom = _resolve_geometry(n, int(cfg.precomp_depth), budget)
+        geom = _resolve_geometry_prof(n, int(cfg.precomp_depth), budget, family)
         if geom is None:
             skipped[family] = "budget"
             continue
-        ft = _build_family(lib, dpk, family, geom, cache_dir, threads)
+        ft = _build_family(
+            lib, dpk, family, geom[:3], cache_dir, threads, geometry_source=geom[3]
+        )
         families[family] = ft
         budget -= ft.nbytes
 

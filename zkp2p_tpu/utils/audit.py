@@ -407,6 +407,14 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
 
     sched_arm()
 
+    # host-profile gate (utils.hostprof): off | tuned | fallback — a run
+    # steered by a tune-produced profile (geometry, thread default,
+    # seeded amortization) must never share a digest with a
+    # hand-picked-constants run
+    from .hostprof import profile_arm
+
+    profile_arm()
+
     if workload and backend != "unavailable":
         # one tiny jitted op: proves the backend executes and ticks the
         # compile listener.  Deliberately NOT a gated field mul — a

@@ -343,6 +343,19 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "alert_for_s": ("ZKP2P_ALERT_FOR_S", _nonneg_float(5.0), 5.0),
     "alert_clear_s": ("ZKP2P_ALERT_CLEAR_S", _nonneg_float(30.0), 30.0),
     "alert_hb_gap_s": ("ZKP2P_ALERT_HB_GAP_S", _nonneg_float(15.0), 15.0),
+    # host auto-tune profile (utils.hostprof + pipeline.tune;
+    # docs/TUNING.md §Host profiles): the profile-load gate ("0" =
+    # ignore any profile on disk — the hand-picked-constants oracle arm
+    # for tuned-vs-fallback A/Bs), an explicit profile path override
+    # ("" = <precomp cache dir>/host_profile_<fingerprint>.json beside
+    # .bench_cache; a copied profile whose embedded fingerprint doesn't
+    # match this host is REJECTED, never loaded), the `zkp2p-tpu tune`
+    # sweep's wall-clock budget in seconds, and a comma filter over the
+    # sweep arms ("" = all of threads,ladder,window,geometry,columns).
+    "profile": ("ZKP2P_PROFILE", _not_zero, True),
+    "profile_path": ("ZKP2P_PROFILE_PATH", str, ""),
+    "tune_budget_s": ("ZKP2P_TUNE_BUDGET_S", _nonneg_float(120.0), 120.0),
+    "tune_arms": ("ZKP2P_TUNE_ARMS", str, ""),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -350,6 +363,7 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 ARMABLE = (
     "msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap",
     "msm_multi", "msm_precomp", "matvec_seg", "ntt_pool", "sched",
+    "profile",
 )
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
@@ -420,6 +434,10 @@ class ProverConfig:
     alert_for_s: float = 5.0
     alert_clear_s: float = 30.0
     alert_hb_gap_s: float = 15.0
+    profile: bool = True
+    profile_path: str = ""
+    tune_budget_s: float = 120.0
+    tune_arms: str = ""
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 

@@ -390,6 +390,19 @@ def run_manifest() -> Dict:
 
     cfg = load_config()
     knobs = serialize_knobs(cfg)
+    # host auto-tune profile (utils.hostprof): which arm resolved (off |
+    # tuned | fallback), from which path, under which hardware
+    # fingerprint — so a tuned-vs-fallback A/B is attributable from the
+    # artifact alone, matching the precomp rows' geometry_source.
+    # Resolved BEFORE the gate snapshot: profile_manifest() records the
+    # host_profile arm, and the gates/digest below must include it.
+    host_profile = None
+    try:
+        from .hostprof import profile_manifest
+
+        host_profile = profile_manifest()
+    except Exception:  # noqa: BLE001 — attribution must not break a dump
+        pass
     man = {
         "run_id": run_id(),
         "pid": os.getpid(),
@@ -434,6 +447,18 @@ def run_manifest() -> Dict:
             man["circuit_audits"] = am
     except Exception:  # noqa: BLE001 — attribution must not break a dump
         pass
+    # segmented matvec plans (prover.matvec_plan): per-matrix shape +
+    # provenance + the pool width the segment partition used
+    try:
+        from ..prover.matvec_plan import matvec_plan_manifest
+
+        mm = matvec_plan_manifest()
+        if mm is not None:
+            man["matvec_plans"] = mm
+    except Exception:  # noqa: BLE001 — attribution must not break a dump
+        pass
+    if host_profile is not None:
+        man["host_profile"] = host_profile
     return man
 
 
