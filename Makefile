@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke tpu-shard-smoke warm-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -147,6 +147,27 @@ sched-smoke: native
 # tiny-shape budgeted sweep end to end.  ~5 s on the 1-core box.
 tune-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_tune.py -q
+
+# Sharded-TPU-arm smoke (tier-1 resident; docs/TPU.md): the pjit
+# batch-axis prover on the 8-virtual-device CPU mesh — toy-circuit
+# byte parity (single + batch) vs the native-loop oracle under pinned
+# (r, s), per-device bucket partial sums vs the unsharded arm, mesh-spec
+# parsing + fallback arming, warm-cache round-trip with the >=10x
+# second-run compile-span assertion, and heterogeneous-tier routing
+# units.  Rides the persistent .jax_cache (run `make warm-cache` first
+# on a cold checkout); ~1 min warm on the 1-core box.
+tpu-shard-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_tpu_shard.py -q
+
+# Pre-compile the batch prover (sharded arm included) into the
+# persistent .jax_cache — the XLA analog of `make precomp-cache`: a
+# cold pod-MSM shard_map executable compiles for MINUTES on a 1-core
+# host, a warm one loads in milliseconds.  Run before a driver/bench
+# window or a cold `make tpu-shard-smoke`.
+warm-cache:
+	env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+	  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -m zkp2p_tpu --circuit toy warm-cache --shard 2x4 --batch 4
 
 # The full fleet acceptance (slow): N=3 supervised workers, seeded
 # faults, worker SIGKILL + worker SIGTERM drain + supervisor

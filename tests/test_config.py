@@ -98,6 +98,10 @@ def test_env_overrides_every_knob():
         "ZKP2P_PROFILE_PATH": "/tmp/prof.json",
         "ZKP2P_TUNE_BUDGET_S": "45",
         "ZKP2P_TUNE_ARMS": "geometry,columns",
+        "ZKP2P_TPU_SHARD": "on",
+        "ZKP2P_TPU_MESH": "2x4",
+        "ZKP2P_JAX_CACHE_DIR": "/tmp/jaxcache",
+        "ZKP2P_WORKER_TIER": "sharded",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -138,6 +142,9 @@ def test_env_overrides_every_knob():
     assert cfg.scale_up_s == 12.0 and cfg.scale_down_s == 45.0
     assert cfg.profile is False and cfg.profile_path == "/tmp/prof.json"
     assert cfg.tune_budget_s == 45.0 and cfg.tune_arms == "geometry,columns"
+    assert cfg.tpu_shard == "on" and cfg.tpu_mesh == "2x4"
+    assert cfg.jax_cache_dir == "/tmp/jaxcache"
+    assert cfg.worker_tier == "sharded"
     assert all(v == "env" for v in cfg.provenance.values())
 
 

@@ -1,0 +1,631 @@
+"""The sharded TPU arm (`make tpu-shard-smoke`; docs/TPU.md).
+
+Tier-1 resident: pjit batch-axis prove parity on the 8-virtual-device
+CPU mesh (toy circuit, byte-identical to the host oracle under pinned
+(r, s)), the `tpu_shard` gate grammar + fallback arming, the
+ZKP2P_TPU_* knob registry, the warm-start compile-cache round-trip
+(>=10x second-run compile span, asserted via the jax.monitoring
+backend_compile listener in subprocess pairs), and the heterogeneous
+worker-tier routing units + the mixed-tier toy fleet A/B under the
+chaos zero-lost/zero-duplicate invariant.
+
+The parity tests dispatch REAL pod-mesh executables: cold, one
+shard_map MSM compiles for minutes on a 1-core host, so they ride the
+persistent .jax_cache (tests/conftest.py points every test at it) and
+SKIP with a pointer at `make warm-cache` when the pod entries are
+absent — the budget rule that keeps tier-1 minutes, not hours.  The
+per-device bucket partial-sum check lives in the slow tier
+(ZKP2P_RUN_SLOW=1) for the same reason: its diagnostic program is a
+different executable than the prover's, so it can never be pre-warmed
+by a production warm-cache run.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from zkp2p_tpu.field.bn254 import R
+from zkp2p_tpu.pipeline.sched import (
+    AmortModel,
+    BatchController,
+    DEFAULT_SHARDED_AMORT_POINTS,
+    SchedRequest,
+    normalize_tier,
+    worker_tier_arm,
+)
+from zkp2p_tpu.snark.r1cs import LC, ConstraintSystem
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS = os.path.join(REPO, "tools", "chaos.py")
+
+
+# ------------------------------------------------------------ mesh grammar
+
+
+def test_mesh_spec_grammar():
+    from zkp2p_tpu.prover.groth16_tpu import _parse_mesh_spec
+
+    assert _parse_mesh_spec("", 8) == (1, 8)  # auto: all devices on the shard axis
+    assert _parse_mesh_spec("4", 8) == (1, 4)  # bare int = 1xN
+    assert _parse_mesh_spec("2x4", 8) == (2, 4)
+    assert _parse_mesh_spec(" 2X4 ", 8) == (2, 4)  # case/space tolerant
+    # malformed or non-positive fails CLOSED (None -> vmap arm)
+    assert _parse_mesh_spec("0x4", 8) is None
+    assert _parse_mesh_spec("2x-1", 8) is None
+    assert _parse_mesh_spec("ax2", 8) is None
+    assert _parse_mesh_spec("2x", 8) is None
+
+
+def test_shard_mesh_gate_grammar_and_digest(monkeypatch):
+    from zkp2p_tpu.prover import groth16_tpu as G
+    from zkp2p_tpu.utils.audit import execution_digest, gate_arms
+
+    monkeypatch.delenv("ZKP2P_TPU_SHARD", raising=False)
+    monkeypatch.delenv("ZKP2P_TPU_MESH", raising=False)
+    assert G._shard_mesh() is None
+    assert gate_arms()["tpu_shard"] == "off"
+    d_off = execution_digest()
+
+    # anything but the literal "on" fails closed
+    monkeypatch.setenv("ZKP2P_TPU_SHARD", "yes")
+    assert G._shard_mesh() is None and gate_arms()["tpu_shard"] == "off"
+
+    # on + unsatisfiable/malformed mesh: an on-record disarm
+    monkeypatch.setenv("ZKP2P_TPU_SHARD", "on")
+    monkeypatch.setenv("ZKP2P_TPU_MESH", "junk")
+    assert G._shard_mesh() is None and gate_arms()["tpu_shard"] == "off"
+    monkeypatch.setenv("ZKP2P_TPU_MESH", "4x4")  # 16 > the 8 virtual devices
+    assert G._shard_mesh() is None and gate_arms()["tpu_shard"] == "off"
+
+    monkeypatch.setenv("ZKP2P_TPU_MESH", "2x4")
+    mesh = G._shard_mesh()
+    assert mesh is not None
+    assert dict(mesh.shape) == {"batch": 2, "shard": 4}
+    assert gate_arms()["tpu_shard"] == "2x4"
+    # a sharded prove must never share a digest with the vmap arm
+    assert execution_digest() != d_off
+    # mesh instances are memoised per shape (the shard_map executable
+    # cache keys on the instance)
+    assert G._shard_mesh() is mesh
+
+    # restore the off arm for later tests in this process
+    monkeypatch.setenv("ZKP2P_TPU_SHARD", "off")
+    assert G._shard_mesh() is None
+
+
+# --------------------------------------------------- arm selection (stubbed)
+
+
+def build_toy():
+    cs = ConstraintSystem("toy")
+    out = cs.new_public("out")
+    x = cs.new_wire("x")
+    y = cs.new_wire("y")
+    z = cs.new_wire("z")
+    cs.enforce(LC.of(x), LC.of(y), LC.of(z), "mul")
+    cs.enforce(LC.of(z), LC.of(z), LC.of(out), "sq")
+    cs.compute(z, lambda a, b: a * b % R, [x, y])
+    return cs, out, x, y
+
+
+def _toy_wits(cs, x, y, cases):
+    wits, pubs = [], []
+    for a, b in cases:
+        o = pow(a * b % R, 2, R)
+        wits.append(cs.witness([o], {x: a, y: b}))
+        pubs.append([o])
+    return wits, pubs
+
+
+@pytest.fixture(scope="module")
+def toy_keys():
+    from zkp2p_tpu.prover import device_pk
+    from zkp2p_tpu.snark.groth16 import setup
+
+    cs, out, x, y = build_toy()
+    pk, vk = setup(cs)
+    return cs, pk, vk, device_pk(pk, cs), x, y
+
+
+class _ArmTaken(Exception):
+    def __init__(self, arm):
+        self.arm = arm
+
+
+def test_batch_arm_selection_and_fallback(toy_keys, monkeypatch):
+    """The per-call arm decision WITHOUT paying a compile: both prove
+    arms stubbed to raise, so the test observes which one prove_tpu_batch
+    dispatched and which `tpu_shard` arm it recorded."""
+    from zkp2p_tpu.prover import groth16_tpu as G
+    from zkp2p_tpu.utils.audit import gate_arms
+
+    cs, _pk, _vk, dpk, x, y = toy_keys
+    wits, _ = _toy_wits(cs, x, y, [(3, 5), (2, 7), (10, 11), (1, 1)])
+
+    monkeypatch.setattr(
+        G, "_prove_batch_sharded", lambda *a, **k: (_ for _ in ()).throw(_ArmTaken("sharded"))
+    )
+    monkeypatch.setattr(
+        G, "_prove_device", lambda *a, **k: (_ for _ in ()).throw(_ArmTaken("vmap"))
+    )
+
+    def arm_for(n_wits, shard, mesh_spec):
+        monkeypatch.setenv("ZKP2P_TPU_SHARD", shard)
+        monkeypatch.setenv("ZKP2P_TPU_MESH", mesh_spec)
+        with pytest.raises(_ArmTaken) as e:
+            G.prove_tpu_batch(dpk, wits[:n_wits])
+        return e.value.arm, gate_arms()["tpu_shard"]
+
+    # knob off: the vmap arm, digest-visible as "off"
+    assert arm_for(4, "off", "2x4") == ("vmap", "off")
+    # on + divisible batch: the sharded arm with the resolved shape
+    assert arm_for(4, "on", "2x4") == ("sharded", "2x4")
+    assert arm_for(3, "on", "1x4") == ("sharded", "1x4")  # B=1 divides anything
+    # on + indivisible batch (3 % 2): fallback recorded, vmap dispatched
+    assert arm_for(3, "on", "2x4") == ("vmap", "fallback")
+
+
+# ----------------------------------------------------------- byte parity
+
+_POD_CACHE_HINTS = ("jit_local", "jit_msm_pod", "shard_map")
+
+
+def _pod_cache_ready() -> bool:
+    """True when the persistent cache holds the pod-mesh executables (a
+    `make warm-cache` ran on this checkout) — the gate that keeps the
+    parity tests out of a COLD tier-1 run, where one shard_map MSM
+    compiles for minutes on a 1-core host."""
+    if os.environ.get("ZKP2P_NO_CACHE") == "1":
+        return False
+    from zkp2p_tpu.utils.jaxcfg import cache_dir
+
+    try:
+        names = os.listdir(cache_dir())
+    except OSError:
+        return False
+    return any(n.startswith(_POD_CACHE_HINTS) and n.endswith("-cache") for n in names)
+
+
+needs_warm_cache = pytest.mark.skipif(
+    not _pod_cache_ready(),
+    reason="pod-mesh executables not in the persistent cache — run `make warm-cache` "
+    "(cold shard_map compiles take minutes; docs/TPU.md §warm-start)",
+)
+
+
+class _PinnedSecrets:
+    """Deterministic stand-in for the secrets module: prove_tpu_batch
+    draws r, s per proof as 1 + randbelow(R - 1) -> the pinned sequence
+    1000, 1001, 1002, ... so the host oracle can replay them."""
+
+    def __init__(self, start=1000):
+        self._it = iter(range(start, start + 10_000))
+
+    def randbelow(self, _n):
+        return next(self._it) - 1
+
+
+@needs_warm_cache
+def test_sharded_batch_matches_host_oracle(toy_keys, monkeypatch):
+    """THE acceptance: ZKP2P_TPU_SHARD=on on the 2x4 virtual pod mesh,
+    batch of 4 -> every proof byte-identical to prove_host under the
+    same (witness, r, s), and pairing-verified.  Covers the batch case
+    AND the single case (a 1-witness call pads to the mesh batch width
+    is NOT done — B=2 groups need 2+ witnesses, so single rides a
+    (1x4) mesh)."""
+    from zkp2p_tpu.prover import groth16_tpu as G
+    from zkp2p_tpu.snark.groth16 import prove_host, verify
+    from zkp2p_tpu.utils.audit import gate_arms
+
+    cs, pk, vk, dpk, x, y = toy_keys
+    cases = [(3, 5), (2, 7), (10, 11), (1, 1)]
+    wits, pubs = _toy_wits(cs, x, y, cases)
+
+    monkeypatch.setenv("ZKP2P_TPU_SHARD", "on")
+    monkeypatch.setenv("ZKP2P_TPU_MESH", "2x4")
+    monkeypatch.setattr(G, "secrets", _PinnedSecrets())
+    proofs = G.prove_tpu_batch(dpk, wits)
+    assert gate_arms()["tpu_shard"] == "2x4"
+    for i, (proof, pub) in enumerate(zip(proofs, pubs)):
+        r, s = 1000 + 2 * i, 1001 + 2 * i
+        assert proof == prove_host(pk, cs, wits[i], r=r, s=s), f"proof {i} != oracle"
+        assert verify(vk, proof, pub)
+
+
+@needs_warm_cache
+def test_sharded_single_matches_host_oracle(toy_keys, monkeypatch):
+    """Single-witness parity on a base-axis-only (1x4) mesh."""
+    from zkp2p_tpu.prover import groth16_tpu as G
+    from zkp2p_tpu.snark.groth16 import prove_host, verify
+    from zkp2p_tpu.utils.audit import gate_arms
+
+    cs, pk, vk, dpk, x, y = toy_keys
+    wits, pubs = _toy_wits(cs, x, y, [(6, 7)])
+    monkeypatch.setenv("ZKP2P_TPU_SHARD", "on")
+    monkeypatch.setenv("ZKP2P_TPU_MESH", "1x4")
+    monkeypatch.setattr(G, "secrets", _PinnedSecrets())
+    (proof,) = G.prove_tpu_batch(dpk, wits)
+    assert gate_arms()["tpu_shard"] == "1x4"
+    assert proof == prove_host(pk, cs, wits[0], r=1000, s=1001)
+    assert verify(vk, proof, pubs[0])
+
+
+@pytest.mark.slow
+@pytest.mark.xslow
+def test_per_device_bucket_partials_match_unsharded():
+    """The allreduce layout claim (docs/TPU.md): each shard-axis
+    device's bucket accumulation covers ONLY its base slice, and the
+    psum fold is a pure group-op combine — so per-slice host MSMs over
+    the same slicing, group-added, must equal both the unsharded host
+    oracle and the pod-mesh device result.  Slow tier with the rest of
+    the mesh tests (XLA-compile-heavy on a 1-core host)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_add, g1_msm, g1_mul
+    from zkp2p_tpu.curve.jcurve import G1J, g1_jac_to_host, g1_to_affine_arrays
+    from zkp2p_tpu.field.jfield import int_to_limbs
+    from zkp2p_tpu.ops import msm as jmsm
+    from zkp2p_tpu.parallel.mesh import make_pod_mesh, msm_pod_batched, pad_to_multiple
+
+    n_ici, lanes, window = 4, 2, 4
+    rng = np.random.default_rng(11)
+    n = 16  # a multiple of n_ici * lanes: slice boundaries == device slices
+    pts = [g1_mul(G1_GENERATOR, int(k)) for k in rng.integers(1, 2**62, n)]
+    batch_scalars = [[int(s) for s in rng.integers(1, 2**62, n)] for _ in range(2)]
+
+    # per-device partial sums, host-computed over each device's base
+    # slice, folded with plain group addition
+    per = n // n_ici
+    for row in batch_scalars:
+        partials = [
+            g1_msm(pts[d * per : (d + 1) * per], row[d * per : (d + 1) * per])
+            for d in range(n_ici)
+        ]
+        folded = None
+        for p in partials:
+            folded = g1_add(folded, p) if folded is not None else p
+        assert folded == g1_msm(pts, row)
+
+    # the pod-mesh executable agrees with the same oracle
+    mesh = make_pod_mesh(2, n_ici)
+    planes = jnp.stack(
+        [
+            jmsm.digit_planes_from_limbs(
+                jnp.asarray(np.stack([int_to_limbs(s) for s in row])), window
+            )
+            for row in batch_scalars
+        ]
+    )
+    bases, _ = pad_to_multiple(g1_to_affine_arrays(pts), planes[0], n_ici * lanes)
+    acc = msm_pod_batched(G1J, bases, planes, mesh, lanes=lanes, window=window)
+    got = g1_jac_to_host(acc)
+    for i, row in enumerate(batch_scalars):
+        assert got[i] == g1_msm(pts, row), f"batch element {i}"
+
+
+# ------------------------------------------------- warm-start compile cache
+
+_PROBE = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("ZKP2P_NO_CACHE", None)
+os.environ["ZKP2P_JAX_CACHE_DIR"] = sys.argv[1]
+sys.path.insert(0, sys.argv[2])
+from zkp2p_tpu.utils.jaxcfg import cache_dir, enable_cache
+enable_cache(min_compile_s=0.0)
+assert cache_dir().startswith(sys.argv[1])  # the knob steers the root
+import jax, jax.numpy as jnp
+comp = []
+jax.monitoring.register_event_duration_secs_listener(
+    lambda name, dur, **kw: comp.append(dur) if name.endswith("backend_compile_duration") else None)
+def ladder(x):
+    for _ in range(10):
+        x = jnp.tanh(x @ x.T) + jnp.sin(x) * jnp.cos(x)
+    return x.sum()
+jax.jit(ladder)(jnp.ones((256, 256))).block_until_ready()
+print("COMPILE_S", sum(comp), len(comp))
+"""
+
+
+def _probe_compile_s(cache_root: str) -> float:
+    env = {k: v for k, v in os.environ.items() if k != "ZKP2P_NO_CACHE"}
+    out = subprocess.run(
+        [sys.executable, "-c", _PROBE, cache_root, REPO],
+        capture_output=True, text=True, timeout=300, env=env, check=True,
+    ).stdout
+    line = [ln for ln in out.splitlines() if ln.startswith("COMPILE_S")][0]
+    _tag, secs, n_events = line.split()
+    assert int(n_events) > 0  # the listener saw the compile either way
+    return float(secs)
+
+
+def test_warm_cache_roundtrip_10x(tmp_path):
+    """Cold subprocess compiles + persists into a fresh
+    ZKP2P_JAX_CACHE_DIR; a second subprocess on the same root must spend
+    >=10x less in backend_compile — the warm-start contract the
+    warm-cache command exists to establish (measured on compile-event
+    seconds, the same zkp2p_compile_seconds_total rail the service
+    publishes)."""
+    root = str(tmp_path / "cache")
+    cold_s = _probe_compile_s(root)
+    # the cold run left entries behind (round-trip evidence, not a no-op)
+    entries = [
+        fn for _r, _d, fns in os.walk(root) for fn in fns if fn.endswith("-cache")
+    ]
+    assert entries, "cold run persisted no cache entries"
+    warm_s = _probe_compile_s(root)
+    assert warm_s > 0.0
+    assert cold_s >= 10.0 * warm_s, (
+        f"warm-start speedup {cold_s / warm_s:.1f}x < 10x (cold {cold_s:.3f}s, warm {warm_s:.3f}s)"
+    )
+
+
+# --------------------------------------------------- heterogeneous tiers
+
+AMORT = "1:0.9,2:1.2,4:1.8,8:3.0"
+
+
+def _ctl(tier="native", objective=8.0):
+    c = BatchController(AmortModel.from_spec(AMORT), objective_s=objective, tier=tier)
+    c.observe_batch(1, 0.9)  # end warm-up: predictions run on the curve
+    return c
+
+
+def _mixed_reqs(now, n_bulk=4, n_int=2):
+    reqs = [
+        SchedRequest(rid=f"b{i:02d}", t_submit=now - 1.0 + i * 1e-3,
+                     deadline=now + 8.0, interactive=False)
+        for i in range(n_bulk)
+    ]
+    reqs += [
+        SchedRequest(rid=f"i{i:02d}", t_submit=now - 0.5 + i * 1e-3,
+                     deadline=now + 8.0, interactive=True)
+        for i in range(n_int)
+    ]
+    return reqs
+
+
+def test_normalize_tier_fails_closed():
+    assert normalize_tier("sharded") == "sharded"
+    for junk in ("", "native", "SHARDED", "tpu", "mesh"):
+        assert normalize_tier(junk) == "native"
+
+
+def test_worker_tier_arm_digest_visible(monkeypatch):
+    from zkp2p_tpu.utils.audit import execution_digest
+
+    monkeypatch.delenv("ZKP2P_WORKER_TIER", raising=False)
+    assert worker_tier_arm() == "native"
+    d_native = execution_digest()
+    monkeypatch.setenv("ZKP2P_WORKER_TIER", "sharded")
+    assert worker_tier_arm() == "sharded"
+    assert execution_digest() != d_native
+    monkeypatch.setenv("ZKP2P_WORKER_TIER", "native")
+    worker_tier_arm()
+    assert execution_digest() == d_native
+
+
+def test_native_defers_bulk_to_sharded_peer():
+    """Bulk-lane wide batches prefer the sharded tier: with a live
+    sharded peer the native worker's plan serves ONLY interactive; the
+    bulk lane stays in the spool (deferred, never shed)."""
+    c = _ctl("native")
+    now = 1000.0
+    plan = c.plan(now, _mixed_reqs(now), cap=8, peer_tiers=["sharded"])
+    assert plan.tier == "native"
+    assert plan.deferred == {"bulk": 4}
+    served = [r.rid for b in plan.batches for r in b]
+    assert served == ["i00", "i01"]
+    assert plan.shed == []  # deferred bulk is the peer's, never shed here
+    assert plan.lanes.get("bulk", 0) == 0
+
+
+def test_deferred_bulk_never_shed_even_when_hopeless():
+    """A doomed bulk request next to a live sharded peer is DEFERRED,
+    not shed: the peer's own shed walk owns its deadline."""
+    c = _ctl("native")
+    now = 1000.0
+    reqs = [SchedRequest(rid="doomed", t_submit=now - 50.0, deadline=now - 1.0,
+                         interactive=False)]
+    plan = c.plan(now, reqs, cap=8, peer_tiers=["sharded"])
+    assert plan.shed == [] and plan.deferred == {"bulk": 1}
+    # without the peer the same request IS shed (the baseline behavior)
+    c2 = _ctl("native")
+    plan2 = c2.plan(now, list(reqs), cap=8, peer_tiers=[])
+    assert [r.rid for r, _why in plan2.shed] == ["doomed"]
+
+
+def test_sharded_defers_interactive_to_native_peer():
+    """The interactive lane never waits on a sharded-tier dispatch: with
+    a live native peer the sharded worker's plan serves ONLY bulk."""
+    c = _ctl("sharded")
+    now = 1000.0
+    plan = c.plan(now, _mixed_reqs(now), cap=8, peer_tiers=["native"])
+    assert plan.tier == "sharded"
+    assert plan.deferred == {"interactive": 2}
+    served = [r.rid for b in plan.batches for r in b]
+    assert served == ["b00", "b01", "b02", "b03"]
+    assert plan.lanes.get("interactive", 0) == 0
+
+
+def test_solo_worker_serves_both_lanes():
+    """No starvation when the fleet degrades to one tier: without a
+    peer of the other tier, either tier serves everything."""
+    now = 1000.0
+    for tier, peers in (("native", []), ("native", ["native"]),
+                        ("sharded", []), ("sharded", ["sharded"]), ("native", None)):
+        c = _ctl(tier)
+        plan = c.plan(now, _mixed_reqs(now), cap=8, peer_tiers=peers)
+        assert plan.deferred == {}, (tier, peers)
+        assert sum(len(b) for b in plan.batches) == 6, (tier, peers)
+
+
+def test_tier_loss_degrades_to_native_with_counted_event():
+    """A sharded peer vanishing while bulk is queued fires tier_fallback
+    exactly ONCE per loss; the native worker resumes the bulk lane."""
+    c = _ctl("native")
+    now = 1000.0
+    plan = c.plan(now, _mixed_reqs(now), cap=8, peer_tiers=["sharded"])
+    assert plan.deferred == {"bulk": 4} and not plan.tier_fallback
+    # peer gone, bulk queued: fallback flagged, bulk served again
+    plan2 = c.plan(now + 5.0, _mixed_reqs(now + 5.0), cap=8, peer_tiers=[])
+    assert plan2.tier_fallback
+    assert plan2.deferred == {}
+    assert sum(len(b) for b in plan2.batches) == 6
+    # once per loss, not once per sweep
+    plan3 = c.plan(now + 10.0, _mixed_reqs(now + 10.0), cap=8, peer_tiers=[])
+    assert not plan3.tier_fallback
+    # peer back then lost again during IDLE: the edge must not fire a
+    # stale fallback on the next busy sweep
+    c.plan(now + 15.0, [], cap=8, peer_tiers=["sharded"])
+    c.plan(now + 20.0, [], cap=8, peer_tiers=[])
+    plan4 = c.plan(now + 25.0, _mixed_reqs(now + 25.0), cap=8, peer_tiers=[])
+    assert not plan4.tier_fallback
+
+
+def test_build_controller_resolves_per_tier_amort(monkeypatch):
+    """ZKP2P_WORKER_TIER=sharded + no explicit spec + no profile ->
+    DEFAULT_SHARDED_AMORT_POINTS (heavy dispatch floor, hard wide-batch
+    amortization); native keeps the venmo default; an explicit
+    ZKP2P_SCHED_AMORT wins for either tier."""
+    from zkp2p_tpu.pipeline.sched import DEFAULT_AMORT_POINTS, build_controller
+    from zkp2p_tpu.utils.config import load_config
+
+    # a REAL host profile on this box would seed the curve — isolate it
+    monkeypatch.setenv("ZKP2P_PROFILE_PATH", "/nonexistent/no-profile.json")
+
+    cfg = load_config(environ={"ZKP2P_WORKER_TIER": "sharded"})
+    monkeypatch.setenv("ZKP2P_WORKER_TIER", "sharded")  # worker_tier_arm fresh-reads
+    ctl = build_controller(cfg)
+    assert ctl.tier == "sharded"
+    for s, cost in DEFAULT_SHARDED_AMORT_POINTS.items():
+        assert ctl.amort.batch_s(s) == pytest.approx(cost)
+    # the sharded curve amortizes wide batches harder than native
+    nat = AmortModel(DEFAULT_AMORT_POINTS)
+    assert ctl.amort.per_proof_s(16) / ctl.amort.per_proof_s(1) < \
+        nat.per_proof_s(16) / nat.per_proof_s(1)
+
+    monkeypatch.setenv("ZKP2P_WORKER_TIER", "native")
+    ctl_n = build_controller(load_config(environ={}))
+    assert ctl_n.tier == "native"
+    for s, cost in DEFAULT_AMORT_POINTS.items():
+        assert ctl_n.amort.batch_s(s) == pytest.approx(cost)
+
+    monkeypatch.setenv("ZKP2P_WORKER_TIER", "sharded")
+    ctl_s = build_controller(
+        load_config(environ={"ZKP2P_WORKER_TIER": "sharded", "ZKP2P_SCHED_AMORT": AMORT})
+    )
+    assert ctl_s.amort.batch_s(8) == pytest.approx(3.0)  # explicit spec wins
+
+
+# ------------------------------------------- mixed-tier toy fleet A/B
+
+
+def _chaos_mod():
+    spec = importlib.util.spec_from_file_location("zkp2p_chaos_for_shard", CHAOS)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def toy_world():
+    from zkp2p_tpu.native.lib import get_lib
+
+    if get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    return _chaos_mod()._build_world()
+
+
+def _toy_service(world, **kw):
+    from zkp2p_tpu.pipeline.service import ProvingService
+    from zkp2p_tpu.prover.native_prove import prove_native_batch
+
+    cs, dpk, vk, witness_fn = world
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("prover_fn", prove_native_batch)
+    return ProvingService(cs, dpk, vk, witness_fn, public_fn=lambda w: [w[1]], **kw)
+
+
+def _drop(spool, rid, payload):
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, rid + ".req.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def _fake_peer_hb(fleet_dir, wid, tier):
+    os.makedirs(fleet_dir, exist_ok=True)
+    with open(os.path.join(fleet_dir, wid + ".hb"), "w") as f:
+        json.dump({"pid": 0, "ts": round(time.time(), 3), "worker": wid,
+                   "state": "up", "tier": tier}, f)
+
+
+def test_mixed_tier_fleet_routes_bulk_to_sharded(toy_world, tmp_path, monkeypatch):
+    """The mixed-tier A/B on one spool: a native worker with a live
+    sharded peer proves ONLY the interactive lane (bulk deferred, sched
+    line + heartbeat say so); the sharded worker then proves the bulk
+    lane; the chaos checker holds — every request exactly one terminal,
+    zero lost, zero duplicated."""
+    monkeypatch.setenv("ZKP2P_SCHED", "adaptive")
+    monkeypatch.setenv("ZKP2P_SCHED_AMORT", "1:0.05,8:0.1")
+    monkeypatch.setenv("ZKP2P_DEADLINE_S", "30")
+    spool = str(tmp_path / "spool")
+    fleet_dir = str(tmp_path / "fleet")
+    monkeypatch.setenv("ZKP2P_FLEET_DIR", fleet_dir)
+    for i in range(4):
+        _drop(spool, f"b{i}", {"x": 3 + i, "y": 4})
+    for i in range(2):
+        _drop(spool, f"i{i}", {"x": 5 + i, "y": 6, "priority": "interactive"})
+
+    # --- the native worker, with a live sharded peer advertised
+    monkeypatch.setenv("ZKP2P_WORKER_TIER", "native")
+    monkeypatch.setenv("ZKP2P_WORKER_ID", "w-native")
+    _fake_peer_hb(fleet_dir, "w-sharded", "sharded")
+    svc_n = _toy_service(toy_world)
+    stats_n = svc_n.process_dir(spool)
+    assert stats_n["done"] == 2  # the interactive pair only
+    assert svc_n._sched_hb["tier"] == "native"
+    assert svc_n._sched_hb["deferred"] == {"bulk": 4}
+    # the bulk lane is still OPEN in the spool — no terminal artifact,
+    # no claim (deferral is claim-free) — while interactive is proved
+    names = set(os.listdir(spool))
+    for i in range(4):
+        assert f"b{i}.proof.json" not in names and f"b{i}.error.json" not in names
+        assert f"b{i}.claim" not in names
+    for i in range(2):
+        assert f"i{i}.proof.json" in names
+
+    # --- the sharded worker sweeps next (native peer still fresh)
+    monkeypatch.setenv("ZKP2P_WORKER_TIER", "sharded")
+    monkeypatch.setenv("ZKP2P_WORKER_ID", "w-sharded")
+    _fake_peer_hb(fleet_dir, "w-native", "native")
+    svc_s = _toy_service(toy_world)
+    stats_s = svc_s.process_dir(spool)
+    assert stats_s["done"] == 4  # the whole bulk lane
+    assert svc_s._sched_hb["tier"] == "sharded"
+
+    # --- global invariant: zero lost, zero duplicated, all verified
+    chaos = _chaos_mod()
+    report = chaos.check_invariants(spool, vk=toy_world[2])
+    assert report["violations"] == [], report
+    assert report["proofs_verified"] == 6 and report["states"] == {"done": 6}
+
+    # the decision telemetry: one sched line per worker, defer recorded
+    with open(spool + ".metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    sched_lines = [r for r in recs if r.get("type") == "sched"]
+    by_tier = {ln["tier"]: ln for ln in sched_lines}
+    assert by_tier["native"]["deferred"] == {"bulk": 4}
+    assert by_tier["native"]["peer_tiers"] == ["sharded"]
+    assert "deferred" not in by_tier["sharded"]
+    # bulk records attribute to the sharded worker, interactive to native
+    reqs = {r["request_id"]: r for r in recs if r.get("type") == "request"}
+    assert all(reqs[f"b{i}"]["worker"] == "w-sharded" for i in range(4))
+    assert all(reqs[f"i{i}"]["worker"] == "w-native" for i in range(2))

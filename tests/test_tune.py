@@ -355,3 +355,86 @@ def test_tune_budget_truncation(prof_env, monkeypatch):
     assert hostprof.geometry_for("h", 1 << 19) is None  # ...falls back
     assert hostprof.amort_points() is None
     assert hostprof.tuned_threads() >= 1  # topology default, measured or not
+
+
+# ------------------------------------- variable-base window arm (applied)
+
+WIN_BODY = {"threads": 1, "families": {"plain": {"c": 9, "bl": 13},
+                                       "glv": {"c": 11, "bl": 14}}}
+
+
+def test_tuned_window_exact_context_only(prof_env):
+    """The profile window applies at the MEASURED (family, shape,
+    threads) context and nowhere else — window optima are not monotone
+    in either axis (the glv curve steps DOWN a window at 2^19)."""
+    _save(prof_env, msm_window=WIN_BODY)
+    assert hostprof.tuned_window("plain", 13, 1) == 9
+    assert hostprof.tuned_window("glv", 14, 1) == 11
+    assert hostprof.tuned_window("plain", 14, 1) is None  # other shape
+    assert hostprof.tuned_window("plain", 13, 2) is None  # other threads
+    assert hostprof.tuned_window("ladder", 13, 1) is None  # unknown family
+
+
+def test_tuned_window_corrupt_c_rejected(prof_env):
+    # a corrupt c would allocate 2^(c-1) buckets — bounds-checked away
+    _save(prof_env, msm_window={"threads": 1,
+                                "families": {"plain": {"c": 25, "bl": 13}}})
+    assert hostprof.tuned_window("plain", 13, 1) is None
+    _save(prof_env, msm_window={"threads": 1, "families": {"plain": "junk"}})
+    hostprof.reset()
+    assert hostprof.tuned_window("plain", 13, 1) is None
+
+
+def test_pick_window_resolves_through_profile(prof_env, monkeypatch):
+    """_pick_window/_pick_window_glv consult the tune evidence on the
+    IFMA tier: tuned c wins and records window_source=profile; no
+    profile keeps the committed curve byte-exactly and records
+    fallback (tuned vs fallback digests therefore differ)."""
+    from zkp2p_tpu.prover import native_prove as npv
+
+    monkeypatch.setattr(npv, "_native_ifma_tier", lambda: True)
+    n = 1 << 12  # bl 13 -> committed IFMA c = max(4, 13 - 5) = 8
+    assert npv._pick_window(n, threads=1) == 8
+    assert audit.gate_arms()["window_source"] == "fallback"
+    d_fallback = audit.execution_digest()
+
+    _save(prof_env, msm_window=WIN_BODY)
+    hostprof.reset()
+    assert npv._pick_window(n, threads=1) == 9
+    assert audit.gate_arms()["window_source"] == "profile"
+    assert audit.execution_digest() != d_fallback
+    # glv family: bl = (2n).bit_length() = 14 -> tuned 11 (committed 16)
+    assert npv._pick_window_glv(1 << 12, threads=1) == 11
+    # non-IFMA tier never consults the profile (generic curve)
+    monkeypatch.setattr(npv, "_native_ifma_tier", lambda: False)
+    assert npv._pick_window(n, threads=1) == max(4, min(17, 13 - 5))
+
+
+def test_tuned_window_bypasses_thread_clamp(prof_env, monkeypatch):
+    """A tuned c measured AT threads=2 skips the min(c, 14) serial-
+    suffix clamp — the sweep already paid the suffix at that thread
+    count, so the clamp's reasoning is inside the number."""
+    from zkp2p_tpu.prover import native_prove as npv
+
+    monkeypatch.setattr(npv, "_native_ifma_tier", lambda: True)
+    n = 1 << 19  # bl 20 -> committed IFMA c=16, clamped to 14 at threads>1
+    assert npv._pick_window(n, threads=2) == 14
+    _save(prof_env, msm_window={"threads": 2,
+                                "families": {"plain": {"c": 16, "bl": 20}}})
+    hostprof.reset()
+    assert npv._pick_window(n, threads=2) == 16
+
+
+def test_amort_points_per_tier(prof_env):
+    """sched.tiers.<tier>.amort_points rides the same validation as the
+    native points; an absent tier block degrades to None (the caller's
+    built-in per-tier default)."""
+    _save(prof_env, sched={"amort_points": {"1": 3.0, "4": 5.0},
+                           "tiers": {"sharded": {"amort_points": {"1": 9.0, "16": 30.0}}}})
+    assert hostprof.amort_points() == {1: 3.0, 4: 5.0}
+    assert hostprof.amort_points(tier="sharded") == {1: 9.0, 16: 30.0}
+    assert hostprof.amort_points(tier="mystery") is None
+    # corrupt tier points (non-increasing) degrade, never raise
+    _save(prof_env, sched={"tiers": {"sharded": {"amort_points": {"4": 2.0, "1": 5.0}}}})
+    hostprof.reset()
+    assert hostprof.amort_points(tier="sharded") is None

@@ -174,10 +174,23 @@ def msm_pod_batched(
     return _msm_pod_fn(curve, len(bases), mesh, dcn_axis, ici_axis, lanes, window)(bases, planes_batch)
 
 
-def pad_to_multiple(bases: AffPoint, bit_planes: jnp.ndarray, multiple: int) -> Tuple[AffPoint, jnp.ndarray]:
+def pad_to_multiple(bases: AffPoint, bit_planes, multiple: int) -> Tuple[AffPoint, jnp.ndarray]:
+    """Pad the MSM base axis (and the matching LAST plane axis) up to a
+    multiple of the mesh width: (0, 0) infinity bases and zero digit
+    columns contribute nothing.  Planes may be (n_planes, N) single-proof
+    or (B, n_planes, N) batched (msm_pod_batched), and signed planes
+    arrive as a (mags, negs) tuple — the pad is rank-generic on the last
+    axis either way."""
     n = bases[0].shape[0]
     pad = (-n) % multiple
     if pad:
         bases = tuple(jnp.pad(c, [(0, pad)] + [(0, 0)] * (c.ndim - 1)) for c in bases)
-        bit_planes = jnp.pad(bit_planes, [(0, 0), (0, pad)])
+
+        def pad_last(p):
+            return jnp.pad(p, [(0, 0)] * (p.ndim - 1) + [(0, pad)])
+
+        if isinstance(bit_planes, tuple):
+            bit_planes = tuple(pad_last(p) for p in bit_planes)
+        else:
+            bit_planes = pad_last(bit_planes)
     return bases, bit_planes

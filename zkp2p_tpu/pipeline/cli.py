@@ -737,6 +737,91 @@ def cmd_tune(args):
         sys.exit(1)
 
 
+def cmd_warm_cache(args):
+    """Pre-populate the persistent XLA compile cache with the batch
+    prover's executables, so the first REAL batch of a service/fleet
+    session dispatches warm instead of paying the multi-minute
+    shard_map compile inline (docs/TPU.md §warm-start).
+
+    The executables XLA caches are keyed by SHAPES (circuit wires +
+    domain, batch width, mesh geometry, window), not key material — so
+    a dev in-memory setup over the same circuit warms exactly the
+    entries a production zkey will hit, and no zkey file is needed.
+    Run it with the same --circuit/--batch and ZKP2P_TPU_SHARD/
+    ZKP2P_TPU_MESH (or --shard) the service will use."""
+    # knob wiring BEFORE any compile — flags ride the env knobs like
+    # cmd_service's --sched (the prover's shard gate fresh-reads)
+    if args.shard:
+        os.environ["ZKP2P_TPU_SHARD"] = "on"
+        if args.shard != "on":
+            os.environ["ZKP2P_TPU_MESH"] = args.shard
+    if args.cache_dir:
+        os.environ["ZKP2P_JAX_CACHE_DIR"] = args.cache_dir
+    # re-assert the cache with a ZERO compile-time floor: main() enabled
+    # it with the 1.0 s default, which would skip sub-second executables
+    # (the toy-circuit smoke depends on those round-tripping)
+    from ..utils.audit import install_compile_listener
+    from ..utils.jaxcfg import cache_dir as _resolved_cache_dir, enable_cache
+
+    enable_cache(path=args.cache_dir or None, min_compile_s=0.0)
+    install_compile_listener()
+    from ..utils.metrics import REGISTRY
+
+    def _compile_totals():
+        ev = secs = 0.0
+        for m in REGISTRY.snapshot():
+            if m["name"] == "zkp2p_compile_events_total":
+                ev += m.get("value", 0.0)
+            elif m["name"] == "zkp2p_compile_seconds_total":
+                secs += m.get("value", 0.0)
+        return ev, secs
+
+    cdir = _resolved_cache_dir(args.cache_dir or None)
+
+    def _cache_entries():
+        files = total = 0
+        for root, _dirs, fns in os.walk(cdir):
+            for fn in fns:
+                files += 1
+                try:
+                    total += os.path.getsize(os.path.join(root, fn))
+                except OSError:
+                    pass
+        return files, total
+
+    f0, b0 = _cache_entries()
+    ev0, s0 = _compile_totals()
+
+    cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
+    from ..prover import device_pk
+    from ..prover.groth16_tpu import prove_tpu_batch
+    from ..snark.groth16 import setup
+
+    pk, _vk = setup(cs)
+    dpk = device_pk(pk, cs)
+    w, _pub = _witness_for(args, cs, meta)
+    wits = [w] * max(1, args.batch)
+    _log(f"warm-cache: compiling batch={len(wits)} of {args.circuit!r} into {cdir}")
+    t0 = time.perf_counter()
+    prove_tpu_batch(dpk, wits)
+    dt = time.perf_counter() - t0
+    f1, b1 = _cache_entries()
+    ev1, s1 = _compile_totals()
+    from ..utils.audit import gate_arms
+
+    _log(
+        f"warm-cache: {dt:.1f}s wall, {ev1 - ev0:.0f} compiles "
+        f"({s1 - s0:.1f}s compile time), cache {'+' if f1 >= f0 else ''}{f1 - f0} "
+        f"entries ({(b1 - b0) / 2**20:.1f} MiB) -> {f1} total"
+    )
+    _log(f"warm-cache: tpu_shard arm = {gate_arms().get('tpu_shard', 'off')}")
+    # warm runs still fire backend_compile EVENTS (the cache hit and its
+    # deserialization happen inside the span) — zero NEW entries is the
+    # round-trip proof
+    if f1 - f0 == 0:
+        _log("warm-cache: zero new cache entries — every executable loaded warm")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser("zkp2p-tpu", description=__doc__)
     ap.add_argument("--build-dir", default=os.environ.get("BUILD_DIR", "build"))
@@ -913,6 +998,23 @@ def main(argv=None):
     s.add_argument("--arms", default=None,
                    help="comma list of arms (threads,window,geometry,columns,ladder); default: ZKP2P_TUNE_ARMS or all")
     s.set_defaults(fn=cmd_tune)
+
+    s = sub.add_parser(
+        "warm-cache",
+        help="pre-compile the batch prover into the persistent XLA cache "
+             "(sharded arm included when ZKP2P_TPU_SHARD/--shard asks)",
+    )
+    s.add_argument("--batch", type=int, default=8,
+                   help="batch width to compile for (must match the service's; "
+                        "sharded: a multiple of the mesh batch dim)")
+    s.add_argument("--shard", nargs="?", const="on", default=None, metavar="BxS",
+                   help="arm the sharded batch prover (sets ZKP2P_TPU_SHARD=on; "
+                        "an explicit BxS value also sets ZKP2P_TPU_MESH)")
+    s.add_argument("--cache-dir", default=None,
+                   help="cache root (default: ZKP2P_JAX_CACHE_DIR or <repo>/.jax_cache)")
+    s.add_argument("--message", help=argparse.SUPPRESS)
+    s.add_argument("--eml", help=argparse.SUPPRESS)
+    s.set_defaults(fn=cmd_warm_cache)
 
     s = sub.add_parser("doctor", help="execution-path preflight: arm every gate, report arms + digest")
     s.add_argument("--json", action="store_true", help="machine-readable report on stdout")

@@ -189,6 +189,16 @@ def _write_heartbeat(svc, fleet_dir: str, state: Optional[str] = None) -> None:
         "rss_mb": _rss_mb(os.getpid()),
         "degraded": bool(getattr(svc, "_fleet_degraded", False)),
     }
+    # worker tier advertisement (ZKP2P_WORKER_TIER): peers read this
+    # from the heartbeat to route lanes — a sharded-tier peer takes the
+    # bulk lane, native peers keep interactive (pipeline.sched).  Fresh
+    # read + record_arm so the gate digest tracks what was advertised.
+    try:
+        from .sched import worker_tier_arm
+
+        hb["tier"] = worker_tier_arm()
+    except Exception:  # noqa: BLE001 — the heartbeat must always land
+        hb["tier"] = "native"
     # the worker's last scheduler decision (pipeline.sched block:
     # mode, batch target, lane depths) — surfaces in fleet /status
     # and `zkp2p-tpu top` without another scrape route

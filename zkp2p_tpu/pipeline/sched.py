@@ -63,6 +63,19 @@ from typing import Dict, List, Optional, Tuple
 # measured.  Calibrate per circuit/host via ZKP2P_SCHED_AMORT.
 DEFAULT_AMORT_POINTS: Dict[int, float] = {1: 3.17, 4: 13.3}
 
+# Built-in sharded-tier default: a mesh worker (prover=tpu, the
+# ZKP2P_TPU_SHARD pod program) pays a heavy per-dispatch floor — witness
+# staging, collective setup, and the residual warm-cache executable
+# load — but its batch axis is data-parallel across the mesh, so the
+# marginal proof is cheap and wide batches amortize hard.  Deliberately
+# conservative in the same sense as DEFAULT_AMORT_POINTS (a worse
+# single-proof cost than any real mesh would measure): it steers the
+# bulk lane toward wide batches without ever promising latency the
+# interactive lane should get from a native worker instead.  Measured
+# per-host curves land via `zkp2p-tpu tune` (hostprof amort_points,
+# tier="sharded").
+DEFAULT_SHARDED_AMORT_POINTS: Dict[int, float] = {1: 8.0, 4: 12.0, 16: 28.0}
+
 # Interactive latency-lane width: interactive batches never exceed this
 # many columns, however wide the bulk target is — the lane exists so an
 # interactive request's service time is bounded by a small batch even
@@ -175,6 +188,15 @@ class SweepPlan:
     rate_hz: float = 0.0
     oldest_wait_s: float = 0.0
     lanes: Dict[str, int] = field(default_factory=dict)
+    # heterogeneous-tier routing (docs/TPU.md §tier routing): the tier
+    # this plan was made under, per-lane counts LEFT IN THE SPOOL for a
+    # better-suited live peer tier (not batched, not shed — the peer
+    # claims them), and the tier-loss flag: True exactly once when a
+    # previously-live sharded peer vanished while bulk work was queued,
+    # so the service can count the degrade-to-native event.
+    tier: str = "native"
+    deferred: Dict[str, int] = field(default_factory=dict)
+    tier_fallback: bool = False
 
 
 class BatchController:
@@ -188,8 +210,19 @@ class BatchController:
         objective_s: float = 0.0,
         target_fill: float = 0.8,
         ewma_tau_s: float = 10.0,
+        tier: str = "native",
     ):
         self.amort = amort
+        # the worker tier this controller plans for (normalize_tier
+        # grammar): lane routing against live peer tiers happens in
+        # plan(); the amort curve for the tier is the factory's job
+        # (build_controller).
+        self.tier = normalize_tier(tier)
+        # tier-loss edge detector: set when a plan() has SEEN a live
+        # sharded peer, cleared when the loss event fires — so the
+        # degrade-to-native fallback is counted once per loss, not once
+        # per sweep.
+        self._seen_sharded_peer = False
         self.objective_s = max(0.0, float(objective_s))
         # headroom fraction of the deadline/objective budget batches are
         # planned to — 0.8 leaves 20% for queue wait drift, witness
@@ -338,8 +371,25 @@ class BatchController:
         spool_cap: int = 0,
         allow_shed: bool = True,
         parallelism: int = 1,
+        peer_tiers: Optional[List[str]] = None,
     ) -> SweepPlan:
-        """One sweep's full decision: lane-sort, shed, partition.
+        """One sweep's full decision: lane-sort, tier-route, shed,
+        partition.
+
+        `peer_tiers` (None = no tier information, serve everything) is
+        the tiers of the OTHER live workers on this spool.  Routing is
+        deferral, not claiming: a native worker with a live sharded peer
+        leaves the bulk lane in the spool (wide batches belong on the
+        mesh tier — per-batch cost there amortizes hard); a sharded
+        worker with a live native peer leaves the interactive lane (an
+        interactive request must never wait behind a sharded-tier
+        dispatch/compile).  Deferred requests are NEVER shed here — they
+        are the peer's to serve, and its own shed walk owns their
+        deadlines.  A worker with no live peer of the other tier serves
+        both lanes (no starvation when the fleet degrades to one tier);
+        a native worker that LOSES its sharded peer with bulk queued
+        flags tier_fallback exactly once per loss so the service counts
+        the degrade event.
 
         1. service order: interactive first, then by (t_submit, rid) —
            oldest-first within a lane, deterministic throughout.
@@ -368,7 +418,35 @@ class BatchController:
         PEERS could still serve (the fleet-wide over-shed bug class).
         """
         plan = SweepPlan()
+        plan.tier = self.tier
         plan.rate_hz = round(self.observe_arrivals(now, [r.t_submit for r in reqs]), 6)
+
+        # Tier routing before anything else: deferred lanes drop out of
+        # the shed walk, the sizing, and the partition — they stay in
+        # the spool for the peer.  The sharded-peer edge detector runs
+        # even on an empty queue so a loss during idle does not fire a
+        # stale fallback on the next busy sweep.
+        sharded_peer = peer_tiers is not None and "sharded" in peer_tiers
+        native_peer = peer_tiers is not None and "native" in peer_tiers
+        has_bulk = any(not r.interactive for r in reqs)
+        if self.tier == "native":
+            if sharded_peer:
+                self._seen_sharded_peer = True
+            elif self._seen_sharded_peer:
+                self._seen_sharded_peer = False
+                if has_bulk:
+                    plan.tier_fallback = True
+        if self.tier == "native" and sharded_peer:
+            deferred = [r for r in reqs if not r.interactive]
+            reqs = [r for r in reqs if r.interactive]
+            if deferred:
+                plan.deferred["bulk"] = len(deferred)
+        elif self.tier == "sharded" and native_peer:
+            deferred = [r for r in reqs if r.interactive]
+            reqs = [r for r in reqs if not r.interactive]
+            if deferred:
+                plan.deferred["interactive"] = len(deferred)
+
         if not reqs:
             return plan
         order = sorted(reqs, key=lambda r: (not r.interactive, r.t_submit, r.rid))
@@ -563,6 +641,26 @@ def sched_arm() -> str:
     return sched_mode()
 
 
+def normalize_tier(value: str) -> str:
+    """The worker-tier grammar in ONE place: anything but the literal
+    "sharded" fails CLOSED to "native" (the single-device arm keeps
+    serving everything; a typo'd tier must not strand the bulk lane
+    waiting for a mesh worker that does not exist)."""
+    return "sharded" if value == "sharded" else "native"
+
+
+def worker_tier_arm() -> str:
+    """Resolve + record the worker tier (ZKP2P_WORKER_TIER, fresh-read
+    like sched_mode): "sharded" or "native".  The tier is a routing code
+    path — a mixed-tier fleet and a homogeneous one must never share an
+    execution digest — so it rides the same record_arm rail as every
+    other gate and preflight arms it explicitly."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("worker_tier", normalize_tier(load_config().worker_tier))
+
+
 def build_controller(cfg) -> BatchController:
     """THE BatchController factory (service + tests share it): the
     amortization curve resolves explicit spec -> tuned host profile ->
@@ -578,24 +676,36 @@ def build_controller(cfg) -> BatchController:
          on THIS hardware by `zkp2p-tpu tune`.
       3. neither: the built-in conservative curve, warm-up as before.
 
+    The curve is PER TIER (worker_tier_arm, recorded here so every
+    controller build stamps the tier into the digest): a sharded-tier
+    worker resolves the profile's sharded batch-cost points (hostprof
+    amort_points(tier="sharded")) and falls back to the built-in
+    DEFAULT_SHARDED_AMORT_POINTS — heavy dispatch floor, hard
+    wide-batch amortization — while the native tier keeps the venmo
+    default.  An explicit ZKP2P_SCHED_AMORT still wins for either tier.
+
     Resolving through hostprof records the "host_profile" gate, so a
     seeded and an unseeded run never share an execution digest."""
     from ..utils.hostprof import amort_points
 
+    tier = worker_tier_arm()
     seeded = False
     if cfg.sched_amort.strip():
         amort = AmortModel.from_spec(cfg.sched_amort)
     else:
-        pts = amort_points()
+        pts = amort_points(tier=tier)
         if pts is not None:
             amort = AmortModel(pts)
             seeded = True
         else:
-            amort = AmortModel(DEFAULT_AMORT_POINTS)
+            amort = AmortModel(
+                DEFAULT_SHARDED_AMORT_POINTS if tier == "sharded" else DEFAULT_AMORT_POINTS
+            )
     ctl = BatchController(
         amort,
         objective_s=cfg.slo_p95_s,
         target_fill=cfg.sched_target_fill,
+        tier=tier,
     )
     if seeded:
         ctl.seed_calibration()

@@ -552,6 +552,39 @@ class ProvingService:
             return 1
         return max(1, n)
 
+    def _live_peer_tiers(self) -> List[str]:
+        """Advertised tiers of live fleet peers (self EXCLUDED), from
+        the `tier` field of fresh heartbeat JSON.  Feeds the scheduler's
+        heterogeneous routing: a native worker seeing a live "sharded"
+        peer defers its bulk lane to it (and vice versa for
+        interactive).  Solo service or unreadable heartbeats = [] — the
+        scheduler then serves both lanes itself, so a torn/legacy hb
+        (no tier field) degrades to homogeneous routing, never to a
+        starved lane."""
+        if not getattr(self, "_fleet_dir", ""):
+            return []
+        my_wid = getattr(self, "_worker_id", "") or ""
+        tiers: List[str] = []
+        now = time.time()
+        try:
+            for fn in os.listdir(self._fleet_dir):
+                if not fn.endswith(".hb") or fn == my_wid + ".hb":
+                    continue
+                path = os.path.join(self._fleet_dir, fn)
+                try:
+                    if now - os.path.getmtime(path) >= self._PEER_HB_FRESH_S:
+                        continue
+                    with open(path) as f:
+                        hb = json.load(f)
+                    tier = hb.get("tier")
+                    if isinstance(tier, str) and tier:
+                        tiers.append(tier)
+                except (OSError, ValueError):
+                    pass  # torn write / legacy hb: peer counts for parallelism, not routing
+        except OSError:
+            return []
+        return tiers
+
     def _sched_controller(self):
         """The lazily-built BatchController (adaptive arm only).  The
         amortization model and objective are resolved once per process —
@@ -1098,6 +1131,7 @@ class ProvingService:
             for r in pending
         ]
         peers = self._live_peers()
+        peer_tiers = self._live_peer_tiers()
         plan = ctl.plan(
             now, sreqs, cap=max(1, self.batch_size),
             spool_cap=self._spool_cap or 0,
@@ -1106,6 +1140,12 @@ class ProvingService:
             # fleet peers share this queue: predictions must not model
             # the whole backlog as served by this worker alone
             parallelism=peers,
+            # heterogeneous routing: live peers' advertised tiers — a
+            # native worker defers bulk to a live sharded peer (and a
+            # sharded worker defers interactive to a native one).
+            # Deferred requests stay UNCLAIMED in the spool for the
+            # peer; they are never shed by this worker.
+            peer_tiers=peer_tiers,
         )
         backlog = len(pending)
         for sr, reason in plan.shed:
@@ -1128,6 +1168,17 @@ class ProvingService:
             REGISTRY.counter("zkp2p_sched_decisions_total", {"kind": "batch"}).inc(len(plan.batches))
         if plan.lanes.get("interactive"):
             REGISTRY.counter("zkp2p_sched_decisions_total", {"kind": "lane"}).inc()
+        if plan.deferred:
+            # lane handoff to a tier peer: the requests stay unclaimed
+            # in the spool — count the DECISION (per sweep, per lane),
+            # not the requests, so the counter reads "how often routing
+            # engaged", aggregatable against the sched sink lines
+            REGISTRY.counter("zkp2p_sched_decisions_total", {"kind": "defer"}).inc(len(plan.deferred))
+        if plan.tier_fallback:
+            # a sharded peer vanished while bulk work was pending: this
+            # native worker resumes the bulk lane — the counted,
+            # alertable "tier degraded to native" event
+            REGISTRY.counter("zkp2p_sched_decisions_total", {"kind": "tier_fallback"}).inc()
         if self._sampler is not None:
             self._sampler.batch_target_last = plan.batch_target
         self._sched_hb = {
@@ -1138,7 +1189,10 @@ class ProvingService:
             "lane_bulk": plan.lanes.get("bulk", 0),
             "rate_hz": plan.rate_hz,
             "peers": peers,
+            "tier": plan.tier,
         }
+        if plan.deferred:
+            self._sched_hb["deferred"] = dict(plan.deferred)
         if pending:
             # one decision line per sweep with queue activity: every
             # sizing/shed choice is auditable offline, next to the
@@ -1157,7 +1211,14 @@ class ProvingService:
                     "batches": len(plan.batches),
                     "shed": len(plan.shed),
                     "peers": peers,
+                    "tier": plan.tier,
                 }
+                if peer_tiers:
+                    rec["peer_tiers"] = peer_tiers
+                if plan.deferred:
+                    rec["deferred"] = dict(plan.deferred)
+                if plan.tier_fallback:
+                    rec["tier_fallback"] = True
                 if self._worker_id:
                     rec["worker"] = self._worker_id
                 if self._fleet_id:

@@ -185,6 +185,7 @@ def run_tune(
     sweep: Dict = {}
     arms_run: List[str] = []
     truncated = False
+    window_profile: Optional[Dict] = None
 
     # ---------------------------------------------------------- threads
     # candidates from the detected topology; the variable-base plain MSM
@@ -380,6 +381,30 @@ def run_tune(
                 log(f"tune: window[{tag}] c={c} min={rows[str(c)]*1e3:.0f} ms")
             win[tag] = rows
         sweep["window"] = win
+        # promote winners: the measured-best c per tag, with the same
+        # hysteresis discipline as the fixed-tier geometry — a neighbor
+        # must beat the committed c0 by >3% to displace it, so rep noise
+        # never flaps the curve.  The context (scalar-count bit length,
+        # thread count) rides along: hostprof.tuned_window applies the
+        # value only at the exact measured shape — window optima are not
+        # monotone in either axis.
+        fams: Dict[str, Dict[str, int]] = {}
+        for tag in ("plain", "glv"):
+            rows = win.get(tag, {})
+            if not rows:
+                continue
+            best_c = min(rows, key=lambda k: rows[k])
+            c0 = (
+                _pick_window(n, threads=best_threads)
+                if tag == "plain"
+                else _pick_window_glv(n, threads=best_threads)
+            )
+            if str(c0) in rows and rows[best_c] > rows[str(c0)] * (1.0 - _GEOMETRY_HYSTERESIS):
+                best_c = str(c0)
+            bl = n.bit_length() if tag == "plain" else (2 * n).bit_length()
+            fams[tag] = {"c": int(best_c), "bl": int(bl)}
+        if fams:
+            window_profile = {"threads": int(best_threads), "families": fams}
 
     # ----------------------------------------------------------- ladder
     # non-MSM floor at the resolved pool width — evidence rows only
@@ -435,6 +460,8 @@ def run_tune(
         }
         if batch_columns is not None:
             profile["sched"]["batch_columns"] = int(batch_columns)
+    if window_profile is not None:
+        profile["msm_window"] = window_profile
 
     path = save_profile(profile, out_path)
     if path is None:

@@ -113,6 +113,62 @@ def _glv() -> bool:
     return _record_arm("msm_glv", MSM_GLV and MSM_SIGNED)
 
 
+def _parse_mesh_spec(spec: str, n_devices: int) -> Optional[Tuple[int, int]]:
+    """ZKP2P_TPU_MESH -> (batch_width, shard_width).  "BxS" gives B
+    data-parallel batch groups of S base-axis shards; a bare int N is
+    "1xN"; "" auto-sizes to 1x<all devices>.  Malformed or non-positive
+    specs return None (the caller fails CLOSED to the vmap arm — the
+    same malformed-knob rule as _batch_chunk_size)."""
+    spec = (spec or "").strip().lower()
+    if not spec:
+        return (1, n_devices)
+    try:
+        if "x" in spec:
+            b_s = spec.split("x", 1)
+            b, s = int(b_s[0]), int(b_s[1])
+        else:
+            b, s = 1, int(spec)
+    except ValueError:
+        return None
+    if b < 1 or s < 1:
+        return None
+    return (b, s)
+
+
+# pod meshes memoised by shape: Mesh construction is cheap but the
+# shard_map executable caches (parallel.mesh._msm_pod_fn) key on the
+# Mesh instance — one instance per shape keeps them warm across proves.
+_POD_MESH_CACHE: Dict[Tuple[int, int], object] = {}
+
+
+def _shard_mesh():
+    """The sharded-arm gate + mesh resolver (fresh config read per call,
+    like the scheduler's sched_arm): ZKP2P_TPU_SHARD must be literally
+    "on" — anything else fails CLOSED to the single-device vmap path —
+    and ZKP2P_TPU_MESH shapes the ("batch", "shard") pod mesh.  Records
+    the `tpu_shard` gate with the RESOLVED shape ("off" | "2x4"), so a
+    sharded prove is digest-distinguishable from the vmap arm and an
+    unsatisfiable mesh is an on-record disarm, never a silent one."""
+    cfg = _load_config()
+    if cfg.tpu_shard != "on":
+        _record_arm("tpu_shard", "off")
+        return None
+    n_dev = len(jax.devices())
+    shape = _parse_mesh_spec(cfg.tpu_mesh, n_dev)
+    if shape is None or shape[0] * shape[1] > n_dev:
+        _record_arm("tpu_shard", "off")
+        return None
+    b, s = shape
+    mesh = _POD_MESH_CACHE.get((b, s))
+    if mesh is None:
+        from ..parallel.mesh import make_pod_mesh
+
+        mesh = make_pod_mesh(b, s, names=("batch", "shard"))
+        _POD_MESH_CACHE[(b, s)] = mesh
+    _record_arm("tpu_shard", f"{b}x{s}")
+    return mesh
+
+
 @dataclass
 class DeviceProvingKey:
     """Proving key resident as device arrays (the zkey, TPU-shaped)."""
@@ -941,6 +997,63 @@ def prove_tpu_sharded(
     return _assemble(dpk, (a, b1, b2, c, hq), r, s)
 
 
+# Batched sharded-arm stage jits: h_evals vmapped over the witness batch
+# (the pjit data-parallel axis — inputs arrive batch-sharded, XLA
+# propagates the layout through the matvec/NTT ladder), and the UNSIGNED
+# digit-plane recode per witness ((B, n_planes, n) — the layout
+# msm_pod_batched's shard_map consumes).  The sharded MSMs use the
+# unsigned formulation like prove_tpu_sharded: group arithmetic is
+# exact, so the proof bytes match the signed vmap arm regardless.
+_jit_h_evals_batch = jax.jit(jax.vmap(h_evals, in_axes=(None, 0)))
+_jit_digit_planes_batch = jax.jit(
+    jax.vmap(lambda w_std: digit_planes_from_limbs(w_std, MSM_WINDOW))
+)
+
+
+def _prove_batch_sharded(dpk: DeviceProvingKey, w_mont: jnp.ndarray, mesh):
+    """One prove_tpu_batch chunk on a ("batch", "shard") pod mesh: the
+    (B, n_wires, 16) witness chunk is placed batch-sharded
+    (`NamedSharding(mesh, P("batch"))` — each batch group proves its
+    share of the chunk), and every MSM runs base-axis-sharded over the
+    inner "shard" axis with per-device bucket partial sums combined by
+    ONE group-op allreduce (all_gather + Jacobian fold — ICI on real
+    hardware, host rings on the virtual CPU mesh; parallel.mesh.
+    msm_pod_batched).  Returns the same five (B,)-batched accumulators
+    `_prove_device(batched=True)` emits, so chunks from either arm
+    concatenate identically downstream."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import msm_pod_batched, pad_to_multiple
+
+    n_ici = mesh.shape["shard"]
+    w_mont = jax.device_put(w_mont, NamedSharding(mesh, P("batch")))
+    h = _jit_h_evals_batch(dpk, w_mont)
+    w_planes = _jit_digit_planes_batch(FR.from_mont(w_mont))
+    h_planes = _jit_digit_planes_batch(FR.from_mont(h))
+
+    def msm(curve, bases, planes):
+        # lanes sized to the per-device slice (tiny CI circuits stay at
+        # lanes ~ n/S instead of padding 16x to a 64-lane step); the pad
+        # rule matches prove_tpu_sharded — bases to a multiple of
+        # S * lanes so every device sees whole steps.
+        n = bases[0].shape[0]
+        lanes = max(1, min(64, -(-n // n_ici)))
+        b, p = pad_to_multiple(bases, planes, n_ici * lanes)
+        return msm_pod_batched(
+            curve, b, p, mesh,
+            dcn_axis="batch", ici_axis="shard", lanes=lanes, window=MSM_WINDOW,
+        )
+
+    b_planes = jnp.take(w_planes, dpk.b_sel, axis=-1)
+    return (
+        msm(G1J, dpk.a_bases, w_planes),
+        msm(G1J, dpk.b1_bases, b_planes),
+        msm(G2J, dpk.b2_bases, b_planes),
+        msm(G1J, dpk.c_bases, jnp.take(w_planes, dpk.c_sel, axis=-1)),
+        msm(G1J, dpk.h_bases, h_planes),
+    )
+
+
 def _batch_chunk_size() -> int:
     """Sub-batch size for prove_tpu_batch; 0 = whole batch in one vmap.
 
@@ -971,7 +1084,15 @@ def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -
     Large batches run as shape-stable sub-chunks (see _batch_chunk_size;
     the last chunk pads by repeating its final witness) so device memory
     is bounded by the chunk, not the batch, and every chunk reuses the
-    same compiled executable."""
+    same compiled executable.
+
+    With ZKP2P_TPU_SHARD=on (and a satisfiable ZKP2P_TPU_MESH) each
+    chunk runs the pod-mesh program instead (_prove_batch_sharded):
+    batch data-parallel over the mesh's "batch" axis, MSM bucket partial
+    sums allreduced over "shard".  The arm is decided ONCE per call —
+    a chunk size indivisible by the mesh's batch width records the
+    `tpu_shard` arm as "fallback" and the whole call takes the vmap
+    path, so every chunk of a call shares one executable either way."""
     from ..utils.audit import sample_device_memory
     from ..utils.metrics import REGISTRY
     from ..utils.trace import trace
@@ -987,11 +1108,19 @@ def prove_tpu_batch(dpk: DeviceProvingKey, witnesses: Sequence[Sequence[int]]) -
         else:
             spans = [list(witnesses[i : i + chunk]) for i in range(0, n, chunk)]
             spans[-1] += [spans[-1][-1]] * (chunk - len(spans[-1]))
+        mesh = _shard_mesh()
+        if mesh is not None and len(spans[0]) % mesh.shape["batch"]:
+            _record_arm("tpu_shard", "fallback")
+            mesh = None
         parts = []
         for span in spans:
             # one batched to_mont per chunk (not one device dispatch per witness)
             w = FR.to_mont(jnp.asarray(np.stack([_witness_std_limbs(wit) for wit in span])))
-            parts.append(_prove_device(dpk, w, batched=True))
+            parts.append(
+                _prove_batch_sharded(dpk, w, mesh)
+                if mesh is not None
+                else _prove_device(dpk, w, batched=True)
+            )
             # sub-chunk HBM watermark: the batched pipeline's peak is
             # linear in the vmapped chunk (r5: 15.75 G OOM at batch=16
             # with no telemetry) — sample per chunk so the staircase is

@@ -401,6 +401,25 @@ def _u64x4_to_int_arr(a: np.ndarray) -> list:
     return [int.from_bytes(a[i].tobytes(), "little") for i in range(a.shape[0])]
 
 
+def _tuned_window(tag: str, bl: int, threads: int):
+    """Host-profile window resolution for the variable-base G1 curves
+    (the tune window arm, APPLIED — docs/NEXT.md §1): the measured-best
+    c when the profile recorded one at this exact (shape, threads)
+    context, else None -> the committed curve below.  A tuned value
+    bypasses the multi-thread clamp: the sweep measured it AT that
+    thread count, so the clamp's serial-suffix reasoning is already in
+    the number.  The source is recorded per consultation (the precomp
+    manifest's geometry_source discipline, on the audit rail) so a
+    profile-resolved prove never shares a digest with a curve-resolved
+    one."""
+    from ..utils.audit import record_arm
+    from ..utils.hostprof import tuned_window
+
+    c = tuned_window(tag, bl, threads)
+    record_arm("window_source", "profile" if c is not None else "fallback")
+    return c
+
+
 def _pick_window(n: int, g2: bool = False, threads: int = 1) -> int:
     """Pippenger window: ~log2(n) - 4 with SIGNED digits — the signed
     recoding halves the bucket count at a given c, so the sweet spot
@@ -412,6 +431,9 @@ def _pick_window(n: int, g2: bool = False, threads: int = 1) -> int:
     the big domains reach c=17 while the bench shape keeps its
     measured-best c=15 (signed sweep at 2^19: c=15 6.3s, c=16 7.6s)."""
     if not g2 and _native_ifma_tier():  # batch-affine off: wide-window curve n/a
+        tuned = _tuned_window("plain", n.bit_length(), threads)
+        if tuned is not None:
+            return tuned
         # IFMA regime (G1 only) with the 8-lane vector suffix (csrc
         # g1_suffix8): the serial per-window reduction that clamped the
         # r5 sweep at c=14 is vectorized across windows, so wider
@@ -452,6 +474,9 @@ def _pick_window_glv(n: int, threads: int = 1) -> int:
     curve (the vector suffix is gated off there)."""
     bl = (2 * n).bit_length()
     if _native_ifma_tier():
+        tuned = _tuned_window("glv", bl, threads)
+        if tuned is not None:
+            return tuned
         if bl >= 20:
             c = 15
         elif bl >= 14:

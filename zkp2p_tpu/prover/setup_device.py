@@ -15,6 +15,13 @@ The emitted DeviceProvingKey is bit-identical to
 `device_pk(setup(cs, seed))` for the same seed — pinned by
 tests/test_setup_device.py — and the matching VerifyingKey is a host
 object usable by `snark.groth16.verify` and the Solidity export.
+
+The same key feeds every device arm unchanged: the single-device loop,
+`prove_tpu_sharded`, and the pjit batch-axis arm (ZKP2P_TPU_SHARD=on,
+docs/TPU.md).  The pruned b/c query lanes emitted here are NOT padded
+to any mesh width — the sharded MSMs pad bases and digit planes with
+infinity lanes per-mesh at trace time (parallel.mesh.pad_to_multiple),
+so one key serves every mesh shape.
 """
 
 from __future__ import annotations

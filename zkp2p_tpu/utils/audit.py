@@ -337,7 +337,7 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     on_tpu()
     from ..curve.jcurve import G1J
     from ..field.jfield import field_mul_impl
-    from ..prover.groth16_tpu import _affine, _batch_chunk_size, _glv, _h_bucket, _unified
+    from ..prover.groth16_tpu import _affine, _batch_chunk_size, _glv, _h_bucket, _shard_mesh, _unified
 
     field_mul_impl()
     G1J._pallas()
@@ -346,6 +346,11 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     _h_bucket()
     _glv()
     _batch_chunk_size()
+    # sharded-batch gate: "off" | "BxS" mesh shape | "fallback" — a
+    # pjit-sharded batch prove must never share a digest with the
+    # single-device loop (arms "off"/shape here; prove_tpu_batch
+    # re-arms "fallback" when a batch can't split across the mesh)
+    _shard_mesh()
 
     from ..native.lib import get_lib
     from ..prover.native_prove import (
@@ -371,9 +376,14 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     except Exception:  # noqa: BLE001 — a broken toolchain is a finding, not a crash
         pass
     if native_ok:
-        from ..prover.native_prove import _native_ifma_tier
+        from ..prover.native_prove import _native_ifma_tier, _pick_window
 
-        _native_ifma_tier()
+        if _native_ifma_tier():
+            # arms window_source ("profile" when the host profile holds
+            # tuned MSM geometry for this context, else "fallback") via
+            # a representative single-thread pick — the same resolver
+            # every real MSM consults
+            _pick_window(1 << 12, threads=1)
     else:
         record_arm("native_tier", "unavailable")
 
@@ -403,9 +413,14 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     # scheduler gate (pipeline.sched): the adaptive batching/shedding
     # controller vs the static oracle arm — an adaptive run must never
     # share a digest with a static one
-    from ..pipeline.sched import sched_arm
+    from ..pipeline.sched import sched_arm, worker_tier_arm
 
     sched_arm()
+    # worker-tier gate: "native" | "sharded" — heterogeneous-fleet
+    # routing decisions must be attributable to the tier this worker
+    # advertised (a bulk batch served by the wrong tier is an A/B
+    # confound, not just a perf blip)
+    worker_tier_arm()
 
     # host-profile gate (utils.hostprof): off | tuned | fallback — a run
     # steered by a tune-produced profile (geometry, thread default,

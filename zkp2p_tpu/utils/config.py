@@ -356,6 +356,22 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "profile_path": ("ZKP2P_PROFILE_PATH", str, ""),
     "tune_budget_s": ("ZKP2P_TUNE_BUDGET_S", _nonneg_float(120.0), 120.0),
     "tune_arms": ("ZKP2P_TUNE_ARMS", str, ""),
+    # sharded TPU arm (prover.groth16_tpu._prove_batch_sharded;
+    # docs/TPU.md): the batch-axis pjit gate ("on" = route prove_tpu_batch
+    # chunks through the pod-mesh program — batch data-parallel over the
+    # mesh's outer axis, MSM bucket partial sums allreduced over the inner
+    # ICI axis; anything else fails CLOSED to the single-device vmap),
+    # the mesh shape ("BxS" = B batch-parallel groups of S base-axis
+    # shards; a bare int N = "1xN"; "" = auto 1x<all devices>), the
+    # persistent XLA compile-cache root the warm-cache command pre-warms
+    # ("" = JAX_COMPILATION_CACHE_DIR or <repo>/.jax_cache — read by
+    # utils.jaxcfg.cache_dir), and the fleet worker tier this process
+    # advertises in heartbeats ("sharded" = the wide-batch mesh tier the
+    # scheduler routes the bulk lane to; anything else = "native").
+    "tpu_shard": ("ZKP2P_TPU_SHARD", str, "off"),
+    "tpu_mesh": ("ZKP2P_TPU_MESH", str, ""),
+    "jax_cache_dir": ("ZKP2P_JAX_CACHE_DIR", str, ""),
+    "worker_tier": ("ZKP2P_WORKER_TIER", str, ""),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -363,7 +379,7 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 ARMABLE = (
     "msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap",
     "msm_multi", "msm_precomp", "matvec_seg", "ntt_pool", "sched",
-    "profile",
+    "profile", "tpu_shard", "worker_tier",
 )
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
@@ -438,6 +454,10 @@ class ProverConfig:
     profile_path: str = ""
     tune_budget_s: float = 120.0
     tune_arms: str = ""
+    tpu_shard: str = "off"
+    tpu_mesh: str = ""
+    jax_cache_dir: str = ""
+    worker_tier: str = ""
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 

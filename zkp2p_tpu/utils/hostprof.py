@@ -324,15 +324,54 @@ def tuned_threads() -> Optional[int]:
     return v if v >= 1 else None
 
 
-def amort_points() -> Optional[Dict[int, float]]:
-    """Measured batch-cost points {S: seconds} to seed the scheduler's
-    AmortModel (pipeline.sched), or None.  Validated here (strictly
-    increasing in both axes, positive) so a corrupt profile degrades to
-    the built-in curve instead of raising in the service loop."""
+def tuned_window(tag: str, bl: int, threads: int) -> Optional[int]:
+    """The measured-best VARIABLE-BASE Pippenger window for `tag`
+    ("plain" | "glv") or None -> the committed curve (_pick_window*).
+
+    Applies only at the EXACT measured context: the sweep ran one shape
+    at one thread count, and the window optimum is not monotone in
+    either (the glv curve steps DOWN a window at 2^19 when the deferred
+    bucket block falls out of LLC) — so `bl` must equal the recorded
+    scalar-count bit length and `threads` the recorded worker count, or
+    the committed curve stays authoritative.  c is bounds-checked like
+    geometry_for (a corrupt c would allocate 2^(c-1) buckets)."""
     prof = load_profile()
     if prof is None:
         return None
-    raw = prof.get("sched", {}).get("amort_points")
+    win = prof.get("msm_window")
+    if not isinstance(win, dict):
+        return None
+    row = win.get("families", {}).get(tag)
+    if not isinstance(row, dict):
+        return None
+    try:
+        c = int(row["c"])
+        if int(row["bl"]) != int(bl) or int(win.get("threads")) != int(threads):
+            return None
+    except (KeyError, TypeError, ValueError):
+        return None
+    return c if 4 <= c <= 20 else None
+
+
+def amort_points(tier: str = "native") -> Optional[Dict[int, float]]:
+    """Measured batch-cost points {S: seconds} to seed the scheduler's
+    AmortModel (pipeline.sched), or None.  Validated here (strictly
+    increasing in both axes, positive) so a corrupt profile degrades to
+    the built-in curve instead of raising in the service loop.
+
+    Per worker tier: "native" reads the classic sched.amort_points;
+    any other tier reads sched.tiers.<tier>.amort_points (the sharded
+    pod-mesh curve a tune pass on mesh hardware records) — absent, the
+    caller's built-in per-tier default applies."""
+    prof = load_profile()
+    if prof is None:
+        return None
+    sched = prof.get("sched", {})
+    if tier == "native":
+        raw = sched.get("amort_points")
+    else:
+        tiers = sched.get("tiers")
+        raw = tiers.get(tier, {}).get("amort_points") if isinstance(tiers, dict) else None
     if not isinstance(raw, dict) or not raw:
         return None
     try:

@@ -35,14 +35,24 @@ def host_fingerprint() -> str:
 
 
 def cache_dir(base: str | None = None) -> str:
-    base = base or os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"),
+    # ZKP2P_JAX_CACHE_DIR (registered in utils.config KNOBS; raw read
+    # here because this runs before jax import on every entry path)
+    # overrides the conventional JAX_COMPILATION_CACHE_DIR so the
+    # warm-cache command and its consumers (tools/sharded_scale.py, the
+    # tpu-shard smoke) can share one pre-warmed root without touching
+    # the global JAX env contract.
+    base = (
+        base
+        or os.environ.get("ZKP2P_JAX_CACHE_DIR")
+        or os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"),
+        )
     )
     return os.path.join(base, host_fingerprint())
 
 
-def enable_cache(path: str | None = None) -> None:
+def enable_cache(path: str | None = None, min_compile_s: float = 1.0) -> None:
     # ZKP2P_NO_CACHE=1 is a global off-switch (every caller, including
     # in-process CLI drives inside the test suite): long full-suite runs
     # have segfaulted inside the persistent-cache WRITE path
@@ -54,7 +64,11 @@ def enable_cache(path: str | None = None) -> None:
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir(path))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # min_compile_s: the default 1.0 keeps trivial executables out of the
+    # cache; the warm-cache command and the tpu-shard smoke pass 0.0 so
+    # the toy-circuit compiles (sub-second on the virtual mesh) round-trip
+    # and the >=10x warm-start assertion has entries to hit.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", float(min_compile_s))
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
