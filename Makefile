@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke tpu-shard-smoke warm-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke perf-smoke perf-gate tpu-shard-smoke warm-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -147,6 +147,26 @@ sched-smoke: native
 # tiny-shape budgeted sweep end to end.  ~5 s on the 1-core box.
 tune-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_tune.py -q
+
+# Perf-regression sentry smoke (fast; tier-1 resident;
+# docs/OBSERVABILITY.md §perf sentry): ledger append/round-trip,
+# foreign-fingerprint + tampered-entry + schema-drift refusal, budget
+# derivation windows, overrun counting through a real service sweep
+# with a seeded `prove:hang` slowdown (and a clean replay that stays
+# quiet), alert fire/hold/clear hysteresis, gate fails-closed, and
+# ledger-on/off digest distinguishability.
+perf-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_perfledger.py -q
+
+# Drift gate (CI + the pre-hardware-window check): backfill the
+# committed BENCH_r*.json history into this host's ledger (idempotent)
+# and replay the ledger HEAD against the committed PERF_BASELINE.json
+# band.  Exit 0 = within band, 1 = DRIFT (a stage's head p50 exceeds
+# median x tolerance), 2 = fail closed (no baseline / no valid ledger
+# entries — a gate that cannot compare must not pass).  Rebaseline
+# with `zkp2p-tpu perf --rebaseline` after an intentional perf change.
+perf-gate:
+	env -u PALLAS_AXON_POOL_IPS python -m zkp2p_tpu.pipeline.cli perf --backfill --gate
 
 # Sharded-TPU-arm smoke (tier-1 resident; docs/TPU.md): the pjit
 # batch-axis prover on the 8-virtual-device CPU mesh — toy-circuit

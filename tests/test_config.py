@@ -102,6 +102,9 @@ def test_env_overrides_every_knob():
         "ZKP2P_TPU_MESH": "2x4",
         "ZKP2P_JAX_CACHE_DIR": "/tmp/jaxcache",
         "ZKP2P_WORKER_TIER": "sharded",
+        "ZKP2P_PERF_LEDGER": "0",
+        "ZKP2P_PERF_TOLERANCE": "2.25",
+        "ZKP2P_PERF_WINDOW": "12",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -145,6 +148,8 @@ def test_env_overrides_every_knob():
     assert cfg.tpu_shard == "on" and cfg.tpu_mesh == "2x4"
     assert cfg.jax_cache_dir == "/tmp/jaxcache"
     assert cfg.worker_tier == "sharded"
+    assert cfg.perf_ledger is False and cfg.perf_tolerance == 2.25
+    assert cfg.perf_window == 12
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -231,6 +236,19 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_SCALE_UP_S": "-1"}).scale_up_s == 0.0
     assert load_config(environ={"ZKP2P_SCALE_DOWN_S": "junk"}).scale_down_s == 30.0
     assert load_config(environ={}).sched_priority_default == "bulk"
+    # perf-sentry knobs: the gate follows the not-zero rule; the
+    # tolerance is a multiplier and must stay >= 1.0 (a sub-1 band
+    # would flag the median itself — malformed/too-small keeps 1.5);
+    # the window is a positive entry count
+    assert load_config(environ={}).perf_ledger is True  # default: sentry on
+    assert load_config(environ={"ZKP2P_PERF_LEDGER": "0"}).perf_ledger is False
+    assert load_config(environ={"ZKP2P_PERF_LEDGER": "true"}).perf_ledger is True
+    assert load_config(environ={"ZKP2P_PERF_TOLERANCE": "2.0"}).perf_tolerance == 2.0
+    assert load_config(environ={"ZKP2P_PERF_TOLERANCE": "0.5"}).perf_tolerance == 1.5
+    assert load_config(environ={"ZKP2P_PERF_TOLERANCE": "junk"}).perf_tolerance == 1.5
+    assert load_config(environ={"ZKP2P_PERF_WINDOW": "3"}).perf_window == 3
+    assert load_config(environ={"ZKP2P_PERF_WINDOW": "0"}).perf_window == 1
+    assert load_config(environ={"ZKP2P_PERF_WINDOW": "junk"}).perf_window == 8
 
 
 def test_armed_flags_whitelist_and_precedence(tmp_path):

@@ -241,6 +241,31 @@ def render_diff(agg_a: Dict[str, dict], agg_b: Dict[str, dict], label_a: str, la
     return "\n".join(lines)
 
 
+def digest_callout(runs_detail: List[dict], run_a: str, run_b: str) -> List[str]:
+    """The interleaved-A/B sanity line every bench note used to write
+    by hand: do the two runs share an execution digest (apples to
+    apples), and if not, WHICH gate arms differ — a perf delta between
+    digest-divergent runs is a code-path change, not a regression."""
+    by = {r["run_id"]: r for r in runs_detail}
+    a, b = by.get(run_a, {}), by.get(run_b, {})
+    da, db = a.get("execution_digest"), b.get("execution_digest")
+    if not da or not db:
+        missing = [r for r, d in ((run_a, da), (run_b, db)) if not d]
+        return [f"digest callout unavailable: no manifest digest for {', '.join(missing)}"]
+    if da == db:
+        return [f"digests MATCH ({da}) — same code paths, the delta is a real perf delta"]
+    lines = [f"digests DIFFER: A={da}  B={db} — the runs took different code paths"]
+    ga, gb = a.get("gates") or {}, b.get("gates") or {}
+    diffs = [
+        f"{g}={ga.get(g, '?')}->{gb.get(g, '?')}"
+        for g in sorted(set(ga) | set(gb))
+        if ga.get(g) != gb.get(g)
+    ]
+    if diffs:
+        lines.append("  differing arms: " + "  ".join(diffs))
+    return lines
+
+
 def chrome_trace(requests: List[dict], run: Optional[str] = None) -> dict:
     """Chrome trace-event JSON (loads in Perfetto / chrome://tracing)
     from the service's request records: **one pid per worker process,
@@ -630,6 +655,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--diff", nargs=2, metavar=("A", "B"),
         help="two run_ids (single input) or ignored-with-two-files A/B p50 diff",
     )
+    ap.add_argument(
+        "--compare", nargs=2, metavar=("RUN_A", "RUN_B"),
+        help="two run_ids: per-stage p50 diff table WITH the execution-digest "
+             "callout (match = real perf delta; differ = names the diverging arms)",
+    )
     ap.add_argument("--json", action="store_true", help="machine output (stages/requests/runs + digests)")
     ap.add_argument(
         "--chrome-trace", metavar="OUT",
@@ -691,6 +721,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(json.dumps({"runs": runs}))
         else:
             print(_runs_summary(runs))
+        return 0
+    if args.compare:
+        run_a, run_b = args.compare
+        agg_a = aggregate(stages, run=run_a)
+        agg_b = aggregate(stages, run=run_b)
+        if not agg_a or not agg_b:
+            print(f"no records for run_id {run_a if not agg_a else run_b}", file=sys.stderr)
+            return 1
+        callout = digest_callout(_runs_detail(stages, requests, manifests), run_a, run_b)
+        if args.json:
+            print(json.dumps({"a": agg_a, "b": agg_b, "digest_callout": callout}))
+        else:
+            print("\n".join(callout))
+            print(render_diff(agg_a, agg_b, run_a, run_b))
         return 0
     if args.diff:
         agg_a = aggregate(stages, run=args.diff[0])

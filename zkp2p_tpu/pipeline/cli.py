@@ -735,6 +735,17 @@ def cmd_tune(args):
     if prof is None:
         _log("tune: nothing tuned (native library unavailable)")
         sys.exit(1)
+    # perf-ledger stamp: the sweep's measured bests become one
+    # structured entry (source=tune) so host slowdowns show up as a
+    # trend across tunes, not just a changed profile on disk
+    try:
+        from ..utils.perfledger import record as perf_record, tune_stages
+
+        where = perf_record("tune", "microbench", tune_stages(prof))
+        if where:
+            _log(f"tune: sweep bests stamped into the perf ledger ({where})")
+    except Exception:  # noqa: BLE001 — observation must never fail the tune
+        pass
 
 
 def cmd_warm_cache(args):
@@ -820,6 +831,120 @@ def cmd_warm_cache(args):
     # round-trip proof
     if f1 - f0 == 0:
         _log("warm-cache: zero new cache entries — every executable loaded warm")
+    # perf-ledger stamp: the round trip's wall + backend_compile rail
+    # (source=warm_cache) — a cold-start regression (cache miss storm,
+    # slower deserialize) becomes a ledger trend, not a vibe
+    try:
+        from ..utils.perfledger import record as perf_record
+
+        wall_ms = round(dt * 1e3, 3)
+        compile_ms = round((s1 - s0) * 1e3, 3)
+        where = perf_record(
+            "warm_cache", args.circuit,
+            {
+                "warm_cache/wall": {"p50_ms": wall_ms, "p95_ms": wall_ms, "n": 1},
+                "warm_cache/backend_compile": {
+                    "p50_ms": compile_ms, "p95_ms": compile_ms,
+                    "n": max(1, int(ev1 - ev0)),
+                },
+            },
+        )
+        if where:
+            _log(f"warm-cache: round trip stamped into the perf ledger ({where})")
+    except Exception:  # noqa: BLE001 — observation must never fail the warm
+        pass
+
+
+def cmd_perf(args):
+    """Perf-regression sentry (utils.perfledger; docs/OBSERVABILITY.md
+    §perf sentry): render per-(circuit, stage) trendlines + regression
+    verdicts from the host's stage-cost ledger; `--backfill` imports
+    the committed BENCH_r*.json history, `--rebaseline` freezes current
+    budgets as PERF_BASELINE.json, `--gate` replays the ledger head
+    against the committed band and exits nonzero on drift (the `make
+    perf-gate` engine — rc 1 drift, rc 2 fail-closed)."""
+    from ..utils import perfledger as pl
+    from ..utils.config import load_config
+
+    did_action = False
+    if args.backfill:
+        did_action = True
+        n = pl.backfill_bench(log=_log)
+        _log(f"perf: backfill appended {n} entr{'y' if n == 1 else 'ies'}")
+    if args.rebaseline:
+        did_action = True
+        doc = pl.write_baseline(
+            baseline_path=args.baseline or None, ledger_path=args.ledger or None,
+            window=args.window, tolerance=args.tolerance,
+        )
+        if doc is None:
+            _log("perf: rebaseline FAILED — no valid ledger entries to freeze "
+                 "(run a bench / tune / service sweep, or --backfill, first)")
+            sys.exit(2)
+        bands = sum(len(v) for v in doc["bands"].values())
+        _log(f"perf: baseline frozen — {bands} band(s), "
+             f"window={doc['window']} tolerance={doc['tolerance']:g}")
+    if args.gate:
+        rc, verdicts = pl.gate_check(
+            baseline_path=args.baseline or None, ledger_path=args.ledger or None,
+            log=_log,
+        )
+        for v in verdicts:
+            if v["verdict"] in ("new", "gone"):
+                print(f"{v['verdict']:<6} {v['circuit']}/{v['stage']}")
+                continue
+            print(
+                f"{v['verdict']:<6} {v['circuit']}/{v['stage']}: "
+                f"head p50 {v['p50_ms']:.1f} ms vs budget {v['budget_ms']:.1f} ms "
+                f"(band median {v['median_ms']:.1f} ms)"
+            )
+        drifts = sum(1 for v in verdicts if v["verdict"] == "DRIFT")
+        print(f"perf-gate: {'DRIFT' if rc == 1 else 'FAIL CLOSED' if rc else 'ok'} "
+              f"({drifts} drifting stage(s) of {len(verdicts)})")
+        sys.exit(rc)
+    if did_action:
+        return
+    # default: trendlines + verdicts against the current budgets
+    entries, refused = pl.load_entries(args.ledger or None)
+    if not entries:
+        _log(f"perf: no valid ledger entries for this host (refused: {refused})")
+        sys.exit(1)
+    cfg = load_config()
+    budgets = pl.derive_budgets(entries, window=args.window, tolerance=args.tolerance)
+    series = {}
+    for e in entries:
+        circuit = str(e.get("circuit", "?"))
+        if args.circuit and circuit != args.circuit:
+            continue
+        for stage, st in e["stages"].items():
+            if args.stage and args.stage not in stage:
+                continue
+            series.setdefault((circuit, stage), []).append(float(st["p50_ms"]))
+    if args.json:
+        print(json.dumps({
+            "budgets": budgets,
+            "series": {f"{c}/{s}": v for (c, s), v in sorted(series.items())},
+            "refused": refused,
+        }, indent=1, sort_keys=True))
+        return
+    marks = "_.-=#"  # low..high within each stage's own range
+    for (circuit, stage), vals in sorted(series.items()):
+        lo, hi = min(vals), max(vals)
+        span = (hi - lo) or 1.0
+        line = "".join(marks[int((v - lo) / span * (len(marks) - 1))] for v in vals[-48:])
+        b = (budgets.get(circuit) or {}).get(stage)
+        if b is None:
+            verdict = "no-budget"
+        else:
+            verdict = "REGRESSED" if vals[-1] > b["budget_ms"] else "ok"
+        print(
+            f"{circuit}/{stage:<28} [{line}] last {vals[-1]:.1f} ms "
+            + (f"budget {b['budget_ms']:.1f} ms " if b else "")
+            + f"(n={len(vals)}) {verdict}"
+        )
+    if any(refused.values()):
+        _log(f"perf: refused entries: {refused} "
+             f"(window={cfg.perf_window} tolerance={cfg.perf_tolerance:g})")
 
 
 def main(argv=None):
@@ -1043,6 +1168,25 @@ def main(argv=None):
     # without importing jax or touching the compilation cache (the
     # circuit tier builds real circuits but still needs only numpy)
     s.set_defaults(fn=cmd_lint, no_jax=True)
+
+    s = sub.add_parser(
+        "perf",
+        help="perf-regression sentry: ledger trendlines, stage budgets, baseline drift gate",
+    )
+    s.add_argument("--ledger", default="", help="ledger path override (default: host-keyed beside .bench_cache)")
+    s.add_argument("--baseline", default="", help="baseline path (default: PERF_BASELINE.json at the repo root)")
+    s.add_argument("--circuit", default="", help="filter trendlines to one circuit label")
+    s.add_argument("--stage", default="", help="substring filter over stage names")
+    s.add_argument("--window", type=int, default=None, help="trailing-window override (ZKP2P_PERF_WINDOW)")
+    s.add_argument("--tolerance", type=float, default=None, help="budget multiplier override (ZKP2P_PERF_TOLERANCE)")
+    s.add_argument("--json", action="store_true", help="machine-readable budgets + series")
+    s.add_argument("--backfill", action="store_true", help="import committed BENCH_r*.json history (idempotent)")
+    s.add_argument("--rebaseline", action="store_true", help="freeze current budgets as the committed baseline band")
+    s.add_argument("--gate", action="store_true",
+                   help="replay the ledger head against the baseline band; rc 1 = drift, rc 2 = fail closed")
+    # no_jax: the sentry reads JSON on disk — it must answer in seconds
+    # (and run in CI) without paying a backend import
+    s.set_defaults(fn=cmd_perf, no_jax=True)
 
     s = sub.add_parser("batch", help="prove a directory of inputs as one batch")
     s.add_argument("--indir", required=True)

@@ -411,6 +411,7 @@ class ProvingService:
         spool_cap: Optional[int] = None,
         retries: Optional[int] = None,
         retry_backoff_s: Optional[float] = None,
+        circuit: str = "",
     ):
         """witness_fn: request payload -> witness vector (raises on bad
         input); public_fn: witness -> public signals.
@@ -489,6 +490,20 @@ class ProvingService:
         # (the `sched` block in fleet /status and `zkp2p-tpu top`)
         self._sched_ctl = None
         self._sched_hb: Optional[Dict] = None
+        # perf-regression sentry (utils.perfledger): the budget book
+        # every terminal request's spans are checked against, loaded
+        # lazily on the first terminal record (the gate and ledger are
+        # env/disk-derived — stable under a running service), the
+        # cumulative overrun/check counters the fleet heartbeat carries
+        # (`perf` block in fleet /status and `zkp2p-tpu top`), and the
+        # per-stage span samples the exit-time ledger stamp aggregates.
+        # `circuit` labels this service's ledger entries and selects its
+        # budget rows; "" = the generic "service" bucket.
+        self.circuit = circuit or "service"
+        self._perf_book = None
+        self._perf_lock = threading.Lock()
+        self._perf_hb: Optional[Dict] = None
+        self._perf_agg: Dict[str, List[float]] = {}
 
     def request_drain(self) -> None:
         """Flip the drain flag: stop claiming, finish in-flight work,
@@ -720,10 +735,76 @@ class ProvingService:
                     default_tracker().observe(time.time() - anchor, ok=(state == "done"))
             except Exception:  # noqa: BLE001 — observation only
                 pass
+            # perf sentry: this request's spans vs the ledger-derived
+            # stage budgets (utils.perfledger) — overruns are counted
+            # per stage and surfaced through the fleet heartbeat; spans
+            # also pool into the exit-time ledger stamp
+            try:
+                self._perf_check(req)
+            except Exception:  # noqa: BLE001 — observation only
+                pass
         else:
             # non-terminal sweep outcome (deferred): its own counter —
             # requests_total stays one-inc-per-TERMINAL-transition
             REGISTRY.counter("zkp2p_service_deferred_total").inc()
+
+    def _perf_check(self, req: Request) -> None:
+        """Check one terminal request's lifecycle spans against the
+        ledger-derived stage budgets (utils.perfledger.BudgetBook —
+        dict lookups only on this path; the book is loaded once).  An
+        over-budget span incs zkp2p_stage_budget_overruns_total{stage};
+        cumulative counts ride the fleet heartbeat as the `perf` block.
+        With the gate off the book is empty and this is a no-op beyond
+        the span pooling guard."""
+        from ..utils.perfledger import BudgetBook
+
+        book = self._perf_book
+        if book is None:
+            book = self._perf_book = BudgetBook.load(self.circuit)
+            REGISTRY.gauge("zkp2p_perf_budget_stages").set(float(len(book)))
+        if not req.spans:
+            return
+        overruns = checked = 0
+        with self._perf_lock:
+            for sp in req.spans:
+                name, ms = sp.get("name"), sp.get("ms")
+                if not name or ms is None:
+                    continue
+                # pool every span for the exit-time ledger stamp (a
+                # fresh host builds its first budgets from live sweeps)
+                self._perf_agg.setdefault(name, []).append(float(ms))
+                verdict = book.over(name, ms)
+                if verdict is None:
+                    continue  # no budget for this stage: never counts
+                checked += 1
+                if verdict:
+                    overruns += 1
+                    REGISTRY.counter(
+                        "zkp2p_stage_budget_overruns_total", {"stage": name}
+                    ).inc()
+            if self._perf_hb is None:
+                self._perf_hb = {"overruns": 0, "checked": 0, "budgets": len(book)}
+            self._perf_hb["overruns"] += overruns
+            self._perf_hb["checked"] += checked
+
+    def _perf_stamp(self) -> None:
+        """Exit-time ledger stamp: one entry aggregating this run's
+        terminal-request span costs (source=service), gated inside
+        perfledger.record by ZKP2P_PERF_LEDGER.  Sampling at run
+        granularity — not per request — is what keeps the ledger's
+        steady-state overhead under the documented <1%."""
+        from ..utils.perfledger import record as perf_record, stage_stats
+
+        with self._perf_lock:
+            agg, self._perf_agg = self._perf_agg, {}
+        stages = {
+            name: stats
+            for name, samples in agg.items()
+            for stats in [stage_stats(samples)]
+            if stats is not None
+        }
+        if stages:
+            perf_record("service", self.circuit, stages, run_id=run_id())
 
     def _record_deferred(
         self,
@@ -1713,10 +1794,15 @@ class ProvingService:
         # the sampler appends zkp2p_timeseries lines to the same sink
         # the request records ride.
         from ..utils.config import load_config
+        from ..utils.perfledger import perf_arm
         from ..utils.slo import slo_arm, timeseries_arm
 
         slo_arm()
         timeseries_arm()
+        # perf-ledger gate: the stage-budget sentry (utils.perfledger)
+        # — armed here so a ledger-on service run never shares a digest
+        # with the ledger-off oracle arm
+        perf_arm()
         # fleet membership gate: "worker" when the supervisor stamped an
         # identity into our env, else "off" — a fleet member and a solo
         # service are digest-distinguishable code paths (the ONE
@@ -1807,6 +1893,13 @@ class ProvingService:
         # RECORDED), and the fleet heartbeat says "draining" so the
         # supervisor sees a deliberate exit, not a hang
         _flush()
+        # perf-ledger stamp: this run's aggregated span costs become
+        # one `source=service` ledger entry (gate-checked inside) —
+        # the live-sweep sample the next run's budgets are derived from
+        try:
+            self._perf_stamp()
+        except Exception:  # noqa: BLE001 — observation only
+            pass
         if hb_stop is not None:
             hb_stop.set()
         if fleet_dir:

@@ -430,6 +430,13 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
 
     profile_arm()
 
+    # perf-ledger gate (utils.perfledger): the stage-cost ledger and
+    # its live budgets — a ledger-on run must never share a digest with
+    # the ledger-off oracle arm of an overhead A/B
+    from .perfledger import perf_arm
+
+    perf_arm()
+
     if workload and backend != "unavailable":
         # one tiny jitted op: proves the backend executes and ticks the
         # compile listener.  Deliberately NOT a gated field mul — a

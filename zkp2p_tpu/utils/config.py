@@ -106,6 +106,20 @@ def _nonneg_float(default: float):
     return parse
 
 
+def _min_one_float(default: float):
+    # perf budget multiplier: must be >= 1.0 — a budget BELOW the
+    # trailing median would page on every healthy request; malformed or
+    # out-of-range keeps the committed default
+    def parse(s: str) -> float:
+        try:
+            v = float(s)
+        except ValueError:
+            return default
+        return v if v >= 1.0 else default
+
+    return parse
+
+
 def _fraction(default: float):
     # SLO target fraction: must land strictly inside (0, 1) — a target
     # of 0 or 1 makes the burn-rate denominator meaningless; malformed
@@ -372,6 +386,15 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     "tpu_mesh": ("ZKP2P_TPU_MESH", str, ""),
     "jax_cache_dir": ("ZKP2P_JAX_CACHE_DIR", str, ""),
     "worker_tier": ("ZKP2P_WORKER_TIER", str, ""),
+    # perf-regression sentry (utils.perfledger; docs/OBSERVABILITY.md
+    # §perf sentry): the stage-cost ledger gate ("0" = the whole
+    # subsystem off — no appends, no budgets, no overrun counting; the
+    # fail-closed oracle arm of a ledger A/B), the budget multiplier
+    # over the trailing-window median (>= 1.0), and the trailing-window
+    # length in ledger entries the median is taken over.
+    "perf_ledger": ("ZKP2P_PERF_LEDGER", _not_zero, True),
+    "perf_tolerance": ("ZKP2P_PERF_TOLERANCE", _min_one_float(1.5), 1.5),
+    "perf_window": ("ZKP2P_PERF_WINDOW", _pos_int(8), 8),
 }
 
 # The ONLY knobs a hardware-session side-file may arm (bench.py's
@@ -379,7 +402,7 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
 ARMABLE = (
     "msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap",
     "msm_multi", "msm_precomp", "matvec_seg", "ntt_pool", "sched",
-    "profile", "tpu_shard", "worker_tier",
+    "profile", "tpu_shard", "worker_tier", "perf_ledger",
 )
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
@@ -458,6 +481,9 @@ class ProverConfig:
     tpu_mesh: str = ""
     jax_cache_dir: str = ""
     worker_tier: str = ""
+    perf_ledger: bool = True
+    perf_tolerance: float = 1.5
+    perf_window: int = 8
     # knob -> "default" | "armed" | "env"
     provenance: Dict[str, str] = field(default_factory=dict, compare=False)
 
