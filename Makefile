@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke perf-smoke perf-gate tpu-shard-smoke warm-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke perf-smoke flame-smoke perf-gate tpu-shard-smoke warm-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -157,6 +157,17 @@ tune-smoke: native
 # ledger-on/off digest distinguishability.
 perf-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_perfledger.py -q
+
+# Flame-sampler smoke (fast; tier-1 resident; docs/OBSERVABILITY.md
+# §flame profiler): gate off = no thread/no captures + digest
+# distinguishability, collapsed-stack folding of a hot Python loop,
+# synthetic native-frame stitching from stats-block deltas, trigger/
+# cooldown/capture_n controller behavior, atomic capture writes with
+# fail-closed loading, the overrun->capture closed loop through a real
+# service sweep, fleet `top` capture-pointer rendering, and the
+# trace_report --flame track.
+flame-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_flameprof.py -q
 
 # Drift gate (CI + the pre-hardware-window check): backfill the
 # committed BENCH_r*.json history into this host's ledger (idempotent)

@@ -96,6 +96,7 @@ enum StatSlot {
   ST_MATVEC_NS,               // wall ns inside fr_matvec + fr_matvec_seg
   ST_MATVEC_SEG_CALLS,        // segmented-plan matvec driver entries
   ST_NTT_STAGE_NS,            // wall ns inside the vectorized NTT stage pipeline
+  ST_MSM_INFLIGHT,            // MSM driver entries currently executing (gauge)
   ST_COUNT
 };
 static std::atomic<long long> g_stats[ST_COUNT];
@@ -111,6 +112,15 @@ static inline void stat_max(int slot, long long v) {
          !g_stats[slot].compare_exchange_weak(cur, v, std::memory_order_relaxed)) {
   }
 }
+// Scoped in-flight gauge: +1 on driver entry, -1 on EVERY exit path
+// (RAII covers early returns).  An external sampler reading the stats
+// block mid-call can tell "an MSM is executing right now" apart from
+// "the wall counters moved between my two reads".
+struct InflightStat {
+  int slot;
+  explicit InflightStat(int s) : slot(s) { stat_add(slot, 1); }
+  ~InflightStat() { stat_add(slot, -1); }
+};
 
 extern "C" {
 int zkp2p_stats_count(void) { return ST_COUNT; }
@@ -5319,6 +5329,7 @@ static void g1_pippenger_core_multi(const u64 *pb, const int32_t *const *sds,
 void g1_msm_pippenger_mt(const u64 *bases_xy, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out_xy) {
   long long t0 = prof_now_ns();
+  InflightStat _ifs(ST_MSM_INFLIGHT);
   stat_add(ST_MSM_G1_CALLS, 1);
   stat_add(ST_MSM_POINTS, n);
   stat_set(ST_MSM_WINDOW_LAST, c);
@@ -5488,6 +5499,7 @@ void g1_msm_pippenger_glv_mt(const u64 *bases2_xy, const u64 *scalars, long n,
                              long nb, int c, int n_threads,
                              const u64 *glv_consts, int glv_bits, u64 *out_xy) {
   long long t0 = prof_now_ns();
+  InflightStat _ifs(ST_MSM_INFLIGHT);
   stat_add(ST_MSM_GLV_CALLS, 1);
   stat_add(ST_MSM_POINTS, n);
   stat_set(ST_MSM_WINDOW_LAST, c);
@@ -5554,6 +5566,7 @@ void g1_msm_pippenger_multi(const u64 *bases_xy, const u64 *scalars, long n,
                             int S, int c, int n_threads, u64 *out_xy) {
   if (S <= 0) return;
   long long t0 = prof_now_ns();
+  InflightStat _ifs(ST_MSM_INFLIGHT);
   stat_add(ST_MSM_MULTI_CALLS, 1);
   stat_add(ST_MSM_MULTI_COLS, S);
   stat_set(ST_MSM_MULTI_COLS_LAST, S);
@@ -5631,6 +5644,7 @@ void g1_msm_pippenger_glv_multi(const u64 *bases2_xy, const u64 *scalars,
                                 u64 *out_xy) {
   if (S <= 0) return;
   long long t0 = prof_now_ns();
+  InflightStat _ifs(ST_MSM_INFLIGHT);
   stat_add(ST_MSM_MULTI_CALLS, 1);
   stat_add(ST_MSM_MULTI_COLS, S);
   stat_set(ST_MSM_MULTI_COLS_LAST, S);
@@ -5860,6 +5874,7 @@ void g1_msm_pippenger_fixed(const u64 *table_xy, const u64 *table52,
                             const u64 *scalars, long nsc, long n, int levels,
                             int c, int q, int n_threads, u64 *out_xy) {
   long long t0 = prof_now_ns();
+  InflightStat _ifs(ST_MSM_INFLIGHT);
   stat_add(ST_MSM_FIXED_CALLS, 1);
   stat_add(ST_MSM_G1_CALLS, 1);
   stat_add(ST_MSM_POINTS, nsc);
@@ -5916,6 +5931,7 @@ void g1_msm_pippenger_fixed_multi(const u64 *table_xy, const u64 *table52,
                                   u64 *out_xy) {
   if (S <= 0) return;
   long long t0 = prof_now_ns();
+  InflightStat _ifs(ST_MSM_INFLIGHT);
   stat_add(ST_MSM_FIXED_CALLS, 1);
   stat_add(ST_MSM_MULTI_CALLS, 1);
   stat_add(ST_MSM_MULTI_COLS, S);
@@ -6063,6 +6079,7 @@ void g1_scale_batch(const u64 *bases_xy, long n, const u64 *scalar, u64 *out_xy)
 void g2_msm_pippenger_mt(const u64 *bases, const u64 *scalars, long n,
                          int c, int n_threads, u64 *out) {
   long long t0 = prof_now_ns();
+  InflightStat _ifs(ST_MSM_INFLIGHT);
   stat_add(ST_MSM_G2_CALLS, 1);
   stat_add(ST_MSM_POINTS, n);
   stat_set(ST_MSM_WINDOW_LAST, c);

@@ -105,6 +105,10 @@ def test_env_overrides_every_knob():
         "ZKP2P_PERF_LEDGER": "0",
         "ZKP2P_PERF_TOLERANCE": "2.25",
         "ZKP2P_PERF_WINDOW": "12",
+        "ZKP2P_FLAME": "1",
+        "ZKP2P_FLAME_HZ": "31",
+        "ZKP2P_FLAME_CAPTURE_N": "3",
+        "ZKP2P_FLAME_COOLDOWN_S": "15",
     }
     cfg = load_config(environ=env)
     assert cfg.msm_window == 8 and cfg.msm_signed is False
@@ -150,6 +154,8 @@ def test_env_overrides_every_knob():
     assert cfg.worker_tier == "sharded"
     assert cfg.perf_ledger is False and cfg.perf_tolerance == 2.25
     assert cfg.perf_window == 12
+    assert cfg.flame is True and cfg.flame_hz == 31.0
+    assert cfg.flame_capture_n == 3 and cfg.flame_cooldown_s == 15.0
     assert all(v == "env" for v in cfg.provenance.values())
 
 
@@ -249,6 +255,24 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_PERF_WINDOW": "3"}).perf_window == 3
     assert load_config(environ={"ZKP2P_PERF_WINDOW": "0"}).perf_window == 1
     assert load_config(environ={"ZKP2P_PERF_WINDOW": "junk"}).perf_window == 8
+    # flame-sampler knobs: gate default OFF (not-zero rule), the rate
+    # must stay strictly positive (a 0 Hz sampler parks forever —
+    # malformed/non-positive keeps the prime 47), capture_n is a
+    # positive sweep count, cooldown 0 = unlimited captures
+    assert load_config(environ={}).flame is False  # default: sampler off
+    assert load_config(environ={"ZKP2P_FLAME": "1"}).flame is True
+    assert load_config(environ={"ZKP2P_FLAME": "0"}).flame is False
+    assert load_config(environ={"ZKP2P_FLAME": "yes"}).flame is True
+    assert load_config(environ={"ZKP2P_FLAME_HZ": "101"}).flame_hz == 101.0
+    assert load_config(environ={"ZKP2P_FLAME_HZ": "0"}).flame_hz == 47.0
+    assert load_config(environ={"ZKP2P_FLAME_HZ": "-5"}).flame_hz == 47.0
+    assert load_config(environ={"ZKP2P_FLAME_HZ": "junk"}).flame_hz == 47.0
+    assert load_config(environ={"ZKP2P_FLAME_CAPTURE_N": "5"}).flame_capture_n == 5
+    assert load_config(environ={"ZKP2P_FLAME_CAPTURE_N": "0"}).flame_capture_n == 1
+    assert load_config(environ={"ZKP2P_FLAME_CAPTURE_N": "junk"}).flame_capture_n == 2
+    assert load_config(environ={"ZKP2P_FLAME_COOLDOWN_S": "0"}).flame_cooldown_s == 0.0
+    assert load_config(environ={"ZKP2P_FLAME_COOLDOWN_S": "-3"}).flame_cooldown_s == 0.0
+    assert load_config(environ={"ZKP2P_FLAME_COOLDOWN_S": "junk"}).flame_cooldown_s == 60.0
 
 
 def test_armed_flags_whitelist_and_precedence(tmp_path):

@@ -113,6 +113,7 @@ STATS_FIELDS = (
     "matvec_ns",
     "matvec_seg_calls",
     "ntt_stage_ns",
+    "msm_inflight",
 )
 
 

@@ -855,6 +855,74 @@ def cmd_warm_cache(args):
         pass
 
 
+def cmd_flame(args):
+    """On-demand flame profile (utils.flameprof; docs/OBSERVABILITY.md
+    §flame profiler): run a real prove loop under the sampling profiler
+    for --duration seconds, print the collapsed-stack profile
+    (flamegraph.pl wire format — pipe into flamegraph.pl directly) and
+    write a trigger="manual" capture file beside .bench_cache, which
+    `tools/trace_report.py --flame <capture> --chrome-trace out.json`
+    merges into a Perfetto track."""
+    from ..utils import flameprof
+    from ..utils.config import load_config
+
+    # flags are TRANSPORT: arm the gate for this invocation so the
+    # sampler may run and the recorded arm (and digest) reflect it
+    os.environ["ZKP2P_FLAME"] = "1"
+    if args.hz is not None:
+        os.environ["ZKP2P_FLAME_HZ"] = str(args.hz)
+    _log(f"flame: arm {flameprof.flame_arm()}")
+    cfg = load_config()
+
+    from ..prover.groth16_tpu import device_pk_from_zkey
+
+    prove_fn = _prover_fn(args)
+    cs, meta = _build_circuit(args.circuit, args.max_header, args.max_body)
+    try:
+        zk = _load_zkey(args)
+        _check_zkey_matches(zk, cs)
+        dpk = device_pk_from_zkey(zk, infer_widths=_infer_widths(args))
+    except (OSError, SystemExit):
+        # no zkey on disk: a dev setup keeps the command self-contained
+        # (the profile's shape is what matters, not the key's origin)
+        _log("flame: no zkey found — running the dev setup in-process")
+        from ..prover.groth16_tpu import device_pk
+        from ..snark.groth16 import setup
+
+        pk, _vk = setup(cs, seed="flame-profile")
+        dpk = device_pk(pk, cs)
+    w, _pub = _witness_for(args, cs, meta)
+
+    # one warmup prove OUTSIDE the sampler: first-call compiles and
+    # table builds are real costs, but not the steady state a profile
+    # is meant to attribute
+    prove_fn(dpk, w)
+
+    sampler = flameprof.FlameSampler(hz=cfg.flame_hz).start()
+    t0 = time.perf_counter()
+    proves = 0
+    while True:
+        prove_fn(dpk, w)
+        proves += 1
+        if time.perf_counter() - t0 >= args.duration:
+            break
+    path = flameprof.write_capture(
+        sampler, circuit=args.circuit, stage="on-demand", trigger="manual",
+    )
+    body = sampler.result()
+    _log(
+        f"flame: {proves} prove(s) in {body['duration_s']:.1f}s — "
+        f"{body['samples']} samples over {body['windows']} windows "
+        f"@ {cfg.flame_hz:g} Hz, sampler self-cost "
+        f"{body['sampler']['self_ms']:.1f} ms"
+    )
+    if path:
+        _log(f"flame: capture -> {path}")
+    else:
+        _log("flame: capture NOT persisted (cache dir disabled)")
+    print(flameprof.collapsed_text(body["stacks"]))
+
+
 def cmd_perf(args):
     """Perf-regression sentry (utils.perfledger; docs/OBSERVABILITY.md
     §perf sentry): render per-(circuit, stage) trendlines + regression
@@ -863,6 +931,7 @@ def cmd_perf(args):
     budgets as PERF_BASELINE.json, `--gate` replays the ledger head
     against the committed band and exits nonzero on drift (the `make
     perf-gate` engine — rc 1 drift, rc 2 fail-closed)."""
+    from ..utils import flameprof
     from ..utils import perfledger as pl
     from ..utils.config import load_config
 
@@ -898,6 +967,14 @@ def cmd_perf(args):
                 f"head p50 {v['p50_ms']:.1f} ms vs budget {v['budget_ms']:.1f} ms "
                 f"(band median {v['median_ms']:.1f} ms)"
             )
+            # DRIFT -> the flame capture that shows WHY (utils.flameprof)
+            if v["verdict"] == "DRIFT":
+                for cpath, cdoc in flameprof.captures_for(
+                    v["circuit"], v["stage"]
+                )[:1]:
+                    print(f"       capture: {cpath} "
+                          f"(trigger {cdoc.get('trigger')}, "
+                          f"entry {cdoc.get('entry_digest')})")
         drifts = sum(1 for v in verdicts if v["verdict"] == "DRIFT")
         print(f"perf-gate: {'DRIFT' if rc == 1 else 'FAIL CLOSED' if rc else 'ok'} "
               f"({drifts} drifting stage(s) of {len(verdicts)})")
@@ -942,6 +1019,13 @@ def cmd_perf(args):
             + (f"budget {b['budget_ms']:.1f} ms " if b else "")
             + f"(n={len(vals)}) {verdict}"
         )
+        # a REGRESSED stage with an overrun-triggered capture on disk
+        # gets the pointer printed under its trendline — the sentry's
+        # "that" row linked to the sampler's "why" file
+        if verdict == "REGRESSED":
+            for cpath, cdoc in flameprof.captures_for(circuit, stage)[:1]:
+                print(f"    capture: {cpath} (trigger {cdoc.get('trigger')}, "
+                      f"entry {cdoc.get('entry_digest')})")
     if any(refused.values()):
         _log(f"perf: refused entries: {refused} "
              f"(window={cfg.perf_window} tolerance={cfg.perf_tolerance:g})")
@@ -1168,6 +1252,28 @@ def main(argv=None):
     # without importing jax or touching the compilation cache (the
     # circuit tier builds real circuits but still needs only numpy)
     s.set_defaults(fn=cmd_lint, no_jax=True)
+
+    s = sub.add_parser(
+        "flame",
+        help="on-demand flame profile: a real prove loop under the sampler -> "
+             "collapsed stacks on stdout + a capture file beside .bench_cache",
+    )
+    s.add_argument("--duration", type=float, default=30.0,
+                   help="prove-loop wall clock in s (at least one prove always runs)")
+    s.add_argument("--hz", type=float, default=None,
+                   help="sampling rate override (default: ZKP2P_FLAME_HZ)")
+    s.add_argument("--zkey", help="zkey path or chunk glob (default: BUILD_DIR/"
+                                  "circuit_final.zkey; missing = in-process dev setup)")
+    s.add_argument("--no-infer-widths", action="store_true",
+                   help="disable the zkey bit-constraint width inference")
+    s.add_argument("--prover", choices=["tpu", "native"], default="native",
+                   help="prover arm under the sampler (native = the C runtime "
+                        "the synthetic frames attribute)")
+    s.add_argument("--message", help=argparse.SUPPRESS)
+    s.add_argument("--eml", help=argparse.SUPPRESS)
+    s.add_argument("--order-id", type=int, default=1)
+    s.add_argument("--claim-id", type=int, default=0)
+    s.set_defaults(fn=cmd_flame)
 
     s = sub.add_parser(
         "perf",

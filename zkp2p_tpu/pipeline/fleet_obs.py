@@ -553,8 +553,15 @@ def render_top(body: Dict) -> str:
         lines.append("alerts: none firing")
     workers = body.get("workers") or {}
     if workers:
+        # flame column only when some worker's perf block carries a
+        # capture pointer (utils.flameprof via the heartbeat): a fresh
+        # fleet with no captures renders the PR-18 table unchanged
+        flame_col = any(
+            (w.get("perf") or {}).get("capture") for w in workers.values()
+        )
         lines.append(f"{'worker':<8} {'state':<9} {'pid':>7} {'port':>6} "
-                     f"{'restarts':>8} {'rss_mb':>8} {'hb_age':>7} {'degr':>5} {'overrun':>8}")
+                     f"{'restarts':>8} {'rss_mb':>8} {'hb_age':>7} {'degr':>5} {'overrun':>8}"
+                     + ("  flame" if flame_col else ""))
         for wid in sorted(workers):
             w = workers[wid]
             rss = w.get("rss_mb")
@@ -564,6 +571,7 @@ def render_top(body: Dict) -> str:
             # a clean bill of health)
             perf = w.get("perf") or {}
             over = perf.get("overruns") if perf.get("budgets") else None
+            cap = perf.get("capture") or {}
             lines.append(
                 f"{wid:<8} {w.get('state', '?'):<9} {str(w.get('pid') or '-'):>7} "
                 f"{str(w.get('port') or '-'):>6} {w.get('restarts', 0):>8} "
@@ -571,6 +579,7 @@ def render_top(body: Dict) -> str:
                 f"{(f'{age:.1f}' if isinstance(age, (int, float)) else '-'):>7} "
                 f"{('y' if w.get('degraded') else '-'):>5} "
                 f"{(str(over) if isinstance(over, (int, float)) else '-'):>8}"
+                + (f"  {cap.get('file', '-')}" if flame_col else "")
             )
     scrape = body.get("scrape") or {}
     if scrape:

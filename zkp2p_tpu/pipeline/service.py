@@ -782,6 +782,23 @@ class ProvingService:
                     REGISTRY.counter(
                         "zkp2p_stage_budget_overruns_total", {"stage": name}
                     ).inc()
+                    # overrun-triggered flame capture (utils.flameprof):
+                    # gated by ZKP2P_FLAME, one capture at a time,
+                    # cooldown-limited — the sentry's "why" half.  The
+                    # capture cross-links the budget's ledger head
+                    # digest so `zkp2p-tpu perf` can walk DRIFT ->
+                    # capture file.
+                    try:
+                        from ..utils.flameprof import controller as _flame
+
+                        _flame().trigger(
+                            self.circuit, name,
+                            entry_digest=book.head_digest(name),
+                            budget_ms=book.budget_ms(name),
+                            over_ms=float(ms),
+                        )
+                    except Exception:  # noqa: BLE001 — observation only
+                        pass
             if self._perf_hb is None:
                 self._perf_hb = {"overruns": 0, "checked": 0, "budgets": len(book)}
             self._perf_hb["overruns"] += overruns
@@ -1635,6 +1652,21 @@ class ProvingService:
             # and no record this sweep — the claim-file discipline means
             # a later sweep (or another worker) picks them up.
             raise producer_error[0]
+        # flame sweep boundary: an overrun-triggered capture spans the
+        # next flame_capture_n FULL sweeps after its trigger; when this
+        # tick completes one, the pointer rides the heartbeat perf
+        # block so `zkp2p-tpu top` can name the capture file
+        try:
+            from ..utils.flameprof import controller as _flame
+
+            if _flame().sweep_tick() is not None:
+                ptr = _flame().pointer()
+                with self._perf_lock:
+                    if self._perf_hb is None:
+                        self._perf_hb = {"overruns": 0, "checked": 0, "budgets": 0}
+                    self._perf_hb["capture"] = ptr
+        except Exception:  # noqa: BLE001 — observation must never fail a sweep
+            pass
         return stats
 
     def _consume(self, spool, ready_q, knobs, stats) -> None:
@@ -1794,6 +1826,7 @@ class ProvingService:
         # the sampler appends zkp2p_timeseries lines to the same sink
         # the request records ride.
         from ..utils.config import load_config
+        from ..utils.flameprof import flame_arm
         from ..utils.perfledger import perf_arm
         from ..utils.slo import slo_arm, timeseries_arm
 
@@ -1803,6 +1836,10 @@ class ProvingService:
         # — armed here so a ledger-on service run never shares a digest
         # with the ledger-off oracle arm
         perf_arm()
+        # flame-sampler gate: overrun-triggered captures ride the perf
+        # sentry (utils.flameprof) — armed here so a sampler-on run
+        # never shares a digest with the zero-overhead off arm
+        flame_arm()
         # fleet membership gate: "worker" when the supervisor stamped an
         # identity into our env, else "off" — a fleet member and a solo
         # service are digest-distinguishable code paths (the ONE

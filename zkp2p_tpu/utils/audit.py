@@ -437,6 +437,13 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
 
     perf_arm()
 
+    # flame-sampler gate (utils.flameprof): the in-process sampling
+    # profiler + overrun-triggered captures — the arm carries the
+    # sampling rate, so runs at different Hz are distinguishable too
+    from .flameprof import flame_arm
+
+    flame_arm()
+
     if workload and backend != "unavailable":
         # one tiny jitted op: proves the backend executes and ticks the
         # compile listener.  Deliberately NOT a gated field mul — a

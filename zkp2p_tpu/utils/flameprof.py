@@ -1,0 +1,597 @@
+"""Continuous profiling: the in-process flame sampler (ISSUE 19).
+
+The perf sentry (utils.perfledger) says *that* a stage regressed;
+nothing in the tree said *why* — attribution meant a hand-run of
+`tools/msm_native_prof.py` on a quiet box, useless against a transient
+regression on a live fleet.  This module closes that loop:
+
+  - `FlameSampler` — a daemon thread samples `sys._current_frames()` of
+    every other thread at ZKP2P_FLAME_HZ (default 47 Hz, prime so the
+    sampler never phase-locks with periodic stage work) and folds each
+    stack into collapsed-stack counts (the flamegraph.pl wire format:
+    `root;child;leaf N`).
+  - *Synthetic native frames* — long ctypes calls release the GIL and
+    park the Python stack at the bridge frame, so a pure-Python sampler
+    would show one opaque tower.  Each sample window is bracketed with
+    deltas from the C runtime's always-on stats block
+    (`native/lib.py stats_snapshot`: msm wall/fill/suffix/apply ns,
+    `matvec_ns`, `ntt_stage_ns`, and the new `msm_inflight` gauge); a
+    thread observed parked at a bridge file while native counters moved
+    gets `native:<stage>` frames stitched UNDER its parked frame.
+    Native self-time that accrues with no parked thread observed (pool
+    workers doing the heavy part) folds under a synthetic `[native]`
+    root at finalization, one count per expected sample
+    (`max(1, round(ns * hz / 1e9))`) — nothing measured is dropped.
+  - `CaptureController` — the sentry hook: `service._perf_check`
+    triggers a capture on a stage budget overrun; the next
+    ZKP2P_FLAME_CAPTURE_N service sweeps run under the sampler, then an
+    atomic `flame_<circuit>_<stage>_<ts>.json` lands beside
+    `.bench_cache`, rate-limited by ZKP2P_FLAME_COOLDOWN_S, counted in
+    `zkp2p_flame_captures_total{trigger}`, and pointed to from the
+    heartbeat perf block (federated into `zkp2p-tpu top`).  Each
+    capture records the perf-ledger head entry_digest it was judged
+    against, so `zkp2p-tpu perf` can walk DRIFT verdict -> capture.
+
+Gating: ZKP2P_FLAME (`flame` knob, default OFF) is record_arm'd and
+preflight-armed; a sampler-on run never shares an execution digest
+with a sampler-off one.  Off means fully off — no thread, no captures,
+the zero-overhead oracle arm of the overhead A/B.
+
+Honest overhead: the sampler clocks its own per-tick work
+(`sampler.self_ms` in every capture) and the A/B protocol + measured
+numbers live in docs/OBSERVABILITY.md §flame profiler.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+CAPTURE_SCHEMA = 1
+CAPTURE_KIND = "zkp2p_flame_capture"
+CAPTURE_PREFIX = "flame_"
+
+# Path suffixes (normalized to "/") of the Python files that host the
+# ctypes bridge calls: a thread whose LEAF frame sits in one of these
+# while native counters move is parked under a GIL-released native
+# call, and earns synthetic native frames.  Module-level so tests can
+# monkeypatch the set.
+BRIDGE_SUFFIXES = (
+    "native/lib.py",
+    "prover/native_prove.py",
+    "prover/precomp.py",
+    "prover/matvec_plan.py",
+)
+
+# stats-block fields the window deltas are taken over; `stage` name ->
+# the wall-ns field that measures it
+_STAGE_NS_FIELDS = {
+    "msm": "msm_wall_ns",
+    "matvec": "matvec_ns",
+    "ntt": "ntt_stage_ns",
+}
+# msm sub-frame attribution: child frame name -> fill/suffix/apply ns
+_MSM_SUB_FIELDS = {
+    "fill": "msm_fill_ns",
+    "suffix": "msm_suffix_ns",
+    "apply": "msm_apply_ns",
+}
+_MAX_DEPTH = 64  # frames kept per stack (root-most are dropped beyond it)
+
+
+def flame_arm() -> str:
+    """Resolve + arm the flame-sampler gate (the preflight hook):
+    "off" | "<hz>hz".  The arm string carries the sampling rate so two
+    runs at different rates are digest-distinguishable too."""
+    from .audit import record_arm
+    from .config import load_config
+
+    cfg = load_config()
+    return record_arm("flame", f"{cfg.flame_hz:g}hz" if cfg.flame else "off")
+
+
+def _is_bridge_file(filename: str) -> bool:
+    return filename.replace(os.sep, "/").endswith(BRIDGE_SUFFIXES)
+
+
+# code object -> "file.py:func" label memo.  Keyed on the code object
+# itself (not id(): ids recycle after GC and would mislabel).  Bounded
+# by the number of live code objects; the held refs pin them, which is
+# the same lifetime the interpreter's own caches give hot code.
+_label_memo: Dict[object, str] = {}
+
+
+def _fold(frame, depth: int = _MAX_DEPTH) -> List[str]:
+    """One thread's stack as root-first `file.py:func` frames.  This is
+    the sampler's hot loop — label formatting is memoized per code
+    object so a steady-state sample is dict hits plus one list."""
+    out: List[str] = []
+    while frame is not None and len(out) < depth:
+        code = frame.f_code
+        label = _label_memo.get(code)
+        if label is None:
+            label = f"{os.path.basename(code.co_filename)}:{code.co_name}"
+            _label_memo[code] = label
+        out.append(label)
+        frame = frame.f_back
+    out.reverse()
+    return out
+
+
+class _NativeStatsReader:
+    """Per-tick stats reads on the sampler's hot path.  The general
+    `stats_snapshot()` rebuilds ctypes argtypes, a numpy buffer, and a
+    32-field dict on every call — ~240 µs/tick measured under a
+    bus-saturated prove, most of the sampler's budget.  This reader
+    binds the call and buffer ONCE and extracts only the fields the
+    window deltas consume; a missing/stale lib degrades to None (pure
+    Python sampling), never an exception."""
+
+    _FIELDS = tuple(
+        set(_STAGE_NS_FIELDS.values())
+        | set(_MSM_SUB_FIELDS.values())
+        | {"msm_inflight"}
+    )
+
+    def __init__(self):
+        self._fn = None
+        try:
+            import ctypes
+
+            import numpy as np
+
+            from ..native.lib import STATS_FIELDS, get_lib
+
+            lib = get_lib()
+            if lib is None or not hasattr(lib, "zkp2p_stats_count"):
+                return
+            n = int(lib.zkp2p_stats_count())
+            self._buf = np.zeros(max(n, len(STATS_FIELDS)), dtype=np.int64)
+            self._ptr = self._buf.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_longlong)
+            )
+            lib.zkp2p_stats_snapshot.argtypes = [
+                ctypes.POINTER(ctypes.c_longlong)
+            ]
+            self._idx = {
+                f: STATS_FIELDS.index(f)
+                for f in self._FIELDS
+                if f in STATS_FIELDS
+            }
+            self._fn = lib.zkp2p_stats_snapshot
+        except Exception:  # noqa: BLE001 — observation must degrade
+            self._fn = None
+
+    def __call__(self) -> Optional[dict]:
+        if self._fn is None:
+            return None
+        self._fn(self._ptr)
+        buf = self._buf
+        return {f: int(buf[i]) for f, i in self._idx.items()}
+
+
+class FlameSampler:
+    """Background sampling profiler.  start() spawns the daemon thread;
+    stop() joins it and freezes the folded counts; result() returns the
+    capture body (stacks + native attribution + sampler self-cost).
+
+    `stats_source` is injectable for tests (a callable returning a
+    stats_snapshot-shaped dict or None); `thread_filter` optionally
+    restricts sampling to a set of thread idents."""
+
+    def __init__(
+        self,
+        hz: float,
+        stats_source: Optional[Callable[[], Optional[dict]]] = None,
+        thread_filter: Optional[set] = None,
+    ):
+        self.hz = max(0.001, float(hz))
+        self._stats = stats_source or _NativeStatsReader()
+        self._filter = thread_filter
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._native_ns: Dict[str, int] = {s: 0 for s in _STAGE_NS_FIELDS}
+        self._unattributed_ns: Dict[str, int] = {s: 0 for s in _STAGE_NS_FIELDS}
+        self._prev_snap: Optional[dict] = None
+        self.samples = 0
+        self.windows = 0
+        self._self_s = 0.0
+        self._t_start: Optional[float] = None
+        self.duration_s = 0.0
+
+    # -- lifecycle ---------------------------------------------------
+
+    def start(self) -> "FlameSampler":
+        if self._thread is not None:
+            return self
+        self._prev_snap = self._stats()
+        self._t_start = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._loop, name="zkp2p-flame-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._stop_evt.set()
+        t.join(timeout=5.0)
+        self._thread = None
+        if self._t_start is not None:
+            self.duration_s = time.perf_counter() - self._t_start
+
+    # -- sampling ----------------------------------------------------
+
+    def _loop(self) -> None:
+        interval = 1.0 / self.hz
+        next_t = time.perf_counter()
+        while not self._stop_evt.is_set():
+            t0 = time.perf_counter()
+            try:
+                self._sample_once()
+            except Exception:  # noqa: BLE001 — the profiler must never
+                pass  # take down the thread it is observing
+            self._self_s += time.perf_counter() - t0
+            next_t += interval
+            delay = next_t - time.perf_counter()
+            if delay > 0:
+                self._stop_evt.wait(delay)
+            else:
+                # fell behind (a long fold under load): re-anchor rather
+                # than bursting to catch up — bursting IS overhead
+                next_t = time.perf_counter()
+
+    def _window_deltas(self) -> Tuple[Dict[str, int], Dict[str, int], int]:
+        """(stage ns deltas, msm sub-field ns deltas, inflight gauge)
+        since the previous tick; zeros when the stats block is absent."""
+        snap = self._stats()
+        if snap is None:
+            return {s: 0 for s in _STAGE_NS_FIELDS}, {k: 0 for k in _MSM_SUB_FIELDS}, 0
+        prev = self._prev_snap or {}
+        self._prev_snap = snap
+        stage_d = {
+            s: max(0, int(snap.get(f, 0)) - int(prev.get(f, 0)))
+            for s, f in _STAGE_NS_FIELDS.items()
+        }
+        sub_d = {
+            k: max(0, int(snap.get(f, 0)) - int(prev.get(f, 0)))
+            for k, f in _MSM_SUB_FIELDS.items()
+        }
+        return stage_d, sub_d, int(snap.get("msm_inflight", 0))
+
+    def _synthetic_frames(self, stage_d, sub_d, inflight) -> List[str]:
+        """Frames to stitch under a bridge-parked leaf for this window.
+        Dominant stage by ns delta; an in-flight MSM with no ns movement
+        yet (the call entered but hasn't hit an exit-site flush) still
+        attributes to msm."""
+        stage = max(stage_d, key=lambda s: stage_d[s])
+        if stage_d[stage] <= 0:
+            if inflight <= 0:
+                return []
+            stage = "msm"
+        frames = [f"native:{stage}"]
+        if stage == "msm":
+            sub = max(sub_d, key=lambda k: sub_d[k])
+            if sub_d[sub] > 0:
+                frames.append(f"native:msm.{sub}")
+        return frames
+
+    def _sample_once(self) -> None:
+        stage_d, sub_d, inflight = self._window_deltas()
+        self.windows += 1
+        native_active = inflight > 0 or any(v > 0 for v in stage_d.values())
+        me = threading.get_ident()
+        parked = False
+        keys: List[str] = []
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            if self._filter is not None and tid not in self._filter:
+                continue
+            stack = _fold(frame)
+            if not stack:
+                continue
+            if native_active and _is_bridge_file(frame.f_code.co_filename):
+                stack.extend(self._synthetic_frames(stage_d, sub_d, inflight))
+                parked = True
+            keys.append(";".join(stack))
+        # ONE lock acquisition per tick, after the frame walk — the
+        # sampler's GIL slice is what the profiled process pays
+        with self._lock:
+            for key in keys:
+                self._counts[key] = self._counts.get(key, 0) + 1
+            self.samples += len(keys)
+            for stage, ns in stage_d.items():
+                if ns <= 0:
+                    continue
+                self._native_ns[stage] += ns
+                if not parked:
+                    # the heavy part ran on threads we never saw parked
+                    # (pool workers) — credit it to the synthetic root
+                    # at finalization instead of dropping it
+                    self._unattributed_ns[stage] += ns
+
+    # -- results -----------------------------------------------------
+
+    def stacks(self) -> Dict[str, int]:
+        """Folded counts, including the `[native];native:<stage>` root
+        stacks for self-time never observed under a parked thread: one
+        count per sample the window SHOULD have produced at this hz
+        (floor 1, so any nonzero native delta is visible)."""
+        with self._lock:
+            out = dict(self._counts)
+            for stage, ns in self._unattributed_ns.items():
+                if ns <= 0:
+                    continue
+                key = f"[native];native:{stage}"
+                out[key] = out.get(key, 0) + max(1, round(ns * self.hz / 1e9))
+        return out
+
+    def result(self) -> Dict:
+        """The capture body (everything but trigger metadata)."""
+        with self._lock:
+            native_ns = dict(self._native_ns)
+            unattributed = dict(self._unattributed_ns)
+        return {
+            "hz": self.hz,
+            "samples": self.samples,
+            "windows": self.windows,
+            "duration_s": round(self.duration_s, 4),
+            "sampler": {"self_ms": round(self._self_s * 1e3, 3)},
+            "native_ns": native_ns,
+            "native_unattributed_ns": unattributed,
+            "stacks": self.stacks(),
+        }
+
+
+def collapsed_text(stacks: Dict[str, int]) -> str:
+    """flamegraph.pl wire format: `frame;frame;frame count` per line,
+    heaviest first."""
+    rows = sorted(stacks.items(), key=lambda kv: (-kv[1], kv[0]))
+    return "\n".join(f"{k} {v}" for k, v in rows)
+
+
+# -- capture files ---------------------------------------------------
+
+
+def capture_dir() -> Optional[str]:
+    """Captures live beside the precomp tables / perf ledger; None when
+    persistence is disabled (ZKP2P_MSM_PRECOMP_CACHE=0)."""
+    from ..prover.precomp import _cache_dir
+
+    return _cache_dir()
+
+
+def _safe_token(s: str) -> str:
+    return "".join(c if (c.isalnum() or c in "-.") else "-" for c in str(s)) or "x"
+
+
+def write_capture(
+    sampler: FlameSampler,
+    circuit: str,
+    stage: str,
+    trigger: str,
+    entry_digest: Optional[str] = None,
+    budget_ms: Optional[float] = None,
+    over_ms: Optional[float] = None,
+    out_dir: Optional[str] = None,
+) -> Optional[str]:
+    """Stop `sampler` and persist its capture atomically (tmp+rename —
+    a torn capture must never parse).  Returns the path, or None when
+    persistence is off / the write fails.  Counts the capture in
+    zkp2p_flame_captures_total{trigger} only on a successful rename."""
+    from .audit import execution_digest
+    from .metrics import REGISTRY
+
+    sampler.stop()
+    d = out_dir or capture_dir()
+    if d is None:
+        return None
+    body = sampler.result()
+    ts = int(time.time())  # the request clock: comparable across hosts
+    body.update({
+        "schema": CAPTURE_SCHEMA,
+        "kind": CAPTURE_KIND,
+        "circuit": str(circuit),
+        "stage": str(stage),
+        "trigger": str(trigger),
+        "ts": ts,
+        "entry_digest": entry_digest,
+        "budget_ms": budget_ms,
+        "over_ms": over_ms,
+        "execution_digest": execution_digest(),
+    })
+    name = (
+        f"{CAPTURE_PREFIX}{_safe_token(circuit)}_{_safe_token(stage)}_{ts}.json"
+    )
+    path = os.path.join(d, name)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        os.makedirs(d, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(body, f, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        return None
+    REGISTRY.counter("zkp2p_flame_captures_total", {"trigger": trigger}).inc()
+    return path
+
+
+def load_capture(path: str) -> Optional[Dict]:
+    """Fail-closed capture reader: one JSON object of the expected kind
+    and schema with a str->int stacks map, or None — a truncated or
+    foreign file must never render as a flamegraph."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("kind") != CAPTURE_KIND or doc.get("schema") != CAPTURE_SCHEMA:
+        return None
+    stacks = doc.get("stacks")
+    if not isinstance(stacks, dict) or not all(
+        isinstance(k, str) and isinstance(v, int) and v >= 0
+        for k, v in stacks.items()
+    ):
+        return None
+    return doc
+
+
+def captures_for(
+    circuit: str,
+    stage: Optional[str] = None,
+    out_dir: Optional[str] = None,
+) -> List[Tuple[str, Dict]]:
+    """Valid on-disk captures for `circuit` (newest first), optionally
+    narrowed to one stage.  Unparseable files are skipped, not raised —
+    this feeds report paths."""
+    d = out_dir or capture_dir()
+    if d is None:
+        return []
+    pat = os.path.join(d, f"{CAPTURE_PREFIX}{_safe_token(circuit)}_*.json")
+    out: List[Tuple[str, Dict]] = []
+    for path in glob.glob(pat):
+        doc = load_capture(path)
+        if doc is None:
+            continue
+        if doc.get("circuit") != circuit:
+            continue
+        if stage is not None and doc.get("stage") != stage:
+            continue
+        out.append((path, doc))
+    out.sort(key=lambda pd: (-int(pd[1].get("ts", 0)), pd[0]))
+    return out
+
+
+# -- the sentry hook -------------------------------------------------
+
+
+class CaptureController:
+    """Overrun-triggered captures: `trigger()` (called from
+    service._perf_check on a budget overrun) starts the sampler unless
+    gated off, mid-capture, or cooling down; `sweep_tick()` (called
+    once per completed service sweep) finishes the capture after
+    `flame_capture_n` sweeps and writes the file.  One instance per
+    process (`controller()`), shared across service threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sampler: Optional[FlameSampler] = None
+        self._meta: Optional[Dict] = None
+        self._sweeps = 0
+        self._need = 0
+        self._last_mono: Optional[float] = None
+        self._pointer: Optional[Dict] = None
+
+    def trigger(
+        self,
+        circuit: str,
+        stage: str,
+        entry_digest: Optional[str] = None,
+        budget_ms: Optional[float] = None,
+        over_ms: Optional[float] = None,
+    ) -> bool:
+        """True when a capture actually started."""
+        if flame_arm() == "off":
+            return False
+        from .config import load_config
+
+        cfg = load_config()
+        with self._lock:
+            if self._sampler is not None:
+                return False  # one capture at a time
+            now = time.monotonic()
+            if (
+                self._last_mono is not None
+                and cfg.flame_cooldown_s > 0
+                and now - self._last_mono < cfg.flame_cooldown_s
+            ):
+                return False
+            self._sampler = FlameSampler(hz=cfg.flame_hz).start()
+            self._meta = {
+                "circuit": str(circuit),
+                "stage": str(stage),
+                "entry_digest": entry_digest,
+                "budget_ms": budget_ms,
+                "over_ms": over_ms,
+            }
+            self._sweeps = 0
+            self._need = max(1, int(cfg.flame_capture_n))
+        return True
+
+    def sweep_tick(self) -> Optional[str]:
+        """Called at the end of every service sweep; returns the capture
+        path when this tick completed one."""
+        with self._lock:
+            if self._sampler is None:
+                return None
+            self._sweeps += 1
+            if self._sweeps < self._need:
+                return None
+            sampler, meta = self._sampler, self._meta
+            self._sampler, self._meta = None, None
+            self._last_mono = time.monotonic()
+        path = write_capture(
+            sampler,
+            circuit=meta["circuit"],
+            stage=meta["stage"],
+            trigger="overrun",
+            entry_digest=meta["entry_digest"],
+            budget_ms=meta["budget_ms"],
+            over_ms=meta["over_ms"],
+        )
+        if path is not None:
+            with self._lock:
+                self._pointer = {
+                    "file": os.path.basename(path),
+                    "stage": meta["stage"],
+                    "ts": int(time.time()),
+                    "samples": sampler.samples,
+                }
+        return path
+
+    def active(self) -> bool:
+        with self._lock:
+            return self._sampler is not None
+
+    def pointer(self) -> Optional[Dict]:
+        """The most recent capture this process produced — what the
+        heartbeat perf block federates to `zkp2p-tpu top`."""
+        with self._lock:
+            return dict(self._pointer) if self._pointer else None
+
+    def reset(self) -> None:
+        """Test hook: abandon any in-flight capture and clear state."""
+        with self._lock:
+            sampler = self._sampler
+            self._sampler = None
+            self._meta = None
+            self._sweeps = 0
+            self._last_mono = None
+            self._pointer = None
+        if sampler is not None:
+            sampler.stop()
+
+
+_controller = CaptureController()
+
+
+def controller() -> CaptureController:
+    return _controller

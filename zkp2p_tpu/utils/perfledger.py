@@ -283,21 +283,22 @@ def derive_budgets(
     cfg = load_config()
     window = cfg.perf_window if window is None else max(1, int(window))
     tolerance = cfg.perf_tolerance if tolerance is None else float(tolerance)
-    series: Dict[Tuple[str, str], List[Tuple[float, str]]] = {}
+    series: Dict[Tuple[str, str], List[Tuple[float, str, str]]] = {}
     for e in entries:
         circuit = str(e.get("circuit", "?"))
         digest = str(e.get("execution_digest", "?"))
+        entry_d = str(e.get("entry_digest", "?"))
         for stage, st in e["stages"].items():
             try:
                 p50 = float(st["p50_ms"])
             except (KeyError, TypeError, ValueError):
                 continue
-            series.setdefault((circuit, stage), []).append((p50, digest))
+            series.setdefault((circuit, stage), []).append((p50, digest, entry_d))
     out: Dict[str, Dict[str, Dict]] = {}
     for (circuit, stage), rows in series.items():
         tail = rows[-window:]
         head_digest = tail[-1][1]
-        vals = sorted(v for v, d in tail if d == head_digest)
+        vals = sorted(v for v, d, _ed in tail if d == head_digest)
         if not vals:
             continue
         # UPPER median (even-count windows take the higher middle): the
@@ -311,6 +312,11 @@ def derive_budgets(
             "n": len(vals),
             "arm_skipped": len(tail) - len(vals),
             "tolerance": tolerance,
+            # entry_digest of the HEAD ledger entry this budget window
+            # is anchored to — a flame capture triggered by this budget
+            # records it, so `zkp2p-tpu perf` can walk a DRIFT verdict
+            # to the capture that explains it
+            "head_digest": tail[-1][2],
         }
     return out
 
@@ -329,6 +335,13 @@ class BudgetBook:
     def budget_ms(self, stage: str) -> Optional[float]:
         b = self._budgets.get(stage)
         return None if b is None else b["budget_ms"]
+
+    def head_digest(self, stage: str) -> Optional[str]:
+        """The ledger entry_digest this stage's budget window was
+        filtered against (None for a stage with no budget) — what a
+        triggered flame capture records as its cross-link."""
+        b = self._budgets.get(stage)
+        return None if b is None else b.get("head_digest")
 
     def over(self, stage: str, ms: Optional[float]) -> Optional[bool]:
         """True = over budget, False = within, None = NO budget for
