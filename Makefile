@@ -6,7 +6,7 @@
 # weakness #1/#2).  Run it before closing a round; quote its output in
 # the round notes.
 
-.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke perf-smoke flame-smoke perf-gate tpu-shard-smoke warm-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
+.PHONY: native native-asan native-tsan lint circuit-audit test test-slow metrics-smoke precomp-smoke precomp-cache chaos-smoke loadgen-smoke nonmsm-smoke prove-floor-smoke fleet-smoke fleet-obs-smoke fleet-chaos sched-smoke tune-smoke perf-smoke flame-smoke perf-gate tpu-shard-smoke warm-cache doctor driver-rehearsal rehearsal-dryrun rehearsal-bench fullsize-proof
 
 native:
 	$(MAKE) -C csrc
@@ -217,6 +217,21 @@ fleet-chaos: native
 # docs/TUNING.md §non-MSM; ~15 s on the 2-core box.
 nonmsm-smoke: native
 	env -u PALLAS_AXON_POOL_IPS python -m pytest tests/test_nonmsm.py -q
+
+# Single-prove floor smoke (fast; tier-1 resident): the PR-20 floor
+# arms — interleaved+prefetched MSM apply, radix-8 fused NTT stages,
+# witness-u64-at-builder — byte-identical to the committed-old arms
+# across {knob on/off} x {threads 1,2} x {single, batch S=3}, with the
+# execution digest separating every gate combination, plus the
+# builder-u64 zero-copy hand-off and the radix-8 kernel parity vs the
+# scalar fr_ntt oracle.  The isolated perf read is
+# `python tools/msm_hwbench.py --apply-prof --glv --n 524288` — see
+# docs/TUNING.md §prove floor; ~40 s on the 1-core box.
+prove-floor-smoke: native
+	env -u PALLAS_AXON_POOL_IPS python -m pytest -q \
+	  tests/test_nonmsm.py -k "radix8 or witness_u64 or prove_floor" && \
+	env -u PALLAS_AXON_POOL_IPS python -m pytest -q \
+	  tests/test_msm_multi.py -k "floor_arms"
 
 # Execution-path preflight (docs/OBSERVABILITY.md §execution audit):
 # probe the backend, arm EVERY gate through its real resolver, print

@@ -144,6 +144,40 @@ static bool batch_affine_enabled() {
   return !(e && e[0] == '0');
 }
 
+// Apply-chain interleave (ZKP2P_MSM_INTERLEAVE, default ON; same '0'
+// rule): two levers under one knob, both attacking the chunk apply's
+// stalls.  (1) The batched-affine chunk apply splits its blocks into
+// TWO independent prefix/suffix chains issued through one register
+// schedule (mont52_mul8x2), so the second chain's muls fill the IFMA
+// latency bubbles of the first.  (2) The gather/schedule loops issue
+// software prefetches down the already-known (bucket, point) index
+// streams — the apply's phase profile shows the random-index Aff52
+// gathers (DRAM-latency, hardware-prefetch-blind) cost more than the
+// mul chains themselves.  Off = the original schedule — the byte-parity
+// A/B arm (outputs are canonically folded either way and prefetch never
+// changes an architectural value, so neither lever can change a proof
+// byte).  Fresh-read per chunk-apply call, like the batch-affine gate
+// above.
+static bool msm_interleave_enabled() {
+  const char *e = getenv("ZKP2P_MSM_INTERLEAVE");
+  return !(e && e[0] == '0');
+}
+
+// Radix-8 NTT stage fusion (ZKP2P_NTT_RADIX8, default OFF — set '1'
+// to arm): the vectorized SoA stage pipeline fuses THREE radix-2
+// stages per load/store pass (12 muls / 8 elements — the same mul
+// count as the radix-4 arrangement, one memory pass instead of 1.5).
+// Measured slightly SLOWER (0.95x at 2^19) on the 1-core IFMA box —
+// the extra live registers spill and the muls are throughput-bound, so
+// the saved memory pass does not pay there; the knob stays for wider
+// hosts.  Off = the radix-4 stage-pair fusion — the byte-parity A/B
+// arm (identical butterflies in a different pass grouping).
+// Fresh-read per transform.
+static bool ntt_radix8_enabled() {
+  const char *e = getenv("ZKP2P_NTT_RADIX8");
+  return e && e[0] == '1';
+}
+
 typedef unsigned __int128 u128;
 typedef uint64_t u64;
 
@@ -1327,6 +1361,93 @@ static inline void mont52_mul8(__m512i out[5], const __m512i a[5],
   out[4] = t4;  // < 2^52 (result < 2p < 2^255)
 }
 
+// Two INDEPENDENT mont52_mul8 chains issued through one instruction
+// schedule.  A single chain is latency-bound: each of the 5 outer
+// iterations serializes t0 -> mi -> t0 (madd52lo latency ~4 cycles on
+// 1-2 IFMA ports), leaving most multiplier slots idle.  Interleaving a
+// second chain with no data dependence on the first fills those slots —
+// the out-of-order window sees ~2x the independent madd52 work per
+// serial step.  Lane semantics are exactly two mont52_mul8 calls; the
+// fusion is purely an instruction-scheduling artifact, so callers can
+// regroup chains freely without changing any result bit.
+static inline void mont52_mul8x2(__m512i outA[5], const __m512i aA[5],
+                                 const __m512i bA[5], __m512i outB[5],
+                                 const __m512i aB[5], const __m512i bB[5],
+                                 const __m512i p[5], const __m512i pinv) {
+  const __m512i z = _mm512_setzero_si512();
+  __m512i s0 = z, s1 = z, s2 = z, s3 = z, s4 = z, s5 = z;
+  __m512i u0 = z, u1 = z, u2 = z, u3 = z, u4 = z, u5 = z;
+  for (int i = 0; i < 5; ++i) {
+    const __m512i bi = bA[i], ci = bB[i];
+    s0 = _mm512_madd52lo_epu64(s0, aA[0], bi);
+    u0 = _mm512_madd52lo_epu64(u0, aB[0], ci);
+    s1 = _mm512_madd52lo_epu64(s1, aA[1], bi);
+    u1 = _mm512_madd52lo_epu64(u1, aB[1], ci);
+    s2 = _mm512_madd52lo_epu64(s2, aA[2], bi);
+    u2 = _mm512_madd52lo_epu64(u2, aB[2], ci);
+    s3 = _mm512_madd52lo_epu64(s3, aA[3], bi);
+    u3 = _mm512_madd52lo_epu64(u3, aB[3], ci);
+    s4 = _mm512_madd52lo_epu64(s4, aA[4], bi);
+    u4 = _mm512_madd52lo_epu64(u4, aB[4], ci);
+    s1 = _mm512_madd52hi_epu64(s1, aA[0], bi);
+    u1 = _mm512_madd52hi_epu64(u1, aB[0], ci);
+    s2 = _mm512_madd52hi_epu64(s2, aA[1], bi);
+    u2 = _mm512_madd52hi_epu64(u2, aB[1], ci);
+    s3 = _mm512_madd52hi_epu64(s3, aA[2], bi);
+    u3 = _mm512_madd52hi_epu64(u3, aB[2], ci);
+    s4 = _mm512_madd52hi_epu64(s4, aA[3], bi);
+    u4 = _mm512_madd52hi_epu64(u4, aB[3], ci);
+    s5 = _mm512_madd52hi_epu64(s5, aA[4], bi);
+    u5 = _mm512_madd52hi_epu64(u5, aB[4], ci);
+    const __m512i mA = _mm512_madd52lo_epu64(z, s0, pinv);
+    const __m512i mB = _mm512_madd52lo_epu64(z, u0, pinv);
+    s0 = _mm512_madd52lo_epu64(s0, mA, p[0]);
+    u0 = _mm512_madd52lo_epu64(u0, mB, p[0]);
+    s1 = _mm512_add_epi64(s1, _mm512_srli_epi64(s0, 52));
+    u1 = _mm512_add_epi64(u1, _mm512_srli_epi64(u0, 52));
+    s1 = _mm512_madd52lo_epu64(s1, mA, p[1]);
+    u1 = _mm512_madd52lo_epu64(u1, mB, p[1]);
+    s2 = _mm512_madd52lo_epu64(s2, mA, p[2]);
+    u2 = _mm512_madd52lo_epu64(u2, mB, p[2]);
+    s3 = _mm512_madd52lo_epu64(s3, mA, p[3]);
+    u3 = _mm512_madd52lo_epu64(u3, mB, p[3]);
+    s4 = _mm512_madd52lo_epu64(s4, mA, p[4]);
+    u4 = _mm512_madd52lo_epu64(u4, mB, p[4]);
+    s1 = _mm512_madd52hi_epu64(s1, mA, p[0]);
+    u1 = _mm512_madd52hi_epu64(u1, mB, p[0]);
+    s2 = _mm512_madd52hi_epu64(s2, mA, p[1]);
+    u2 = _mm512_madd52hi_epu64(u2, mB, p[1]);
+    s3 = _mm512_madd52hi_epu64(s3, mA, p[2]);
+    u3 = _mm512_madd52hi_epu64(u3, mB, p[2]);
+    s4 = _mm512_madd52hi_epu64(s4, mA, p[3]);
+    u4 = _mm512_madd52hi_epu64(u4, mB, p[3]);
+    s5 = _mm512_madd52hi_epu64(s5, mA, p[4]);
+    u5 = _mm512_madd52hi_epu64(u5, mB, p[4]);
+    s0 = s1; s1 = s2; s2 = s3; s3 = s4; s4 = s5; s5 = z;
+    u0 = u1; u1 = u2; u2 = u3; u3 = u4; u4 = u5; u5 = z;
+  }
+  const __m512i m52 = _mm512_set1_epi64((long long)M52);
+  __m512i c;
+  outA[0] = _mm512_and_si512(s0, m52);          c = _mm512_srli_epi64(s0, 52);
+  s1 = _mm512_add_epi64(s1, c);
+  outA[1] = _mm512_and_si512(s1, m52);          c = _mm512_srli_epi64(s1, 52);
+  s2 = _mm512_add_epi64(s2, c);
+  outA[2] = _mm512_and_si512(s2, m52);          c = _mm512_srli_epi64(s2, 52);
+  s3 = _mm512_add_epi64(s3, c);
+  outA[3] = _mm512_and_si512(s3, m52);          c = _mm512_srli_epi64(s3, 52);
+  s4 = _mm512_add_epi64(s4, c);
+  outA[4] = s4;
+  outB[0] = _mm512_and_si512(u0, m52);          c = _mm512_srli_epi64(u0, 52);
+  u1 = _mm512_add_epi64(u1, c);
+  outB[1] = _mm512_and_si512(u1, m52);          c = _mm512_srli_epi64(u1, 52);
+  u2 = _mm512_add_epi64(u2, c);
+  outB[2] = _mm512_and_si512(u2, m52);          c = _mm512_srli_epi64(u2, 52);
+  u3 = _mm512_add_epi64(u3, c);
+  outB[3] = _mm512_and_si512(u3, m52);          c = _mm512_srli_epi64(u3, 52);
+  u4 = _mm512_add_epi64(u4, c);
+  outB[4] = u4;
+}
+
 // conditional fold by an arbitrary complement (2^260 - M): subtract M
 // when v >= M.  Used with comp2p (lazy fold) and compp (canonical fold).
 static inline void cond_sub_c8(__m512i v[5], const __m512i comp[5]) {
@@ -1671,20 +1792,11 @@ static void fr_ntt_soa_stages(u64 *soa, long m, const u64 root_std[4], int nt) {
   // the SoA planes instead of two — the stages are memory-bound at
   // these sizes.  Twiddles come straight from the existing per-stage
   // radix-2 tables: stage len's w^j plus stage 2len's w^j and w^{j+q}.
-  int n_vstages = 0;
-  for (long len = 16; len <= m; len <<= 1) ++n_vstages;
-  int stage = 0;
-  long len = 16;
-  if (n_vstages & 1) {
-    radix2_stage(len, stage);
-    ++stage;
-    len <<= 1;
-  }
-  for (; len * 2 <= m; len <<= 2, stage += 2) {
-    const long L = 2 * len;   // fused block size
-    const long q = len >> 1;  // quarter
-    const u64 *tw1p = T.buf.get() + T.offsets[stage];      // stage len: q entries
-    const u64 *tw2p = T.buf.get() + T.offsets[stage + 1];  // stage 2len: 2q entries
+  auto radix4_pass = [&](long len4, int stg) {
+    const long L = 2 * len4;   // fused block size
+    const long q = len4 >> 1;  // quarter
+    const u64 *tw1p = T.buf.get() + T.offsets[stg];      // stage len: q entries
+    const u64 *tw2p = T.buf.get() + T.offsets[stg + 1];  // stage 2len: 2q entries
     const long jblocks = q >> 3;
     pool_parallel_ranges((m / L) * jblocks, 128, nt, [&](long glo, long ghi) {
       for (long g = glo; g < ghi; ++g) {
@@ -1701,17 +1813,16 @@ static void fr_ntt_soa_stages(u64 *soa, long m, const u64 root_std[4], int nt) {
           w2q[k] = _mm512_loadu_si512(tw2p + (size_t)k * (2 * q) + j + q);
         }
         __m512i t1[5], t2[5], a1[5], b1[5], c1[5], d1[5];
-        // stage len: (a,b) and (c,d) with twiddle w1
-        mont52_mul8(t1, b, w1, p, pinv);
-        mont52_mul8(t2, d, w1, p, pinv);
+        // stage len: (a,b) and (c,d) with twiddle w1 — independent
+        // chains, one fused schedule
+        mont52_mul8x2(t1, b, w1, t2, d, w1, p, pinv);
         add_lazy8(a1, a, t1, comp2p);
         sub_lazy8(b1, a, t1, p2, comp2p);
         add_lazy8(c1, c, t2, comp2p);
         sub_lazy8(d1, c, t2, p2, comp2p);
         // stage 2len: (a1,c1) with w2[j], (b1,d1) with w2[j+q]
         __m512i u1[5], u2[5], o0[5], o1[5], o2[5], o3[5];
-        mont52_mul8(u1, c1, w2, p, pinv);
-        mont52_mul8(u2, d1, w2q, p, pinv);
+        mont52_mul8x2(u1, c1, w2, u2, d1, w2q, p, pinv);
         add_lazy8(o0, a1, u1, comp2p);
         sub_lazy8(o2, a1, u1, p2, comp2p);
         add_lazy8(o1, b1, u2, comp2p);
@@ -1724,6 +1835,127 @@ static void fr_ntt_soa_stages(u64 *soa, long m, const u64 root_std[4], int nt) {
         }
       }
     });
+  };
+  // Radix-8 fusion of stage triples (len, 2len, 4len): 12 Montgomery
+  // muls per 8 elements — the same butterfly count as three radix-2
+  // passes or 1.5 radix-4 passes, but ONE load/store trip over the SoA
+  // planes, and every mul paired with an independent partner through
+  // mont52_mul8x2 so the serial madd52 recurrences overlap.  The fused
+  // ladder at 2^19 is compute-bound on exactly those chains (NEXT.md
+  // lever 2).  Twiddle indexing per element s of the 8q block
+  // (q = len/2): stage len pairs (2t, 2t+1) ×w1[j]; stage 2len pairs
+  // (4t+s, 4t+s+2) ×w2[j+s·q]; stage 4len pairs (s, s+4) ×w3[j+s·q].
+  // The op sequence per element is exactly the radix-2 decomposition,
+  // so the lazy-domain residues — and the final proof bytes — are
+  // bit-identical to the radix-4 arrangement.
+  auto radix8_pass = [&](long len8, int stg) {
+    const long q = len8 >> 1;
+    const long L8 = 8 * q;  // fused block: three stages span 4·len8
+    const u64 *tw1p = T.buf.get() + T.offsets[stg];      // q entries
+    const u64 *tw2p = T.buf.get() + T.offsets[stg + 1];  // 2q entries
+    const u64 *tw3p = T.buf.get() + T.offsets[stg + 2];  // 4q entries
+    const long jblocks = q >> 3;
+    pool_parallel_ranges((m / L8) * jblocks, 64, nt, [&](long glo, long ghi) {
+      for (long g = glo; g < ghi; ++g) {
+        const long i0 = (g / jblocks) * L8;
+        const long j = (g % jblocks) * 8;
+        __m512i x0[5], x1[5], x2[5], x3[5], x4[5], x5[5], x6[5], x7[5];
+        __m512i w1[5], w2a[5], w2b[5], w3a[5], w3b[5], w3c[5], w3d[5];
+        for (int k = 0; k < 5; ++k) {
+          const size_t o = (size_t)k * m + i0 + j;
+          x0[k] = _mm512_loadu_si512(soa + o);
+          x1[k] = _mm512_loadu_si512(soa + o + q);
+          x2[k] = _mm512_loadu_si512(soa + o + 2 * q);
+          x3[k] = _mm512_loadu_si512(soa + o + 3 * q);
+          x4[k] = _mm512_loadu_si512(soa + o + 4 * q);
+          x5[k] = _mm512_loadu_si512(soa + o + 5 * q);
+          x6[k] = _mm512_loadu_si512(soa + o + 6 * q);
+          x7[k] = _mm512_loadu_si512(soa + o + 7 * q);
+          w1[k] = _mm512_loadu_si512(tw1p + (size_t)k * q + j);
+          w2a[k] = _mm512_loadu_si512(tw2p + (size_t)k * (2 * q) + j);
+          w2b[k] = _mm512_loadu_si512(tw2p + (size_t)k * (2 * q) + j + q);
+          w3a[k] = _mm512_loadu_si512(tw3p + (size_t)k * (4 * q) + j);
+          w3b[k] = _mm512_loadu_si512(tw3p + (size_t)k * (4 * q) + j + q);
+          w3c[k] = _mm512_loadu_si512(tw3p + (size_t)k * (4 * q) + j + 2 * q);
+          w3d[k] = _mm512_loadu_si512(tw3p + (size_t)k * (4 * q) + j + 3 * q);
+        }
+        __m512i tA[5], tB[5];
+        // stage len: (x0,x1)(x2,x3)(x4,x5)(x6,x7), all ×w1[j]
+        __m512i a0[5], a1[5], a2[5], a3[5], a4[5], a5[5], a6[5], a7[5];
+        mont52_mul8x2(tA, x1, w1, tB, x3, w1, p, pinv);
+        add_lazy8(a0, x0, tA, comp2p);
+        sub_lazy8(a1, x0, tA, p2, comp2p);
+        add_lazy8(a2, x2, tB, comp2p);
+        sub_lazy8(a3, x2, tB, p2, comp2p);
+        mont52_mul8x2(tA, x5, w1, tB, x7, w1, p, pinv);
+        add_lazy8(a4, x4, tA, comp2p);
+        sub_lazy8(a5, x4, tA, p2, comp2p);
+        add_lazy8(a6, x6, tB, comp2p);
+        sub_lazy8(a7, x6, tB, p2, comp2p);
+        // stage 2len: (a0,a2)(a4,a6) ×w2[j], (a1,a3)(a5,a7) ×w2[j+q]
+        __m512i b0[5], b1[5], b2[5], b3[5], b4[5], b5[5], b6[5], b7[5];
+        mont52_mul8x2(tA, a2, w2a, tB, a3, w2b, p, pinv);
+        add_lazy8(b0, a0, tA, comp2p);
+        sub_lazy8(b2, a0, tA, p2, comp2p);
+        add_lazy8(b1, a1, tB, comp2p);
+        sub_lazy8(b3, a1, tB, p2, comp2p);
+        mont52_mul8x2(tA, a6, w2a, tB, a7, w2b, p, pinv);
+        add_lazy8(b4, a4, tA, comp2p);
+        sub_lazy8(b6, a4, tA, p2, comp2p);
+        add_lazy8(b5, a5, tB, comp2p);
+        sub_lazy8(b7, a5, tB, p2, comp2p);
+        // stage 4len: (b0,b4)×w3[j] (b1,b5)×w3[j+q] (b2,b6)×w3[j+2q]
+        // (b3,b7)×w3[j+3q]
+        __m512i o0[5], o1[5], o2[5], o3[5], o4[5], o5[5], o6[5], o7[5];
+        mont52_mul8x2(tA, b4, w3a, tB, b5, w3b, p, pinv);
+        add_lazy8(o0, b0, tA, comp2p);
+        sub_lazy8(o4, b0, tA, p2, comp2p);
+        add_lazy8(o1, b1, tB, comp2p);
+        sub_lazy8(o5, b1, tB, p2, comp2p);
+        mont52_mul8x2(tA, b6, w3c, tB, b7, w3d, p, pinv);
+        add_lazy8(o2, b2, tA, comp2p);
+        sub_lazy8(o6, b2, tA, p2, comp2p);
+        add_lazy8(o3, b3, tB, comp2p);
+        sub_lazy8(o7, b3, tB, p2, comp2p);
+        for (int k = 0; k < 5; ++k) {
+          const size_t o = (size_t)k * m + i0 + j;
+          _mm512_storeu_si512(soa + o, o0[k]);
+          _mm512_storeu_si512(soa + o + q, o1[k]);
+          _mm512_storeu_si512(soa + o + 2 * q, o2[k]);
+          _mm512_storeu_si512(soa + o + 3 * q, o3[k]);
+          _mm512_storeu_si512(soa + o + 4 * q, o4[k]);
+          _mm512_storeu_si512(soa + o + 5 * q, o5[k]);
+          _mm512_storeu_si512(soa + o + 6 * q, o6[k]);
+          _mm512_storeu_si512(soa + o + 7 * q, o7[k]);
+        }
+      }
+    });
+  };
+  int n_vstages = 0;
+  for (long len0 = 16; len0 <= m; len0 <<= 1) ++n_vstages;
+  int stage = 0;
+  long len = 16;
+  if (ntt_radix8_enabled() && n_vstages >= 3) {
+    // Radix-8 arm: clear the mod-3 remainder first (one radix-2 or
+    // radix-4 pass), then triples all the way up.
+    const int r = n_vstages % 3;
+    if (r == 1) {
+      radix2_stage(len, stage);
+      ++stage;
+      len <<= 1;
+    } else if (r == 2) {
+      radix4_pass(len, stage);
+      stage += 2;
+      len <<= 2;
+    }
+    for (; stage < n_vstages; len <<= 3, stage += 3) radix8_pass(len, stage);
+  } else {
+    if (n_vstages & 1) {
+      radix2_stage(len, stage);
+      ++stage;
+      len <<= 1;
+    }
+    for (; len * 2 <= m; len <<= 2, stage += 2) radix4_pass(len, stage);
   }
   stat_add(ST_NTT_STAGE_NS, prof_now_ns() - t_st);
 }
@@ -2070,9 +2302,23 @@ static void g1_chunk_apply_52(const Aff52 *bk, const Aff52 *b52,
       *y352 = buf + (size_t)35 * N;
   u64 one52[5] = {1, 0, 0, 0, 0}, one260[5];
   mont52_mul_scalar(one260, one52, F.r260sq, F);
+  const bool ilv_pf = msm_interleave_enabled();
+  // Prefetch distance down the schedule's index streams.  The gathered
+  // Aff52s (80 bytes, two cache lines) sit at random offsets in a
+  // bases/buckets working set far beyond L2 at bench shape — without
+  // prefetch every add eats a demand-miss latency twice.
+  const long PF = 24;
   // gather-transpose into SoA planes (x1 = bucket, x2 = incoming point)
   for (long j = 0; j < N; ++j) {
     if (j < m) {
+      if (ilv_pf && j + PF < m) {
+        const char *pb = (const char *)&bk[add_bkt[j + PF]];
+        const char *pp = (const char *)&b52[add_pt[j + PF]];
+        _mm_prefetch(pb, _MM_HINT_T0);
+        _mm_prefetch(pb + 64, _MM_HINT_T0);
+        _mm_prefetch(pp, _MM_HINT_T0);
+        _mm_prefetch(pp + 64, _MM_HINT_T0);
+      }
       const Aff52 &B1 = bk[add_bkt[j]];
       const Aff52 &P2 = b52[add_pt[j]];
       for (int k = 0; k < 5; ++k) {
@@ -2107,6 +2353,11 @@ static void g1_chunk_apply_52(const Aff52 *bk, const Aff52 *b52,
       for (int l = 0; l < 8; ++l) {
         long j = t * 8 + l;
         if (j < m) {
+          if (ilv_pf && j + PF < m) {
+            const char *pp = (const char *)b52[add_pt[j + PF]].y;
+            _mm_prefetch(pp, _MM_HINT_T0);
+            _mm_prefetch(pp + 39, _MM_HINT_T0);
+          }
           u64 py[5];
           if (negf[j]) {
             neg52(py, b52[add_pt[j]].y, F);
@@ -2149,73 +2400,212 @@ static void g1_chunk_apply_52(const Aff52 *bk, const Aff52 *b52,
       _mm512_storeu_si512(n52 + (size_t)k * N + t * 8, numv[k]);
     }
   }
-  // phase A: lane-strided prefix products
-  __m512i run[5];
-  for (int k = 0; k < 5; ++k) run[k] = _mm512_set1_epi64((long long)one260[k]);
-  for (long t = 0; t < nblk; ++t) {
-    __m512i dv[5];
-    for (int k = 0; k < 5; ++k) {
-      _mm512_storeu_si512(pr52 + (size_t)k * N + t * 8, run[k]);
-      dv[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
+  if (msm_interleave_enabled() && nblk >= 2) {
+    // Interleaved arm (ZKP2P_MSM_INTERLEAVE): split the block range at
+    // hA and drive BOTH halves' prefix/apply chains through one fused
+    // schedule (mont52_mul8x2).  A single chain is latency-bound —
+    // every block's prefix multiply waits on the previous block's — so
+    // the second, data-independent chain fills the IFMA port bubbles.
+    // The two group products meet in ONE shared 16-lane scalar
+    // inversion (same mont_inv count as before).  Each group is its
+    // own batch-inversion domain, so every lane still computes the
+    // exact same field values; the canonical fold at the end erases
+    // representative drift, keeping outputs byte-identical to the
+    // single-chain arm.
+    const long hA = (nblk + 1) / 2, nB = nblk - hA;
+    __m512i runA[5], runB[5];
+    for (int k = 0; k < 5; ++k)
+      runA[k] = runB[k] = _mm512_set1_epi64((long long)one260[k]);
+    for (long t = 0; t < hA; ++t) {
+      const bool hasB = t < nB;
+      __m512i dvA[5], dvB[5];
+      for (int k = 0; k < 5; ++k) {
+        _mm512_storeu_si512(pr52 + (size_t)k * N + t * 8, runA[k]);
+        dvA[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
+        if (hasB) {
+          _mm512_storeu_si512(pr52 + (size_t)k * N + (hA + t) * 8, runB[k]);
+          dvB[k] = _mm512_loadu_si512(d52 + (size_t)k * N + (hA + t) * 8);
+        }
+      }
+      if (hasB)
+        mont52_mul8x2(runA, runA, dvA, runB, runB, dvB, p, pinv);
+      else
+        mont52_mul8(runA, runA, dvA, p, pinv);
     }
-    mont52_mul8(run, run, dv, p, pinv);
-  }
-  u64 tl8[5][8];
-  for (int k = 0; k < 5; ++k) _mm512_storeu_si512(tl8[k], run[k]);
-  u64 T4[8][4];
-  for (int l = 0; l < 8; ++l) {
-    u64 t52[5];
-    for (int k = 0; k < 5; ++k) t52[k] = tl8[k][l];
-    limb52_to_mont256(t52, T4[l], F);
-  }
-  u64 pre8[8][4], G[4], Ginv[4], suf[4], Tinv[8][4];
-  memcpy(pre8[0], ONE_MONT, 32);
-  for (int l = 1; l < 8; ++l) mont_mul(pre8[l], pre8[l - 1], T4[l - 1]);
-  mont_mul(G, pre8[7], T4[7]);
-  mont_inv(Ginv, G);
-  memcpy(suf, Ginv, 32);
-  for (int l = 7; l >= 0; --l) {
-    mont_mul(Tinv[l], suf, pre8[l]);
-    mont_mul(suf, suf, T4[l]);
-  }
-  __m512i inv_run[5];
-  {
-    u64 ir8[5][8];
+    u64 tl16[2][5][8];
+    for (int k = 0; k < 5; ++k) {
+      _mm512_storeu_si512(tl16[0][k], runA[k]);
+      _mm512_storeu_si512(tl16[1][k], runB[k]);
+    }
+    u64 T4[16][4];
+    for (int l = 0; l < 16; ++l) {
+      u64 t52[5];
+      for (int k = 0; k < 5; ++k) t52[k] = tl16[l >> 3][k][l & 7];
+      limb52_to_mont256(t52, T4[l], F);
+    }
+    u64 pre16[16][4], G[4], Ginv[4], suf[4], Tinv[16][4];
+    memcpy(pre16[0], ONE_MONT, 32);
+    for (int l = 1; l < 16; ++l) mont_mul(pre16[l], pre16[l - 1], T4[l - 1]);
+    mont_mul(G, pre16[15], T4[15]);
+    mont_inv(Ginv, G);
+    memcpy(suf, Ginv, 32);
+    for (int l = 15; l >= 0; --l) {
+      mont_mul(Tinv[l], suf, pre16[l]);
+      mont_mul(suf, suf, T4[l]);
+    }
+    __m512i inv_runA[5], inv_runB[5];
+    {
+      u64 ir16[2][5][8];
+      for (int l = 0; l < 16; ++l) {
+        u64 t52[5], t260[5];
+        limbs4_to_52(t52, Tinv[l]);
+        mont52_mul_scalar(t260, t52, F.c264, F);
+        for (int k = 0; k < 5; ++k) ir16[l >> 3][k][l & 7] = t260[k];
+      }
+      for (int k = 0; k < 5; ++k) {
+        inv_runA[k] = _mm512_loadu_si512(ir16[0][k]);
+        inv_runB[k] = _mm512_loadu_si512(ir16[1][k]);
+      }
+    }
+    // phase B: two interleaved backward walks (A: hA-1..0, B: nblk-1..hA)
+    for (long i = 0; i < hA; ++i) {
+      const long tA = hA - 1 - i, tB = nblk - 1 - i;
+      const bool hasB = i < nB;
+      __m512i prvA[5], dvA[5], nvA[5], x1A[5], y1A[5], x2A[5];
+      __m512i prvB[5], dvB[5], nvB[5], x1B[5], y1B[5], x2B[5];
+      for (int k = 0; k < 5; ++k) {
+        prvA[k] = _mm512_loadu_si512(pr52 + (size_t)k * N + tA * 8);
+        dvA[k] = _mm512_loadu_si512(d52 + (size_t)k * N + tA * 8);
+        nvA[k] = _mm512_loadu_si512(n52 + (size_t)k * N + tA * 8);
+        x1A[k] = _mm512_loadu_si512(x152 + (size_t)k * N + tA * 8);
+        y1A[k] = _mm512_loadu_si512(y152 + (size_t)k * N + tA * 8);
+        x2A[k] = _mm512_loadu_si512(x252 + (size_t)k * N + tA * 8);
+        if (hasB) {
+          prvB[k] = _mm512_loadu_si512(pr52 + (size_t)k * N + tB * 8);
+          dvB[k] = _mm512_loadu_si512(d52 + (size_t)k * N + tB * 8);
+          nvB[k] = _mm512_loadu_si512(n52 + (size_t)k * N + tB * 8);
+          x1B[k] = _mm512_loadu_si512(x152 + (size_t)k * N + tB * 8);
+          y1B[k] = _mm512_loadu_si512(y152 + (size_t)k * N + tB * 8);
+          x2B[k] = _mm512_loadu_si512(x252 + (size_t)k * N + tB * 8);
+        }
+      }
+      __m512i dinvA[5], lamA[5], lam2A[5], x3A[5], ttA[5], yyA[5], y3A[5];
+      if (hasB) {
+        __m512i dinvB[5], lamB[5], lam2B[5], x3B[5], ttB[5], yyB[5], y3B[5];
+        mont52_mul8x2(dinvA, inv_runA, prvA, dinvB, inv_runB, prvB, p, pinv);
+        mont52_mul8x2(inv_runA, inv_runA, dvA, inv_runB, inv_runB, dvB, p,
+                      pinv);
+        mont52_mul8x2(lamA, nvA, dinvA, lamB, nvB, dinvB, p, pinv);
+        mont52_mul8x2(lam2A, lamA, lamA, lam2B, lamB, lamB, p, pinv);
+        sub_lazy8(x3A, lam2A, x1A, p2, comp2p);
+        sub_lazy8(x3A, x3A, x2A, p2, comp2p);
+        sub_lazy8(ttA, x1A, x3A, p2, comp2p);
+        sub_lazy8(x3B, lam2B, x1B, p2, comp2p);
+        sub_lazy8(x3B, x3B, x2B, p2, comp2p);
+        sub_lazy8(ttB, x1B, x3B, p2, comp2p);
+        mont52_mul8x2(yyA, lamA, ttA, yyB, lamB, ttB, p, pinv);
+        sub_lazy8(y3A, yyA, y1A, p2, comp2p);
+        sub_lazy8(y3B, yyB, y1B, p2, comp2p);
+        // canonical fold for the memcmp-equality contract
+        cond_sub_c8(x3A, comppv);
+        cond_sub_c8(y3A, comppv);
+        cond_sub_c8(x3B, comppv);
+        cond_sub_c8(y3B, comppv);
+        for (int k = 0; k < 5; ++k) {
+          _mm512_storeu_si512(x352 + (size_t)k * N + tA * 8, x3A[k]);
+          _mm512_storeu_si512(y352 + (size_t)k * N + tA * 8, y3A[k]);
+          _mm512_storeu_si512(x352 + (size_t)k * N + tB * 8, x3B[k]);
+          _mm512_storeu_si512(y352 + (size_t)k * N + tB * 8, y3B[k]);
+        }
+      } else {
+        mont52_mul8(dinvA, inv_runA, prvA, p, pinv);
+        mont52_mul8(inv_runA, inv_runA, dvA, p, pinv);
+        mont52_mul8(lamA, nvA, dinvA, p, pinv);
+        mont52_mul8(lam2A, lamA, lamA, p, pinv);
+        sub_lazy8(x3A, lam2A, x1A, p2, comp2p);
+        sub_lazy8(x3A, x3A, x2A, p2, comp2p);
+        sub_lazy8(ttA, x1A, x3A, p2, comp2p);
+        mont52_mul8(yyA, lamA, ttA, p, pinv);
+        sub_lazy8(y3A, yyA, y1A, p2, comp2p);
+        cond_sub_c8(x3A, comppv);
+        cond_sub_c8(y3A, comppv);
+        for (int k = 0; k < 5; ++k) {
+          _mm512_storeu_si512(x352 + (size_t)k * N + tA * 8, x3A[k]);
+          _mm512_storeu_si512(y352 + (size_t)k * N + tA * 8, y3A[k]);
+        }
+      }
+    }
+  } else {
+    // Single-chain arm (gate off, or a one-block chunk).
+    // phase A: lane-strided prefix products
+    __m512i run[5];
+    for (int k = 0; k < 5; ++k)
+      run[k] = _mm512_set1_epi64((long long)one260[k]);
+    for (long t = 0; t < nblk; ++t) {
+      __m512i dv[5];
+      for (int k = 0; k < 5; ++k) {
+        _mm512_storeu_si512(pr52 + (size_t)k * N + t * 8, run[k]);
+        dv[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
+      }
+      mont52_mul8(run, run, dv, p, pinv);
+    }
+    u64 tl8[5][8];
+    for (int k = 0; k < 5; ++k) _mm512_storeu_si512(tl8[k], run[k]);
+    u64 T4[8][4];
     for (int l = 0; l < 8; ++l) {
-      u64 t52[5], t260[5];
-      limbs4_to_52(t52, Tinv[l]);
-      mont52_mul_scalar(t260, t52, F.c264, F);
-      for (int k = 0; k < 5; ++k) ir8[k][l] = t260[k];
+      u64 t52[5];
+      for (int k = 0; k < 5; ++k) t52[k] = tl8[k][l];
+      limb52_to_mont256(t52, T4[l], F);
     }
-    for (int k = 0; k < 5; ++k) inv_run[k] = _mm512_loadu_si512(ir8[k]);
-  }
-  // phase B backwards
-  for (long t = nblk - 1; t >= 0; --t) {
-    __m512i prv[5], dv[5], nv[5], x1v[5], y1v[5], x2v[5];
-    for (int k = 0; k < 5; ++k) {
-      prv[k] = _mm512_loadu_si512(pr52 + (size_t)k * N + t * 8);
-      dv[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
-      nv[k] = _mm512_loadu_si512(n52 + (size_t)k * N + t * 8);
-      x1v[k] = _mm512_loadu_si512(x152 + (size_t)k * N + t * 8);
-      y1v[k] = _mm512_loadu_si512(y152 + (size_t)k * N + t * 8);
-      x2v[k] = _mm512_loadu_si512(x252 + (size_t)k * N + t * 8);
+    u64 pre8[8][4], G[4], Ginv[4], suf[4], Tinv[8][4];
+    memcpy(pre8[0], ONE_MONT, 32);
+    for (int l = 1; l < 8; ++l) mont_mul(pre8[l], pre8[l - 1], T4[l - 1]);
+    mont_mul(G, pre8[7], T4[7]);
+    mont_inv(Ginv, G);
+    memcpy(suf, Ginv, 32);
+    for (int l = 7; l >= 0; --l) {
+      mont_mul(Tinv[l], suf, pre8[l]);
+      mont_mul(suf, suf, T4[l]);
     }
-    __m512i dinv[5], lam[5], lam2[5], x3[5], tt[5], yy[5], y3[5];
-    mont52_mul8(dinv, inv_run, prv, p, pinv);
-    mont52_mul8(inv_run, inv_run, dv, p, pinv);
-    mont52_mul8(lam, nv, dinv, p, pinv);
-    mont52_mul8(lam2, lam, lam, p, pinv);
-    sub_lazy8(x3, lam2, x1v, p2, comp2p);
-    sub_lazy8(x3, x3, x2v, p2, comp2p);
-    sub_lazy8(tt, x1v, x3, p2, comp2p);
-    mont52_mul8(yy, lam, tt, p, pinv);
-    sub_lazy8(y3, yy, y1v, p2, comp2p);
-    // canonical fold for the memcmp-equality contract
-    cond_sub_c8(x3, comppv);
-    cond_sub_c8(y3, comppv);
-    for (int k = 0; k < 5; ++k) {
-      _mm512_storeu_si512(x352 + (size_t)k * N + t * 8, x3[k]);
-      _mm512_storeu_si512(y352 + (size_t)k * N + t * 8, y3[k]);
+    __m512i inv_run[5];
+    {
+      u64 ir8[5][8];
+      for (int l = 0; l < 8; ++l) {
+        u64 t52[5], t260[5];
+        limbs4_to_52(t52, Tinv[l]);
+        mont52_mul_scalar(t260, t52, F.c264, F);
+        for (int k = 0; k < 5; ++k) ir8[k][l] = t260[k];
+      }
+      for (int k = 0; k < 5; ++k) inv_run[k] = _mm512_loadu_si512(ir8[k]);
+    }
+    // phase B backwards
+    for (long t = nblk - 1; t >= 0; --t) {
+      __m512i prv[5], dv[5], nv[5], x1v[5], y1v[5], x2v[5];
+      for (int k = 0; k < 5; ++k) {
+        prv[k] = _mm512_loadu_si512(pr52 + (size_t)k * N + t * 8);
+        dv[k] = _mm512_loadu_si512(d52 + (size_t)k * N + t * 8);
+        nv[k] = _mm512_loadu_si512(n52 + (size_t)k * N + t * 8);
+        x1v[k] = _mm512_loadu_si512(x152 + (size_t)k * N + t * 8);
+        y1v[k] = _mm512_loadu_si512(y152 + (size_t)k * N + t * 8);
+        x2v[k] = _mm512_loadu_si512(x252 + (size_t)k * N + t * 8);
+      }
+      __m512i dinv[5], lam[5], lam2[5], x3[5], tt[5], yy[5], y3[5];
+      mont52_mul8(dinv, inv_run, prv, p, pinv);
+      mont52_mul8(inv_run, inv_run, dv, p, pinv);
+      mont52_mul8(lam, nv, dinv, p, pinv);
+      mont52_mul8(lam2, lam, lam, p, pinv);
+      sub_lazy8(x3, lam2, x1v, p2, comp2p);
+      sub_lazy8(x3, x3, x2v, p2, comp2p);
+      sub_lazy8(tt, x1v, x3, p2, comp2p);
+      mont52_mul8(yy, lam, tt, p, pinv);
+      sub_lazy8(y3, yy, y1v, p2, comp2p);
+      // canonical fold for the memcmp-equality contract
+      cond_sub_c8(x3, comppv);
+      cond_sub_c8(y3, comppv);
+      for (int k = 0; k < 5; ++k) {
+        _mm512_storeu_si512(x352 + (size_t)k * N + t * 8, x3[k]);
+        _mm512_storeu_si512(y352 + (size_t)k * N + t * 8, y3[k]);
+      }
     }
   }
   for (long j = 0; j < m; ++j) {
@@ -2653,10 +3043,33 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
     next.clear();
     size_t processed = 0;
     bool bail = false;
+    const bool pf = msm_interleave_enabled();
     for (size_t lo = 0; lo < cur.size() && !bail; lo += B, ++chunk_id) {
       size_t hi = lo + B < cur.size() ? lo + B : cur.size();
       long m = 0;
       for (size_t k = lo; k < hi; ++k) {
+        // Two-level prefetch down the schedule: pull the digit word
+        // first (far), then — once it is cheap to read — the dependent
+        // stamp/bucket/base lines (near).  The bucket table and the
+        // bases both sit beyond L2 at bench shape and the index
+        // pattern is hardware-prefetch-blind.
+        if (pf) {
+          if (k + 32 < hi)
+            _mm_prefetch((const char *)&sd[cur[k + 32] * nwin + wi],
+                         _MM_HINT_T0);
+          if (k + 16 < hi) {
+            const long i2 = cur[k + 16];
+            const int32_t d2 = sd[i2 * nwin + wi];
+            const long b2 = d2 < 0 ? -d2 : d2;
+            _mm_prefetch((const char *)&stamp[b2], _MM_HINT_T0);
+            const char *pb = (const char *)&bk[b2];
+            _mm_prefetch(pb, _MM_HINT_T0);
+            _mm_prefetch(pb + 64, _MM_HINT_T0);
+            const char *pp = (const char *)&b52[i2];
+            _mm_prefetch(pp, _MM_HINT_T0);
+            _mm_prefetch(pp + 64, _MM_HINT_T0);
+          }
+        }
         long i = cur[k];
         int32_t dgt = sd[i * nwin + wi];
         long bno = dgt < 0 ? -dgt : dgt;
@@ -2705,6 +3118,14 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
       stat_add(ST_MSM_APPLY_NS, ap);
       if (msm_prof_enabled()) g_prof_apply_ns += ap;
       for (long j = 0; j < m; ++j) {
+        // write-prefetch the bucket lines ahead: the chunk's working
+        // set (~B x 160 B of buckets + scratch) evicted them since the
+        // gather, so every writeback otherwise eats an RFO miss
+        if (pf && j + 8 < m) {
+          char *wb = (char *)&bk[add_bkt[j + 8]];
+          __builtin_prefetch(wb, 1);
+          __builtin_prefetch(wb + 64, 1);
+        }
         memcpy(bk[add_bkt[j]].x, x3a[j], 40);
         memcpy(bk[add_bkt[j]].y, y3a[j], 40);
       }
@@ -2721,7 +3142,17 @@ static bool g1_window_sum_52(const u64 *bases_xy, const Aff52 *b52,
       G1Jac *jb = new G1Jac[nbuckets];
       memset(jb, 0, (size_t)nbuckets * sizeof(G1Jac));
       next.insert(next.end(), cur.begin() + processed, cur.end());
-      for (long i : next) {
+      for (size_t bi = 0; bi < next.size(); ++bi) {
+        // prefetch the next few adds' base/bucket lines: one Jacobian
+        // mixed add (~16 scalar muls) is long enough to hide the miss
+        if (pf && bi + 2 < next.size()) {
+          const long i3 = next[bi + 2];
+          const int32_t d3 = sd[i3 * nwin + wi];
+          const char *px = (const char *)(bases_xy + 8 * i3);
+          _mm_prefetch(px, _MM_HINT_T0);
+          _mm_prefetch((const char *)&jb[d3 < 0 ? -d3 : d3], _MM_HINT_T0);
+        }
+        const long i = next[bi];
         int32_t dgt = sd[i * nwin + wi];
         long bno = dgt < 0 ? -dgt : dgt;
         const u64 *x = bases_xy + 8 * i;
@@ -4320,10 +4751,33 @@ static void g2_window_sum_affine(const u64 *bases, const int32_t *sd, long n,
     next.clear();
     size_t processed = 0;
     bool bail = false;
+    const bool pf = msm_interleave_enabled();
     for (size_t lo = 0; lo < cur.size() && !bail; lo += B, ++chunk_id) {
       size_t hi = lo + B < cur.size() ? lo + B : cur.size();
       long m = 0;
       for (size_t k = lo; k < hi; ++k) {
+        // Two-level prefetch down the schedule: pull the digit word
+        // first (far), then — once it is cheap to read — the dependent
+        // stamp/bucket/base lines (near).  The bucket table and the
+        // bases both sit beyond L2 at bench shape and the index
+        // pattern is hardware-prefetch-blind.
+        if (pf) {
+          if (k + 32 < hi)
+            _mm_prefetch((const char *)&sd[cur[k + 32] * nwin + wi],
+                         _MM_HINT_T0);
+          if (k + 16 < hi) {
+            const long i2 = cur[k + 16];
+            const int32_t d2 = sd[i2 * nwin + wi];
+            const long b2 = d2 < 0 ? -d2 : d2;
+            _mm_prefetch((const char *)&stamp[b2], _MM_HINT_T0);
+            const char *pb = (const char *)&bk[b2];
+            _mm_prefetch(pb, _MM_HINT_T0);
+            _mm_prefetch(pb + 64, _MM_HINT_T0);
+            const char *pp = (const char *)(bases + 16 * i2);
+            _mm_prefetch(pp, _MM_HINT_T0);
+            _mm_prefetch(pp + 64, _MM_HINT_T0);
+          }
+        }
         long i = cur[k];
         int32_t dgt = sd[i * nwin + wi];
         long bno = dgt < 0 ? -dgt : dgt;
@@ -4930,7 +5384,15 @@ static bool g1_window_sum_52_multi(const u64 *bases_xy, const Aff52 *b52,
       long long ap0 = prof_now_ns();
       g1_chunk_apply_52(bk, b52, add_bkt, add_pt, negf, dbl, m, x3a, y3a, scratch);
       stat_add(ST_MSM_APPLY_NS, prof_now_ns() - ap0);
+      const bool pf_wb = msm_interleave_enabled();
       for (long j = 0; j < m; ++j) {
+        // write-prefetch ahead — the chunk working set evicted these
+        // bucket lines since the gather (see g1_window_sum_52)
+        if (pf_wb && j + 8 < m) {
+          char *wb = (char *)&bk[add_bkt[j + 8]];
+          __builtin_prefetch(wb, 1);
+          __builtin_prefetch(wb + 64, 1);
+        }
         memcpy(bk[add_bkt[j]].x, x3a[j], 40);
         memcpy(bk[add_bkt[j]].y, y3a[j], 40);
       }

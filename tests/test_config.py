@@ -47,6 +47,9 @@ def test_env_overrides_every_knob():
         "ZKP2P_MSM_PRECOMP_FAMILIES": "a,h",
         "ZKP2P_MATVEC_SEG": "0",
         "ZKP2P_NTT_POOL": "0",
+        "ZKP2P_MSM_INTERLEAVE": "0",
+        "ZKP2P_NTT_RADIX8": "1",
+        "ZKP2P_WITNESS_U64": "0",
         "ZKP2P_BATCH_CHUNK": "8",
         "ZKP2P_FIELD_CONV": "limb_major",
         "ZKP2P_FIELD_MUL": "pallas",
@@ -121,6 +124,8 @@ def test_env_overrides_every_knob():
     assert cfg.precomp_max_mb == 512 and cfg.precomp_cache == "/tmp/precomp_cache"
     assert cfg.precomp_persist_min == 1024 and cfg.precomp_families == "a,h"
     assert cfg.matvec_seg is False and cfg.ntt_pool is False
+    assert cfg.msm_interleave is False and cfg.ntt_radix8 is True
+    assert cfg.witness_u64 is False
     assert cfg.batch_chunk == "8"
     assert cfg.field_conv == "limb_major" and cfg.field_mul == "pallas" and cfg.curve_kernel == "xla"
     assert cfg.native_ifma is False and cfg.native_threads == 7 and cfg.no_cache is True
@@ -255,6 +260,21 @@ def test_reader_matched_parsers():
     assert load_config(environ={"ZKP2P_PERF_WINDOW": "3"}).perf_window == 3
     assert load_config(environ={"ZKP2P_PERF_WINDOW": "0"}).perf_window == 1
     assert load_config(environ={"ZKP2P_PERF_WINDOW": "junk"}).perf_window == 8
+    # PR-20 floor knobs: interleave and witness-u64 follow the C
+    # runtime's not-zero rule (committed ON, off only on a leading
+    # '0'); radix-8 follows the C gate's leading-'1' rule — committed
+    # OFF (0.95x on narrow hosts), ON only on an explicit '1'
+    assert load_config(environ={}).msm_interleave is True
+    assert load_config(environ={"ZKP2P_MSM_INTERLEAVE": "0"}).msm_interleave is False
+    assert load_config(environ={"ZKP2P_MSM_INTERLEAVE": "true"}).msm_interleave is True
+    assert load_config(environ={}).witness_u64 is True
+    assert load_config(environ={"ZKP2P_WITNESS_U64": "0"}).witness_u64 is False
+    assert load_config(environ={"ZKP2P_WITNESS_U64": "yes"}).witness_u64 is True
+    assert load_config(environ={}).ntt_radix8 is False
+    assert load_config(environ={"ZKP2P_NTT_RADIX8": "1"}).ntt_radix8 is True
+    assert load_config(environ={"ZKP2P_NTT_RADIX8": "0"}).ntt_radix8 is False
+    assert load_config(environ={"ZKP2P_NTT_RADIX8": "true"}).ntt_radix8 is False
+    assert load_config(environ={"ZKP2P_NTT_RADIX8": ""}).ntt_radix8 is False
     # flame-sampler knobs: gate default OFF (not-zero rule), the rate
     # must stay strictly positive (a 0 Hz sampler parks forever —
     # malformed/non-positive keeps the prime 47), capture_n is a

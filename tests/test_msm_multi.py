@@ -353,6 +353,39 @@ def test_prove_native_batch_matches_sequential(monkeypatch):
     assert verify(vk, seq[2], [(7 * 11) ** 2 % R])
 
 
+def test_prove_native_batch_floor_arms(monkeypatch):
+    """PR-20 floor arms on the batch path: prove_native_batch under
+    {interleave, radix-8, witness-u64 all-on / all-off} x {threads 1,2}
+    emits the exact bytes of the committed-old sequential proves — the
+    multi-column apply interleave and the builder-u64 hand-off are pure
+    scheduling/serialization changes."""
+    from zkp2p_tpu.prover import device_pk
+    from zkp2p_tpu.prover.native_prove import prove_native, prove_native_batch
+    from zkp2p_tpu.snark.groth16 import setup
+
+    cs, (out, x, y, z) = _toy_circuit()
+    wits = [
+        cs.witness([(3 * 5) ** 2 % R], {x: 3, y: 5}),
+        cs.witness([(3 * 10) ** 2 % R], {x: 3, y: 10}),
+        cs.witness([(7 * 11) ** 2 % R], {x: 7, y: 11}),
+    ]
+    pk, _vk = setup(cs)
+    dpk = device_pk(pk, cs)
+    rs = [rng.randrange(1, R) for _ in wits]
+    ss = [rng.randrange(1, R) for _ in wits]
+    for knob in ("ZKP2P_MSM_INTERLEAVE", "ZKP2P_NTT_RADIX8", "ZKP2P_WITNESS_U64"):
+        monkeypatch.setenv(knob, "0")
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", "1")
+    seq = [prove_native(dpk, w, r=r, s=s) for w, r, s in zip(wits, rs, ss)]
+    for arm in ("1", "0"):
+        for knob in ("ZKP2P_MSM_INTERLEAVE", "ZKP2P_NTT_RADIX8", "ZKP2P_WITNESS_U64"):
+            monkeypatch.setenv(knob, arm)
+        for threads in ("1", "2"):
+            monkeypatch.setenv("ZKP2P_NATIVE_THREADS", threads)
+            got = prove_native_batch(dpk, wits, rs=rs, ss=ss)
+            assert got == seq, f"floor arm={arm} threads={threads}"
+
+
 def test_prove_native_batch_edges():
     from zkp2p_tpu.prover import device_pk
     from zkp2p_tpu.prover.native_prove import prove_native, prove_native_batch

@@ -255,6 +255,61 @@ for knob in ("1", "0"):
 assert np.array_equal(lad["1"], lad["0"]), "pooled ladder != unfused ladder"
 print("ok ladder_pool", flush=True)
 
+# PR-20 interleaved apply arm (fresh-read per MSM): both arms at
+# threads 1 and 2 across the bucket drivers.  The down-stream prefetch
+# issues (schedule walk, gather/y2, bail-fill, writeback) and the
+# two-chain mul8x2 accumulators are the new surface — a prefetch off
+# the end of a table or bucket block is exactly what ASan would catch.
+for ilv in ("1", "0"):
+    os.environ["ZKP2P_MSM_INTERLEAVE"] = ilv
+    os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "1"
+    for threads in (1, 2):
+        out = np.zeros(8, dtype=np.uint64)
+        lib.g1_msm_pippenger_mt(
+            bm.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, 14, threads,
+            out.ctypes.data_as(u64p))
+        check(f"ilv={ilv} plain t={threads}", out)
+        out = np.zeros(8, dtype=np.uint64)
+        lib.g1_msm_pippenger_glv_mt(
+            b2.ctypes.data_as(u64p), sc.ctypes.data_as(u64p), n, n, 14, threads,
+            gc.ctypes.data_as(u64p), GLV_MAX_BITS, out.ctypes.data_as(u64p))
+        check(f"ilv={ilv} glv t={threads}", out)
+        outm = np.zeros((3, 8), dtype=np.uint64)
+        lib.g1_msm_pippenger_multi(
+            bm.ctypes.data_as(u64p), scm.ctypes.data_as(u64p), n, 3, 14, threads,
+            outm.ctypes.data_as(u64p))
+        check_multi(f"ilv={ilv} multi t={threads}", outm)
+        out = np.zeros(8, dtype=np.uint64)
+        lib.g1_msm_pippenger_fixed(
+            table.ctypes.data_as(u64p), t52.ctypes.data_as(u64p) if has52 else None,
+            sc.ctypes.data_as(u64p), n, n, Lq, cq, qq, threads, out.ctypes.data_as(u64p))
+        check(f"ilv={ilv} fixed t={threads}", out)
+print("ok msm_interleave", flush=True)
+
+# PR-20 radix-8 fused NTT stages: both arms x threads 1/2 through the
+# ladder at a domain deep enough for whole radix-8 passes (the fused
+# stage's wider twiddle strides and in-place SoA planes are the risk).
+log_r8 = 10; M8 = 1 << log_r8
+wroot8 = _scalars_to_u64([fr_domain_root(log_r8)]).copy()
+gcos8 = _scalars_to_u64([coset_gen(log_r8)]).copy()
+abc8 = _scalars_to_u64([rng.randrange(R) for _ in range(3 * M8)]).reshape(3, M8, 4).copy()
+os.environ["ZKP2P_NTT_POOL"] = "1"
+r8lad = {}
+for r8 in ("1", "0"):
+    os.environ["ZKP2P_NTT_RADIX8"] = r8
+    for t in ("1", "2"):
+        os.environ["ZKP2P_NATIVE_THREADS"] = t
+        abc = [np.ascontiguousarray(abc8[i].copy()) for i in range(3)]
+        d = np.zeros((M8, 4), dtype=np.uint64)
+        lib.fr_h_ladder(abc[0].ctypes.data_as(u64p), abc[1].ctypes.data_as(u64p),
+                        abc[2].ctypes.data_as(u64p), M8, wroot8.ctypes.data_as(u64p),
+                        gcos8.ctypes.data_as(u64p), d.ctypes.data_as(u64p))
+        r8lad[(r8, t)] = d
+ref8 = r8lad[("0", "1")]
+for key, d in r8lad.items():
+    assert np.array_equal(d, ref8), ("radix8 ladder diverged", key)
+print("ok ntt_radix8", flush=True)
+
 lib.zkp2p_pool_shutdown()
 print("ASAN-PARITY-GREEN", flush=True)
 """

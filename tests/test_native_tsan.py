@@ -187,6 +187,33 @@ for knob in ("1", "0"):
 assert np.array_equal(lad["1"], lad["0"]), "pooled ladder != unfused ladder"
 print("ok ladder_pool", flush=True)
 
+# ---- PR-20 floor arms under the race detector ------------------------
+# Interleaved apply: two submitters again, now with the prefetch-issuing
+# interleave arm on — the prefetches walk shared read-only schedule /
+# bucket memory while another worker fills its own chunk, which must
+# stay happens-before-clean.  Then both radix-8 ladder arms at
+# threads=2 (the fused stage splits planes across pool workers).
+for ilv in ("1", "0"):
+    os.environ["ZKP2P_MSM_INTERLEAVE"] = ilv  # fresh-read per MSM
+    ts = [threading.Thread(target=submitter, args=(f"ilv{ilv}-{i}", 2)) for i in range(2)]
+    for t in ts: t.start()
+    for t in ts: t.join()
+    assert not errors, errors
+print("ok msm_interleave", flush=True)
+
+r8lad = {}
+os.environ["ZKP2P_NTT_POOL"] = "1"
+for r8 in ("1", "0"):
+    os.environ["ZKP2P_NTT_RADIX8"] = r8
+    abc = [np.ascontiguousarray(abc0[i].copy()) for i in range(3)]
+    d = np.zeros((M, 4), dtype=np.uint64)
+    lib.fr_h_ladder(abc[0].ctypes.data_as(u64p), abc[1].ctypes.data_as(u64p),
+                    abc[2].ctypes.data_as(u64p), M, wroot.ctypes.data_as(u64p),
+                    gcosv.ctypes.data_as(u64p), d.ctypes.data_as(u64p))
+    r8lad[r8] = d
+assert np.array_equal(r8lad["1"], r8lad["0"]), "radix-8 ladder != radix-4 ladder"
+print("ok ntt_radix8", flush=True)
+
 stop.set()
 rd.join()
 lib.zkp2p_stats_reset()

@@ -455,3 +455,139 @@ def test_nonmsm_stats_counters(monkeypatch, toy_world):
     snap = stats_snapshot()
     assert snap["matvec_seg_calls"] == 0
     assert snap["matvec_ns"] > 0
+
+
+# ------------------------------------------- prove-floor arms (PR 20)
+
+
+@pytest.mark.parametrize("threads", ["1", "2"])
+def test_ntt_radix8_parity(monkeypatch, threads):
+    """fr_ntt_ifma under the radix-8 fused stages == the scalar fr_ntt
+    oracle, forward AND inverse, on both pool arms — the fusion
+    reorders the stage walk (3 log2 levels per pass) but every
+    butterfly is the same exact Fr arithmetic."""
+    lib = _lib()
+    m = 2048  # 11 stages: radix-8 passes + a ragged radix-4/2 tail
+    data = _mont(lib, _rand_fr(m, seed=43))
+    log_m = m.bit_length() - 1
+    root = np.ascontiguousarray(_scalars_to_u64([fr_domain_root(log_m)]))
+    winv = np.ascontiguousarray(
+        _scalars_to_u64([pow(fr_domain_root(log_m), R - 2, R)])
+    )
+    one = np.ascontiguousarray(_scalars_to_u64([1]))
+    minv = np.ascontiguousarray(_scalars_to_u64([pow(m, R - 2, R)]))
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", threads)
+    for root_std, scale in ((root, one), (winv, minv)):
+        want = np.ascontiguousarray(data.copy())
+        lib.fr_ntt(_p(want), m, _p(root_std), _p(scale))
+        for radix8 in ("1", "0"):
+            for pool in ("1", "0"):
+                monkeypatch.setenv("ZKP2P_NTT_RADIX8", radix8)
+                monkeypatch.setenv("ZKP2P_NTT_POOL", pool)
+                got = np.ascontiguousarray(data.copy())
+                lib.fr_ntt_ifma(_p(got), m, _p(root_std), _p(scale))
+                assert np.array_equal(got, want), (radix8, pool)
+
+
+@pytest.mark.parametrize("threads", ["1", "2"])
+def test_ladder_radix8_parity(monkeypatch, threads):
+    """fr_h_ladder (inverse-NTT -> coset -> forward-NTT pipeline): the
+    radix-8 fused stage arm == the radix-4 arm byte-for-byte at a
+    domain deep enough for whole radix-8 passes."""
+    lib = _lib()
+    log_m = 13
+    m = 1 << log_m
+    base = _mont(lib, _rand_fr(3 * m, seed=47)).reshape(3, m, 4)
+    wroot = np.ascontiguousarray(_scalars_to_u64([fr_domain_root(log_m)]))
+    gcos = np.ascontiguousarray(_scalars_to_u64([coset_gen(log_m)]))
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", threads)
+    res = {}
+    for radix8 in ("1", "0"):
+        monkeypatch.setenv("ZKP2P_NTT_RADIX8", radix8)
+        abc = [np.ascontiguousarray(base[i].copy()) for i in range(3)]
+        d = np.zeros((m, 4), dtype=np.uint64)
+        lib.fr_h_ladder(
+            _p(abc[0]), _p(abc[1]), _p(abc[2]), m, _p(wroot), _p(gcos), _p(d)
+        )
+        res[radix8] = d
+    assert np.array_equal(res["1"], res["0"])
+
+
+def test_witness_u64_at_builder():
+    """ConstraintSystem.witness / witness_batch emit the prover's
+    standard-form u64 column at BUILD time, byte-identical to the
+    prove-time serializer — and the builder_u64 short-circuit hands the
+    exact array over (zero copy), so witness_convert collapses."""
+    from zkp2p_tpu.prover.native_prove import _lib as pl, _witness_std_u64
+
+    lib = pl()
+    cs, (x, y, last) = _toy_circuit()
+    w = cs.witness([_toy_public()], {x: 3, y: 5})
+    assert w.u64 is not None and w.u64.shape == (len(w), 4)
+    assert any(v >= 1 << 64 for v in w), "toy witness lost its wide rows"
+    # builder serialization == BOTH prove-time serializer arms
+    assert np.array_equal(w.u64, _witness_std_u64(lib, list(w), fast=True))
+    assert np.array_equal(w.u64, _witness_std_u64(lib, list(w), fast=False))
+    # the gated short-circuit returns the builder array itself
+    got = _witness_std_u64(lib, w, fast=True, builder_u64=True)
+    assert np.shares_memory(got, w.u64)
+    # gate off (or a bare list) still serializes the slow way
+    assert np.array_equal(_witness_std_u64(lib, w, fast=True), w.u64)
+    # batch rows carry per-column u64; slices must NOT inherit it
+    # (a sliced row has a different serialization than its parent)
+    rows = cs.witness_batch([([_toy_public()], {x: 3, y: 5}), ([_toy_public()], {x: 3, y: 5})])
+    for row in rows:
+        assert row.u64 is not None and row.u64.shape == (len(row), 4)
+        assert np.array_equal(row.u64, _witness_std_u64(lib, list(row), fast=True))
+        assert getattr(row[1:], "u64", None) is None
+    # exotic values (>= r, negative) fall back to the exact serializer
+    from zkp2p_tpu.snark.r1cs import Witness, _std_u64
+
+    odd = Witness([0, 1, R - 1, R + 5, -3, 1 << 200])
+    assert np.array_equal(_std_u64(odd), _witness_std_u64(lib, list(odd), fast=False))
+
+
+def test_prove_floor_parity_matrix(monkeypatch, toy_world):
+    """The PR-20 floor arms: {ZKP2P_MSM_INTERLEAVE, ZKP2P_NTT_RADIX8,
+    ZKP2P_WITNESS_U64} x {threads 1,2} all emit IDENTICAL proof bytes
+    for single AND batch (S=3) proves — and the execution digest
+    separates every one of the 8 gate combinations."""
+    from zkp2p_tpu.prover.native_prove import prove_native, prove_native_batch
+    from zkp2p_tpu.snark.groth16 import verify
+    from zkp2p_tpu.utils import audit
+
+    cs, (x, y), dpk, vk = toy_world
+    publics = [_toy_public()]
+    w = cs.witness(publics, {x: 3, y: 5})
+    monkeypatch.setenv("ZKP2P_MSM_INTERLEAVE", "0")
+    monkeypatch.setenv("ZKP2P_NTT_RADIX8", "0")
+    monkeypatch.setenv("ZKP2P_WITNESS_U64", "0")
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", "1")
+    want = prove_native(dpk, w, r=11, s=13)  # the committed-old arm
+    assert verify(vk, want, publics)
+    digests = set()
+    for ilv in ("0", "1"):
+        for r8 in ("0", "1"):
+            for wu in ("0", "1"):
+                for threads in ("1", "2"):
+                    monkeypatch.setenv("ZKP2P_MSM_INTERLEAVE", ilv)
+                    monkeypatch.setenv("ZKP2P_NTT_RADIX8", r8)
+                    monkeypatch.setenv("ZKP2P_WITNESS_U64", wu)
+                    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", threads)
+                    got = prove_native(dpk, w, r=11, s=13)
+                    assert got == want, f"ilv={ilv} r8={r8} wu64={wu} threads={threads}"
+                arms = audit.gate_arms()
+                assert arms["native_msm_interleave"] == ("on" if ilv == "1" else "off")
+                assert arms["native_ntt_radix8"] == ("on" if r8 == "1" else "off")
+                assert arms["native_witness_u64"] == ("on" if wu == "1" else "off")
+                digests.add(audit.execution_digest())
+    assert len(digests) == 8, "digest must separate every floor-gate combo"
+    # batch path, full-new vs full-old arms — same bytes as sequential
+    monkeypatch.setenv("ZKP2P_NATIVE_THREADS", "2")
+    seq = [prove_native(dpk, w, r=r_, s=s_) for r_, s_ in ((11, 13), (2, 5), (3, 7))]
+    for arm in ("1", "0"):
+        monkeypatch.setenv("ZKP2P_MSM_INTERLEAVE", arm)
+        monkeypatch.setenv("ZKP2P_NTT_RADIX8", arm)
+        monkeypatch.setenv("ZKP2P_WITNESS_U64", arm)
+        got = prove_native_batch(dpk, [w, w, w], rs=[11, 2, 3], ss=[13, 5, 7])
+        assert got == seq, f"batch floor arm={arm}"

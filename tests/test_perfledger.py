@@ -325,6 +325,22 @@ def test_gate_ok_drift_and_fail_closed(tmp_path):
     assert rc == 1
     assert [v for v in verdicts if v["verdict"] == "DRIFT"][0]["stage"] == "prove"
 
+    # head BEATS the band median by more than the tolerance factor ->
+    # informational IMPROVED (rc stays 0): the band is stale-loose and
+    # should be re-frozen (`zkp2p-tpu perf --rebaseline`)
+    pl.append_entry(
+        _entry(stages={"prove": {"p50_ms": 40.0, "p95_ms": 40.0, "n": 1}}), path=ledger)
+    rc, verdicts = pl.gate_check(baseline_path=base, ledger_path=ledger)
+    assert rc == 0
+    assert [v for v in verdicts if v["stage"] == "prove"][0]["verdict"] == "IMPROVED"
+    # a merely-better head stays "ok" — IMPROVED must clear tolerance,
+    # otherwise every within-band wobble would nag for a rebaseline
+    pl.append_entry(
+        _entry(stages={"prove": {"p50_ms": 95.0, "p95_ms": 95.0, "n": 1}}), path=ledger)
+    rc, verdicts = pl.gate_check(baseline_path=base, ledger_path=ledger)
+    assert rc == 0
+    assert [v for v in verdicts if v["stage"] == "prove"][0]["verdict"] == "ok"
+
     # fail closed: no baseline, unreadable baseline schema, empty ledger
     assert pl.gate_check(baseline_path=str(tmp_path / "nope.json"),
                          ledger_path=ledger)[0] == 2

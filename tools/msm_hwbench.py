@@ -161,6 +161,120 @@ def _native_bench(args):
     )
 
 
+def _native_apply_prof_bench(args):
+    """--apply-prof arm: isolated fill/apply/suffix/bailfill attribution
+    for the MSM apply-interleave lever, riding the csrc `g_prof_*`
+    counters (ZKP2P_MSM_PROF is latched ON in main() BEFORE the native
+    lib loads).  Interleaved same-process A/B — ZKP2P_MSM_INTERLEAVE=1
+    vs =0 alternate every rep (the C side fresh-reads the env per call),
+    min-of-reps per arm, counters drained before each rep so every
+    split belongs to exactly one call — with the usual result-hash
+    parity echo.  NOTE the fill window ENCLOSES the apply window
+    (sched = fill - apply), so the columns do not sum to the wall."""
+    import ctypes
+    import hashlib
+    import random
+
+    import numpy as np
+
+    from zkp2p_tpu.field.bn254 import GLV_MAX_BITS, R
+    from zkp2p_tpu.curve.host import G1_GENERATOR, g1_mul
+    from zkp2p_tpu.native.lib import _pack_affine, _scalars_to_u64
+    from zkp2p_tpu.prover.native_prove import (
+        _glv_consts,
+        _lib,
+        _n_threads,
+        _p,
+        _pick_window,
+        _pick_window_glv,
+    )
+
+    lib = _lib()
+    assert lib is not None, "native library unavailable"
+    lib.zkp2p_msm_prof_dump.argtypes = [ctypes.POINTER(ctypes.c_longlong)]
+    threads = _n_threads()
+    n = args.n
+    rng = np.random.default_rng(7)
+    host_pts = [g1_mul(G1_GENERATOR, int(k)) for k in rng.integers(1, 1 << 30, 64)]
+    bases = _pack_affine(host_pts)
+    bm64 = np.zeros_like(bases)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.fp_to_mont.argtypes = [u64p, u64p, ctypes.c_int]
+    lib.fp_to_mont(_p(bases), _p(bm64), 2 * 64)
+    bm = np.ascontiguousarray(np.tile(bm64, ((n + 63) // 64, 1))[:n])
+    py_rng = random.Random(11)
+    sc = np.ascontiguousarray(_scalars_to_u64([py_rng.randrange(R) for _ in range(n)]))
+    out = np.zeros(8, dtype=np.uint64)
+    if args.glv:
+        c = args.window if args.window is not None else _pick_window_glv(n, threads=threads)
+        phi = np.zeros_like(bm)
+        lib.g1_glv_phi_bases(_p(bm), n, _p(_glv_consts()), _p(phi))
+        b2 = np.ascontiguousarray(np.concatenate([bm, phi]))
+
+        def run():
+            lib.g1_msm_pippenger_glv_mt(
+                _p(b2), _p(sc), n, n, c, threads, _p(_glv_consts()), GLV_MAX_BITS, _p(out)
+            )
+    else:
+        c = args.window if args.window is not None else _pick_window(n, threads=threads)
+
+        def run():
+            lib.g1_msm_pippenger_mt(_p(bm), _p(sc), n, c, threads, _p(out))
+
+    def drain():
+        buf = (ctypes.c_longlong * 4)()
+        lib.zkp2p_msm_prof_dump(buf)
+        return [int(v) for v in buf]
+
+    print(
+        f"apply-prof: n={n} c={c} threads={threads} "
+        f"glv={'on' if args.glv else 'off'} reps={args.reps} "
+        "(interleaved ZKP2P_MSM_INTERLEAVE=1/0 per rep)",
+        flush=True,
+    )
+    best = {}  # arm -> (wall_s, [fill, apply, suffix, bailfill] ns)
+    hashes = {}
+    for rep in range(args.reps):
+        for arm in ("1", "0"):
+            os.environ["ZKP2P_MSM_INTERLEAVE"] = arm
+            drain()
+            t0 = time.perf_counter()
+            run()
+            wall = time.perf_counter() - t0
+            split = drain()
+            if arm not in best or wall < best[arm][0]:
+                best[arm] = (wall, split)
+            hashes.setdefault(arm, hashlib.sha256(out.tobytes()).hexdigest()[:16])
+    os.environ.pop("ZKP2P_MSM_INTERLEAVE", None)
+    names = ("fill", "apply", "suffix", "bailfill")
+    for arm in ("0", "1"):
+        wall, split = best[arm]
+        cols = " ".join(f"{nm}={v / 1e6:.1f}ms" for nm, v in zip(names, split))
+        print(
+            f"  interleave={arm}: wall={wall * 1e3:.1f}ms {cols} "
+            f"(sched={ (split[0] - split[1]) / 1e6:.1f}ms) "
+            f"result_hash={hashes[arm]}",
+            flush=True,
+        )
+    w1, s1 = best["1"]
+    w0, s0 = best["0"]
+    parity = hashes["1"] == hashes["0"]
+    print(
+        f"  speedup: wall {w0 / w1:.3f}x  apply "
+        f"{(s0[1] / s1[1]) if s1[1] else float('nan'):.3f}x  "
+        f"parity={'OK' if parity else 'MISMATCH'}",
+        flush=True,
+    )
+    assert parity, "apply-prof arms disagree on the MSM result"
+    _rec(
+        arm="native_apply_prof", tag="glv" if args.glv else "plain", n=n, c=c,
+        threads=threads, reps=args.reps,
+        interleave_on={"wall_s": w1, **{nm + "_ns": v for nm, v in zip(names, s1)}},
+        interleave_off={"wall_s": w0, **{nm + "_ns": v for nm, v in zip(names, s0)}},
+        result_hash=hashes["1"],
+    )
+
+
 def _native_precomp_bench(args, lib, bm, sc, threads):
     """--precomp arm: fixed-base precomputed-table drivers vs the
     variable-base oracle (GLV when --glv, plain otherwise) — tables
@@ -549,6 +663,13 @@ def main():
         help="native tier: plain mixed-Jacobian bucket fill (the A/B baseline)",
     )
     ap.add_argument(
+        "--apply-prof", action="store_true",
+        help="native arm: isolated fill/apply/suffix/bailfill split via the "
+        "csrc g_prof_* counters (ZKP2P_MSM_PROF latched before lib load), "
+        "interleaved ZKP2P_MSM_INTERLEAVE=1/0 A/B with a parity hash — the "
+        "measurable surface for the apply-interleave lever (docs/TUNING.md)",
+    )
+    ap.add_argument(
         "--json", action="store_true",
         help="after all text output, emit ONE JSON document of structured "
         "per-arm records (arm, shape, min-of-reps seconds, parity hash) — "
@@ -564,6 +685,10 @@ def main():
         os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "1"
     elif args.no_batch_affine:
         os.environ["ZKP2P_MSM_BATCH_AFFINE"] = "0"
+    if args.apply_prof:
+        # the C prof gate is latched at first use — arm it before ANY
+        # native call so every counter add is live for the whole run
+        os.environ["ZKP2P_MSM_PROF"] = "1"
 
     try:
         _dispatch(args)
@@ -575,6 +700,11 @@ def main():
 def _dispatch(args):
     if args.ladder:
         _ladder_bench(args)
+        return
+    if args.apply_prof:
+        if args.window is not None and args.window <= 0:
+            args.window = None
+        _native_apply_prof_bench(args)
         return
     if args.native:
         _native_bench(args)

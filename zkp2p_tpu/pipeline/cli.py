@@ -960,10 +960,10 @@ def cmd_perf(args):
         )
         for v in verdicts:
             if v["verdict"] in ("new", "gone"):
-                print(f"{v['verdict']:<6} {v['circuit']}/{v['stage']}")
+                print(f"{v['verdict']:<8} {v['circuit']}/{v['stage']}")
                 continue
             print(
-                f"{v['verdict']:<6} {v['circuit']}/{v['stage']}: "
+                f"{v['verdict']:<8} {v['circuit']}/{v['stage']}: "
                 f"head p50 {v['p50_ms']:.1f} ms vs budget {v['budget_ms']:.1f} ms "
                 f"(band median {v['median_ms']:.1f} ms)"
             )
@@ -976,8 +976,16 @@ def cmd_perf(args):
                           f"(trigger {cdoc.get('trigger')}, "
                           f"entry {cdoc.get('entry_digest')})")
         drifts = sum(1 for v in verdicts if v["verdict"] == "DRIFT")
+        improved = sum(1 for v in verdicts if v["verdict"] == "IMPROVED")
         print(f"perf-gate: {'DRIFT' if rc == 1 else 'FAIL CLOSED' if rc else 'ok'} "
               f"({drifts} drifting stage(s) of {len(verdicts)})")
+        # a head landing well UNDER its band means the band is stale-
+        # loose: say so and name the fix — the improvement becomes the
+        # guarded floor only after a rebaseline
+        if improved:
+            print(f"perf-gate: {improved} IMPROVED stage(s) — band is "
+                  "stale-loose; freeze the new floor with "
+                  "`zkp2p-tpu perf --rebaseline`")
         sys.exit(rc)
     if did_action:
         return

@@ -293,6 +293,48 @@ def _ntt_pool_arm() -> bool:
     return record_arm("native_ntt_pool", load_config().ntt_pool)
 
 
+def _msm_interleave_arm() -> bool:
+    """MSM apply interleave gate (ZKP2P_MSM_INTERLEAVE, default ON).
+    Resolved IN the C runtime (fresh getenv per apply/window-sum call):
+    =1 runs the batched affine apply as two independent chunk groups
+    through one mont52_mul8x2 register schedule plus software prefetch
+    down the known bucket/point schedules; =0 is the single-chain
+    byte-parity oracle arm.  This mirror records the arm into the
+    execution digest (docs/NEXT.md lever 4)."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("native_msm_interleave", load_config().msm_interleave)
+
+
+def _ntt_radix8_arm() -> bool:
+    """NTT radix-8 pass gate (ZKP2P_NTT_RADIX8, default OFF on narrow
+    hosts — measured 0.95x at 2^19 on the 1-core box, see
+    docs/TUNING.md).  Resolved IN the C runtime (fresh getenv per
+    stage-batch call): =1 fuses three butterfly stages per load/store
+    pass in fr_ntt_soa_stages; unset/=0 keeps the radix-4 pairs — the
+    byte-parity oracle arm.  Mirror-recorded into the execution digest
+    (docs/NEXT.md lever 2)."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("native_ntt_radix8", load_config().ntt_radix8)
+
+
+def _use_witness_u64() -> bool:
+    """Witness-at-builder gate (ZKP2P_WITNESS_U64, default ON): when the
+    witness object carries a build-time standard-form `u64` array
+    (snark.r1cs.Witness / WitnessRow), the witness_convert stage hands
+    it off instead of re-serializing Python ints every prove; =0 (or a
+    plain witness sequence) re-serializes — the byte-parity oracle arm
+    (docs/NEXT.md lever 3).  Fresh-read per prove and record_arm-audited
+    so A/B digests distinguish the arms."""
+    from ..utils.audit import record_arm
+    from ..utils.config import load_config
+
+    return record_arm("native_witness_u64", load_config().witness_u64)
+
+
 # ONE process-wide executor for the prover's Python-side task graphs
 # (stage overlap + oracle-arm matvec jobs).  The per-prove, per-matvec
 # `ThreadPoolExecutor(max_workers=2)` constructions this replaces
@@ -318,7 +360,9 @@ def _shared_executor():
         return _executor
 
 
-def _witness_std_u64(lib, witness: Sequence[int], fast: bool = False) -> np.ndarray:
+def _witness_std_u64(
+    lib, witness: Sequence[int], fast: bool = False, builder_u64: bool = False
+) -> np.ndarray:
     """Witness ints -> standard-form (n, 4) u64 MSM scalars, reduced
     mod r IN THE NATIVE LIBRARY (docs/NEXT.md lever 3): raw 256-bit
     serialization here, `fr_reduce_batch` there — the per-element
@@ -326,6 +370,14 @@ def _witness_std_u64(lib, witness: Sequence[int], fast: bool = False) -> np.ndar
     Values a 256-bit window cannot hold (negative or >= 2^256 — no
     in-tree witness builder emits them) fall back to the exact Python
     reduction.
+
+    builder_u64=True (the ZKP2P_WITNESS_U64 arm): a witness built by
+    snark.r1cs already carries its standard-form serialization (`u64`
+    attribute, emitted at build time from the same bulk/exact split),
+    so the whole stage collapses to an array hand-off.  The arm is
+    resolved by the caller per prove, so an in-process A/B exercises
+    both paths on the identical witness object; a plain sequence (no
+    `u64`) falls through to the serializing arms regardless.
 
     fast=True (the ZKP2P_MATVEC_SEG arm — witness-side leg of the same
     vectorized-floor tier, so the knob-off arm reproduces the full
@@ -337,6 +389,10 @@ def _witness_std_u64(lib, witness: Sequence[int], fast: bool = False) -> np.ndar
     chunk alone.  Byte-identical to the slow path by construction
     (pinned in tests/test_nonmsm.py)."""
     n = len(witness)
+    if builder_u64:
+        u = getattr(witness, "u64", None)
+        if u is not None and getattr(u, "shape", None) == (n, 4):
+            return np.ascontiguousarray(u)
     if fast and n:
         try:
             arr = np.zeros((n, 4), dtype=np.uint64)
@@ -598,10 +654,15 @@ def prove_native(
     threads = _n_threads()
     plans = _seg_plans(dpk)  # memoized; resolves the matvec_seg gate
     _ntt_pool_arm()  # C-side gate; recorded here for the digest
+    _msm_interleave_arm()  # C-side gate; recorded here for the digest
+    _ntt_radix8_arm()  # C-side gate; recorded here for the digest
+    wit_u64 = _use_witness_u64()
 
     # Witness: standard-form u64x4 (MSM scalars) + Montgomery (matvec).
     with trace("native/witness_convert"):
-        w_std = _witness_std_u64(lib, witness, fast=plans is not None)
+        w_std = _witness_std_u64(
+            lib, witness, fast=plans is not None, builder_u64=wit_u64
+        )
         n_wires = w_std.shape[0]
         # inferred-width guard, vectorized over the limb view
         _check_inferred_widths(dpk, witness, w_std=w_std)
@@ -785,6 +846,9 @@ def prove_native_batch(
     # arm recordings — ladder constants are hoisted further down.
     plans = _seg_plans(dpk)
     _ntt_pool_arm()
+    _msm_interleave_arm()
+    _ntt_radix8_arm()
+    wit_u64 = _use_witness_u64()
 
     # Phase 1: witness conversion for EVERY proof first — it is cheap
     # and unlocks all three witness-column multi MSMs (a/b1/c) plus the
@@ -793,7 +857,9 @@ def prove_native_batch(
     w_cols, w_monts = [], []
     for witness in witnesses:
         with trace("native/witness_convert"):
-            w_std = _witness_std_u64(lib, witness, fast=plans is not None)
+            w_std = _witness_std_u64(
+                lib, witness, fast=plans is not None, builder_u64=wit_u64
+            )
             n_wires = w_std.shape[0]
             _check_inferred_widths(dpk, witness, w_std=w_std)
             w_mont = np.zeros_like(w_std)

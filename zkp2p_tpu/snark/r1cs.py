@@ -30,6 +30,70 @@ from ..field.bn254 import R
 Coeffs = Dict[int, int]  # wire index -> Fr coefficient
 
 
+class Witness(list):
+    """A witness vector (Fr ints) that also carries ``u64``: the prover's
+    standard-form (n, 4) little-endian u64 serialization, emitted at build
+    time so the per-prove ``witness_convert`` stage collapses to an array
+    hand-off (docs/NEXT.md lever 3, gated by ``ZKP2P_WITNESS_U64``)."""
+
+    u64 = None
+
+
+_WITNESS_ROW_CLS = None
+
+
+def _witness_row_cls():
+    """Object-dtype ndarray subclass used for batch witness rows, lazy so
+    the frontend keeps importing without numpy."""
+    global _WITNESS_ROW_CLS
+    if _WITNESS_ROW_CLS is None:
+        import numpy as np
+
+        class WitnessRow(np.ndarray):
+            """Batch witness column carrying the build-time ``u64``
+            standard-form serialization (see :class:`Witness`)."""
+
+            u64 = None
+
+            def __array_finalize__(self, obj):
+                u = getattr(obj, "u64", None)
+                # Propagate only through same-shape views; a slice or
+                # reduction must not inherit a stale serialization.
+                self.u64 = (
+                    u
+                    if u is not None and getattr(obj, "shape", None) == self.shape
+                    else None
+                )
+
+        _WITNESS_ROW_CLS = WitnessRow
+    return _WITNESS_ROW_CLS
+
+
+def _std_u64(vals, out=None):
+    """Serialize reduced Fr values to the prover's standard form: (n, 4)
+    uint64 little-endian limb rows.  Bulk numpy assign covers the sub-2^64
+    common case (>99% of wires at the bench shape); a chunk that overflows
+    falls back to exact 32-byte serialization — mirroring
+    ``native_prove._witness_std_u64`` so builder-emitted and prove-time
+    serializations are byte-identical."""
+    import numpy as np
+
+    n = len(vals)
+    arr = np.zeros((n, 4), dtype=np.uint64) if out is None else out
+    col = arr[:, 0]
+    CH = 8192
+    for lo in range(0, n, CH):
+        hi = min(n, lo + CH)
+        try:
+            col[lo:hi] = vals[lo:hi]
+        except (OverflowError, TypeError, ValueError):
+            arr[lo:hi] = np.frombuffer(
+                b"".join((int(v) % R).to_bytes(32, "little") for v in vals[lo:hi]),
+                dtype="<u8",
+            ).reshape(hi - lo, 4)
+    return arr
+
+
 class LC:
     """Linear combination of wires over Fr."""
 
@@ -340,7 +404,9 @@ class ConstraintSystem:
                 "statically as hook-coverage), first: "
                 + "; ".join(self.wire_desc(i) for i in missing[:5])
             )
-        return w  # type: ignore[return-value]
+        out = Witness(w)
+        out.u64 = _std_u64(out)
+        return out
 
     def witness_batch(
         self, inputs: Sequence[tuple], stats: Optional[Dict[str, int]] = None
@@ -522,12 +588,49 @@ class ConstraintSystem:
             stats["block_hooks"] = n_block
         toobj(np.flatnonzero(~hasobj))  # one merged materialization
         self._hooks_validated = True
+        # Standard-form u64 serialization at the builder (docs/NEXT.md
+        # lever 3), vectorized while the wires are still row-major per
+        # wire: int64-backed rows are canonical and non-negative in the
+        # common case and bulk-cast; object rows bulk-cast per chunk with
+        # the same exact fallback as _std_u64.
+        U = np.zeros((self.num_wires, K, 4), dtype=np.uint64)
+        i64 = np.flatnonzero(has64)
+        slow_rows = np.flatnonzero(~has64)
+        if i64.size:
+            neg = (W64[i64] < 0).any(axis=1)
+            ok = i64[~neg]
+            U[ok, :, 0] = W64[ok].astype(np.uint64)
+            if neg.any():
+                slow_rows = np.concatenate([slow_rows, i64[neg]])
+        CH = 8192
+        for lo in range(0, slow_rows.size, CH):
+            idx = slow_rows[lo : lo + CH]
+            try:
+                U[idx, :, 0] = W[idx].astype(np.uint64)
+            except (OverflowError, TypeError, ValueError):
+                for i in idx:
+                    try:
+                        U[i, :, 0] = W[i].astype(np.uint64)
+                    except (OverflowError, TypeError, ValueError):
+                        U[i] = np.frombuffer(
+                            b"".join(
+                                (int(v) % R).to_bytes(32, "little") for v in W[i]
+                            ),
+                            dtype="<u8",
+                        ).reshape(K, 4)
         # One contiguous transpose copy (per-row strided gathers cost ~4x
         # more), then row views: W/W64 and the flag arrays are released;
         # what stays referenced is exactly the K witness vectors.  (A
         # caller keeping ONE witness long-term keeps its K-batch block —
         # copy the row if that matters.)
-        return list(np.ascontiguousarray(W.T))
+        Wt = np.ascontiguousarray(W.T)
+        row_cls = _witness_row_cls()
+        out: List[Sequence[int]] = []
+        for k in range(K):
+            row = Wt[k].view(row_cls)
+            row.u64 = np.ascontiguousarray(U[:, k])
+            out.append(row)
+        return out
 
     # ---------------------------------------------------------- checking
 

@@ -354,13 +354,16 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
 
     from ..native.lib import get_lib
     from ..prover.native_prove import (
+        _msm_interleave_arm,
         _ntt_pool_arm,
+        _ntt_radix8_arm,
         _use_batch_affine,
         _use_glv,
         _use_matvec_seg,
         _use_msm_multi,
         _use_msm_overlap,
         _use_msm_precomp,
+        _use_witness_u64,
     )
 
     _use_glv()
@@ -370,6 +373,9 @@ def preflight(probe: bool = False, workload: bool = True, log=None, cfg=None) ->
     _use_msm_precomp()
     _use_matvec_seg()
     _ntt_pool_arm()
+    _msm_interleave_arm()
+    _ntt_radix8_arm()
+    _use_witness_u64()
     native_ok = False
     try:
         native_ok = get_lib() is not None

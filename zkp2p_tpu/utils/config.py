@@ -41,6 +41,12 @@ def _not_zero(s: str) -> bool:
     return not s.startswith("0")
 
 
+def _starts_one(s: str) -> bool:
+    # the C runtime's opt-IN rule for default-off native arms: on ONLY
+    # when the value starts with '1' (csrc ntt_radix8_enabled)
+    return s.startswith("1")
+
+
 def _opt_int(s: str) -> Optional[int]:
     if not s:
         return None  # empty string = unset (shell-style), not 1 thread
@@ -239,6 +245,30 @@ KNOBS: Dict[str, Tuple[str, object, object]] = {
     # re-reads the env per call (csrc ntt_pool_enabled), so flips apply
     # immediately.
     "ntt_pool": ("ZKP2P_NTT_POOL", _not_zero, True),
+    # MSM apply interleave in the C batch-affine pipeline: the chunk
+    # apply splits its block range in two and drives both halves'
+    # prefix/inverse/apply mont52 chains through ONE fused register
+    # schedule (mont52_mul8x2 — the second chain fills the first's
+    # madd52 latency bubbles), plus software prefetch down the known
+    # (bucket, point) index streams in the schedule/fill/bail loops.
+    # Default ON; "0" restores the single-chain no-prefetch schedule —
+    # the byte-parity A/B arm.  Fresh-read per call (csrc
+    # msm_interleave_enabled), so flips apply immediately.
+    "msm_interleave": ("ZKP2P_MSM_INTERLEAVE", _not_zero, True),
+    # Radix-8 NTT stage fusion: three butterfly stages per load/store
+    # pass in fr_ntt_soa_stages (vs the radix-4 stage pairs).  Default
+    # OFF — measured 0.95x at 2^19 on the 1-core IFMA box (register
+    # spills; the muls are throughput-bound, so the saved memory pass
+    # does not pay there) — the knob stays for wider hosts; "1" arms it.
+    # Fresh-read per transform (csrc ntt_radix8_enabled).
+    "ntt_radix8": ("ZKP2P_NTT_RADIX8", _starts_one, False),
+    # Witness-at-builder hand-off: snark.r1cs witness builders attach
+    # the prover's standard-form (n, 4) u64 serialization at build time
+    # and the witness_convert stage hands it off instead of
+    # re-serializing Python ints every prove.  Default ON; "0"
+    # re-serializes — the byte-parity oracle arm.  Fresh-read per prove
+    # at the _witness_std_u64 call site.
+    "witness_u64": ("ZKP2P_WITNESS_U64", _not_zero, True),
     # proof-batch sub-chunking: "auto" (4 per chunk on a real TPU — the
     # 16 GB HBM budget; whole batch elsewhere), "0" (never chunk), or an
     # explicit chunk size.  r5 bench1 on-chip: the batched h-evals stage
@@ -428,6 +458,7 @@ ARMABLE = (
     "msm_affine", "msm_h", "msm_glv", "msm_batch_affine", "msm_overlap",
     "msm_multi", "msm_precomp", "matvec_seg", "ntt_pool", "sched",
     "profile", "tpu_shard", "worker_tier", "perf_ledger", "flame",
+    "msm_interleave", "ntt_radix8", "witness_u64",
 )
 _ARMABLE_ENV = {KNOBS[k][0] for k in ARMABLE}
 
@@ -446,6 +477,9 @@ class ProverConfig:
     msm_precomp: bool = True
     matvec_seg: bool = True
     ntt_pool: bool = True
+    msm_interleave: bool = True
+    ntt_radix8: bool = False
+    witness_u64: bool = True
     precomp_depth: int = 8
     precomp_max_mb: int = 6144
     precomp_cache: str = ""
